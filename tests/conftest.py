@@ -1,0 +1,15 @@
+"""Test harness config.
+
+All tests run on CPU with 8 virtual XLA devices so mesh/sharding tests
+exercise real multi-device code paths without TPU hardware
+(SURVEY.md §4: the JAX equivalent of the reference's loopback
+master+slave-in-one-process tests, veles/tests/test_network.py:52-149).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()

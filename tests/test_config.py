@@ -1,0 +1,60 @@
+"""Config tree semantics (ref: veles/tests/test_config.py)."""
+
+import pytest
+
+from veles_tpu.config import Config, get
+
+
+class TestConfig:
+    def test_autovivify(self):
+        c = Config("test")
+        c.a.b.d = 3
+        assert c.a.b.d == 3
+
+    def test_update(self):
+        c = Config("test")
+        c.update({"x": 1, "sub": {"y": 2}})
+        assert c.x == 1
+        assert c.sub.y == 2
+        c.update({"sub": {"z": 3}})
+        assert c.sub.y == 2 and c.sub.z == 3
+
+    def test_content(self):
+        c = Config("test")
+        c.update({"x": 1, "sub": {"y": 2}})
+        assert c.__content__() == {"x": 1, "sub": {"y": 2}}
+
+    def test_protect(self):
+        c = Config("test")
+        c.k = 1
+        c.protect("k")
+        with pytest.raises(AttributeError):
+            c.k = 2
+
+    def test_protect_blocks_update(self):
+        c = Config("test")
+        c.sub.x = 1
+        c.protect("sub")
+        with pytest.raises(AttributeError):
+            c.update({"sub": {"x": 99}})
+        assert c.sub.x == 1
+
+    def test_bool_empty_falsy(self):
+        c = Config("test")
+        assert not c.never_set
+        c.never_set.leaf = 1
+        assert c.never_set
+
+    def test_get_default(self):
+        c = Config("test")
+        assert get(c.missing, 5) == 5
+        c.present = 7
+        assert get(c.present, 5) == 7
+        assert c.get("present") == 7
+        assert c.get("absent", "d") == "d"
+
+    def test_contains(self):
+        c = Config("test")
+        assert "x" not in c
+        c.x = 0
+        assert "x" in c

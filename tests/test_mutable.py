@@ -1,0 +1,115 @@
+"""Bool gate algebra + LinkableAttribute semantics
+(pins behavior per ref: veles/tests/test_mutable.py)."""
+
+import pickle
+
+import pytest
+
+from veles_tpu.mutable import Bool, LinkableAttribute
+
+
+class TestBool:
+    def test_plain_value(self):
+        assert not bool(Bool())
+        assert bool(Bool(True))
+
+    def test_set_and_ilshift(self):
+        b = Bool(False)
+        b <<= True
+        assert bool(b)
+        b.set(False)
+        assert not bool(b)
+
+    def test_shared_identity(self):
+        b = Bool(False)
+        alias = b
+        b <<= True
+        assert bool(alias)
+
+    def test_invert_is_lazy(self):
+        b = Bool(False)
+        nb = ~b
+        assert bool(nb)
+        b <<= True
+        assert not bool(nb)  # re-evaluates against the live source
+
+    def test_and_or_xor(self):
+        a, b = Bool(True), Bool(False)
+        assert not bool(a & b)
+        assert bool(a | b)
+        assert bool(a ^ b)
+        b <<= True
+        assert bool(a & b)
+        assert not bool(a ^ b)
+
+    def test_compound_expression(self):
+        a, b, c = Bool(False), Bool(False), Bool(True)
+        expr = (a | b) & ~c
+        assert not bool(expr)
+        a <<= True
+        c <<= False
+        assert bool(expr)
+
+    def test_derived_not_assignable(self):
+        with pytest.raises(ValueError):
+            (~Bool()).set(True)
+
+    def test_pickle_keeps_structure(self):
+        a = Bool(True)
+        expr = ~a
+        # pickle the PAIR so the memo preserves shared identity
+        a2, expr2 = pickle.loads(pickle.dumps((a, expr)))
+        assert not bool(expr2)
+        a2.set(False)
+        assert bool(expr2)  # still live after round-trip
+
+    def test_pickle_compound_shared_identity(self):
+        a, b = Bool(False), Bool(True)
+        expr = (a | b) & ~a
+        a2, expr2 = pickle.loads(pickle.dumps((a, expr)))
+        assert bool(expr2)
+        a2.set(True)
+        assert not bool(expr2)
+
+
+class Holder:
+    def __init__(self):
+        self.x = 1
+
+
+class TestLinkableAttribute:
+    def test_forwarding(self):
+        src, dst = Holder(), Holder()
+        src.x = 42
+        LinkableAttribute(dst, "x", (src, "x"))
+        assert dst.x == 42
+        src.x = 7
+        assert dst.x == 7
+
+    def test_one_way_write_detaches(self):
+        src, dst = Holder(), Holder()
+        LinkableAttribute(dst, "x", (src, "x"))
+        dst.x = 99
+        assert dst.x == 99
+        assert src.x == 1  # source untouched
+
+    def test_two_way(self):
+        src, dst = Holder(), Holder()
+        LinkableAttribute(dst, "x", (src, "x"), two_way=True)
+        dst.x = 5
+        assert src.x == 5
+
+    def test_per_instance(self):
+        src, dst1, dst2 = Holder(), Holder(), Holder()
+        src.x = 10
+        LinkableAttribute(dst1, "x", (src, "x"))
+        assert dst1.x == 10
+        assert dst2.x == 1  # other instance unaffected
+
+    def test_unlink(self):
+        src, dst = Holder(), Holder()
+        src.x = 3
+        LinkableAttribute(dst, "x", (src, "x"))
+        LinkableAttribute.unlink(dst, "x")
+        src.x = 4
+        assert dst.x == 3  # frozen at unlink time

@@ -1,0 +1,148 @@
+"""Unit graph mechanics: links, gates, demands
+(ref: veles/tests/test_units.py)."""
+
+import pytest
+
+from veles_tpu.units import MissingDemand, Unit
+from veles_tpu.workflow import Workflow
+
+
+class Recorder(Unit):
+    """Appends its name to the workflow-shared trace on each run."""
+
+    def __init__(self, workflow, **kwargs):
+        super(Recorder, self).__init__(workflow, **kwargs)
+        self.trace = workflow.trace
+
+    def run(self):
+        self.trace.append(self.name)
+
+
+class TraceWorkflow(Workflow):
+    def __init__(self, **kwargs):
+        self.trace = []
+        super(TraceWorkflow, self).__init__(**kwargs)
+
+
+def build_chain(n=3):
+    wf = TraceWorkflow()
+    units = [Recorder(wf, name="u%d" % i) for i in range(n)]
+    units[0].link_from(wf.start_point)
+    for a, b in zip(units, units[1:]):
+        b.link_from(a)
+    wf.end_point.link_from(units[-1])
+    return wf, units
+
+
+class TestLinking:
+    def test_link_from(self):
+        wf, (a, b, c) = build_chain()
+        assert a in b.links_from
+        assert b in a.links_to
+
+    def test_unlink(self):
+        wf, (a, b, c) = build_chain()
+        b.unlink_from(a)
+        assert a not in b.links_from
+        assert b not in a.links_to
+
+    def test_unlink_all(self):
+        wf, (a, b, c) = build_chain()
+        b.unlink_all()
+        assert not b.links_from and not b.links_to
+        assert b not in a.links_to and b not in c.links_from
+
+    def test_getitem_by_name(self):
+        wf, units = build_chain()
+        assert wf["u1"] is units[1]
+        with pytest.raises(KeyError):
+            wf["nope"]
+
+
+class TestGates:
+    def test_chain_runs_in_order(self):
+        wf, units = build_chain(4)
+        wf.initialize()
+        wf.run()
+        assert wf.trace == ["u0", "u1", "u2", "u3"]
+        assert bool(wf.stopped)
+
+    def test_fan_in_waits_for_all(self):
+        wf = TraceWorkflow()
+        a = Recorder(wf, name="a")
+        b = Recorder(wf, name="b")
+        j = Recorder(wf, name="join")
+        a.link_from(wf.start_point)
+        b.link_from(wf.start_point)
+        j.link_from(a, b)
+        wf.end_point.link_from(j)
+        wf.initialize()
+        wf.run()
+        assert wf.trace == ["a", "b", "join"]
+
+    def test_gate_block_stops_signal(self):
+        wf, (a, b, c) = build_chain()
+        b.gate_block <<= True
+        wf.initialize()
+        wf.run()
+        assert wf.trace == ["u0"]
+        assert not bool(wf.stopped)  # wave died before reaching end_point
+
+    def test_gate_skip_propagates_without_running(self):
+        wf, (a, b, c) = build_chain()
+        b.gate_skip <<= True
+        wf.initialize()
+        wf.run()
+        assert wf.trace == ["u0", "u2"]
+
+    def test_gate_skip_via_shared_bool(self):
+        wf, (a, b, c) = build_chain()
+        cond = wf.stopped  # any live Bool
+        b.gate_skip = ~cond
+        wf.initialize()
+        wf.run()  # stopped False during run -> skip active
+        assert "u1" not in wf.trace
+
+
+class TestDemand:
+    def test_missing_demand_raises(self):
+        wf = Workflow()
+        u = Unit(wf, name="needy")
+        u.demand("supply")
+        with pytest.raises(MissingDemand):
+            wf.initialize()
+
+    def test_requeue_until_supplier_ready(self):
+        wf = Workflow()
+
+        class Supplier(Unit):
+            def initialize(self, **kwargs):
+                super(Supplier, self).initialize(**kwargs)
+                self.product = 42
+
+        class Consumer(Unit):
+            def __init__(self, workflow, **kw):
+                super(Consumer, self).__init__(workflow, **kw)
+                self.demand("product")
+
+        # consumer constructed FIRST so naive in-order init would fail
+        c = Consumer(wf)
+        s = Supplier(wf)
+        c.link_attrs(s, "product")
+        wf.initialize()
+        assert c.product == 42
+
+    def test_run_before_initialize_raises(self):
+        wf = Workflow()
+        u = Unit(wf)
+        with pytest.raises(RuntimeError):
+            u._run_wrapped()
+
+
+class TestTimers:
+    def test_run_counts(self):
+        wf, units = build_chain(2)
+        wf.initialize()
+        wf.run()
+        assert units[0].timers["runs"] == 1
+        assert units[0].timers["run"] >= 0

@@ -1,0 +1,160 @@
+"""Workflow: loops, initialization order, results, export
+(ref: veles/tests/test_workflow.py:69-278)."""
+
+import pickle
+
+from veles_tpu.mutable import Bool
+from veles_tpu.plumbing import Repeater
+from veles_tpu.result_provider import IResultProvider
+from veles_tpu.units import Unit
+from veles_tpu.workflow import Workflow
+
+
+class Counter(Unit):
+    """Counts runs; closes the loop after `limit` iterations by raising
+    its `complete` Bool (a tiny Decider)."""
+
+    def __init__(self, workflow, limit=5, **kwargs):
+        super(Counter, self).__init__(workflow, **kwargs)
+        self.limit = limit
+        self.count = 0
+        self.complete = Bool(False)
+
+    def run(self):
+        self.count += 1
+        if self.count >= self.limit:
+            self.complete <<= True
+
+
+class TestLoop:
+    def build_loop(self, limit=5):
+        """start -> repeater -> counter -> (loop back | end)"""
+        wf = Workflow()
+        rep = Repeater(wf)
+        cnt = Counter(wf, limit=limit)
+        rep.link_from(wf.start_point)
+        cnt.link_from(rep)
+        # loop back while not complete; end when complete
+        rep.link_from(cnt)
+        rep.gate_block = cnt.complete
+        wf.end_point.link_from(cnt)
+        wf.end_point.gate_block = ~cnt.complete
+        return wf, cnt
+
+    def test_loop_runs_limit_times(self):
+        wf, cnt = self.build_loop(5)
+        wf.initialize()
+        wf.run()
+        assert cnt.count == 5
+        assert bool(wf.stopped)
+
+    def test_loop_reruns_after_reset(self):
+        wf, cnt = self.build_loop(3)
+        wf.initialize()
+        wf.run()
+        cnt.count = 0
+        cnt.complete <<= False
+        wf.run()
+        assert cnt.count == 3
+
+
+class Supplier(Unit):
+    def initialize(self, **kwargs):
+        super(Supplier, self).initialize(**kwargs)
+        self.product = 42
+
+
+class Consumer(Unit):
+    def __init__(self, workflow, **kw):
+        super(Consumer, self).__init__(workflow, **kw)
+        self.demand("product")
+
+
+class Metric(Unit, IResultProvider):
+    def get_metric_values(self):
+        return {"accuracy": 0.42}
+
+
+class TestResults:
+    def test_gather_results(self):
+        wf = Workflow()
+        Metric(wf)
+        assert wf.gather_results() == {"accuracy": 0.42}
+
+
+class TestExport:
+    def test_generate_graph_dot(self):
+        wf = Workflow()
+        u = Unit(wf, name="node_a")
+        u.link_from(wf.start_point)
+        dot = wf.generate_graph()
+        assert "digraph" in dot
+        assert "node_a" in dot
+        assert "->" in dot
+
+    def test_checksum_stable(self):
+        assert Workflow().checksum() == Workflow().checksum()
+
+
+class TestPickling:
+    def test_workflow_roundtrip(self):
+        wf = Workflow()
+        u = Counter(wf, limit=1, name="cnt")
+        u.link_from(wf.start_point)
+        wf.end_point.link_from(u)
+        wf.initialize()
+        wf.run()
+        assert u.count == 1
+        blob = pickle.dumps(wf)
+        wf2 = pickle.loads(blob)
+        assert wf2["cnt"].count == 1
+        # volatile scheduler state was rebuilt
+        assert len(wf2._sched_queue_) == 0
+
+    def test_resume_loop_after_pickle(self):
+        """Derived gate Bools must stay LIVE across snapshot/resume."""
+        wf = pickle.loads(pickle.dumps(TestLoop().build_loop(3)[0]))
+        cnt = next(u for u in wf.units if isinstance(u, Counter))
+        wf.initialize()
+        wf.run()
+        assert cnt.count == 3   # loop still iterates, gates not frozen
+
+    def test_linked_attrs_survive_pickle(self):
+        wf = Workflow()
+        c = Consumer(wf, name="c")
+        s = Supplier(wf, name="s")
+        c.link_attrs(s, "product")
+        wf.initialize()
+        wf2 = pickle.loads(pickle.dumps(wf))
+        wf2.initialize()            # resume path: must not MissingDemand
+        assert wf2["c"].product == 42
+        wf2["s"].product = 7
+        assert wf2["c"].product == 7  # forwarding re-established, shared obj
+
+    def test_callback_not_pickled(self):
+        wf = Workflow()
+        wf.run_is_finished_callback_ = lambda: None
+        wf2 = pickle.loads(pickle.dumps(wf))  # must not raise
+        assert wf2.run_is_finished_callback_ is None
+
+    def test_volatile_attrs_skipped(self):
+        wf = Workflow()
+        u = Unit(wf)
+        u.scratch_ = object()  # unpicklable volatile
+        pickle.dumps(wf)  # must not raise
+
+
+class TestNesting:
+    def test_nested_workflow_runs_as_unit(self):
+        outer = Workflow(name="outer")
+        inner = Workflow(workflow=outer, name="inner")
+        c = Counter(inner, limit=1)
+        c.link_from(inner.start_point)
+        inner.end_point.link_from(c)
+
+        inner.link_from(outer.start_point)
+        outer.end_point.link_from(inner)
+        outer.initialize()
+        outer.run()
+        assert c.count == 1
+        assert bool(outer.stopped)

@@ -1,0 +1,27 @@
+"""veles_tpu — a TPU-native dataflow deep-learning framework.
+
+A ground-up rebuild of the capabilities of Samsung Veles (reference:
+/root/reference, see SURVEY.md) designed for TPU hardware: models are
+Workflows — directed graphs of Units with control-flow gates and linked
+attributes — whose accelerated segments compile into single XLA programs
+via jax.jit, shard over device meshes with pjit/shard_map, and use Pallas
+kernels for custom ops.
+
+Top-level layout (mirrors SURVEY.md §1's layer map, TPU-first):
+
+- :mod:`veles_tpu.config`        — ``root.*`` config tree (ref: veles/config.py)
+- :mod:`veles_tpu.mutable`       — Bool gate algebra, LinkableAttribute (ref: veles/mutable.py)
+- :mod:`veles_tpu.units`         — Unit graph nodes, gates, links (ref: veles/units.py)
+- :mod:`veles_tpu.workflow`      — Workflow container + scheduler (ref: veles/workflow.py)
+- :mod:`veles_tpu.backends`      — TPU / CPU device registry (ref: veles/backends.py)
+- :mod:`veles_tpu.memory`        — Array over jax.Array + Watcher (ref: veles/memory.py)
+- :mod:`veles_tpu.accelerated_units` — jit compilation layer (ref: veles/accelerated_units.py)
+- :mod:`veles_tpu.ops`           — Pallas/XLA kernels (ref: cuda/, ocl/)
+- :mod:`veles_tpu.loader`        — minibatch serving stack (ref: veles/loader/)
+- :mod:`veles_tpu.models`        — NN layer/trainer units + model zoo (ref: Znicz surface)
+- :mod:`veles_tpu.parallel`      — mesh, shardings, collectives (ref: veles/server.py et al.)
+"""
+
+__version__ = "0.1.0"
+
+__all__ = ["__version__"]

@@ -1,0 +1,168 @@
+"""Global configuration tree.
+
+TPU-native rebuild of the reference's attribute-autovivifying ``Config``
+(ref: veles/config.py:60-152): settings live in a single global tree
+``root.*``; reading a missing attribute creates a sub-tree, so user config
+files can write ``root.mnist.learning_rate = 0.01`` without declarations.
+
+Layered overrides (ref: veles/config.py:294-308): package defaults →
+``/etc/default/veles_tpu`` → ``~/.veles_tpu`` → ``$PWD/site_config.py`` →
+the per-run config file → ``-c "root.x=y"`` CLI snippets.
+"""
+
+import os
+import runpy
+from pathlib import Path
+
+
+class Config:
+    """A node in the config tree.  Attribute access autovivifies sub-trees."""
+
+    def __init__(self, path="root"):
+        object.__setattr__(self, "_path_", path)
+        object.__setattr__(self, "_protected_", set())
+
+    # -- tree behaviour ---------------------------------------------------
+
+    def __getattr__(self, name):
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        child = Config("%s.%s" % (self._path_, name))
+        object.__setattr__(self, name, child)
+        return child
+
+    def __setattr__(self, name, value):
+        if name in self._protected_:
+            raise AttributeError(
+                "config key %s.%s is protected" % (self._path_, name))
+        object.__setattr__(self, name, value)
+
+    def protect(self, *names):
+        """Mark keys read-only (ref: veles/config.py:79-84)."""
+        self._protected_.update(names)
+
+    def update(self, value):
+        """Deep-merge a dict (or another Config) into this node."""
+        if isinstance(value, Config):
+            value = value.__content__()
+        if not isinstance(value, dict):
+            raise TypeError("Config.update() needs a dict, got %r" % (value,))
+        for k, v in value.items():
+            if k in self._protected_:
+                raise AttributeError(
+                    "config key %s.%s is protected" % (self._path_, k))
+            if isinstance(v, dict):
+                getattr(self, k).update(v)
+            else:
+                setattr(self, k, v)
+        return self
+
+    def __content__(self):
+        """The tree below this node as a plain nested dict."""
+        out = {}
+        for k, v in vars(self).items():
+            if k.startswith("_") and k.endswith("_"):
+                continue
+            out[k] = v.__content__() if isinstance(v, Config) else v
+        return out
+
+    def get(self, name, default=None):
+        """Read a key without autovivifying; Config-valued (unset) → default."""
+        v = vars(self).get(name, default)
+        return default if isinstance(v, Config) else v
+
+    def __contains__(self, name):
+        v = vars(self).get(name)
+        return v is not None and not isinstance(v, Config)
+
+    def __bool__(self):
+        # An autovivified (empty) node is falsy so `if root.x.y:` is safe.
+        return bool(self.__content__())
+
+    def __iter__(self):
+        return iter(self.__content__().items())
+
+    def __repr__(self):
+        return "Config(%s: %r)" % (self._path_, self.__content__())
+
+    def print_(self, indent=0, file=None):
+        import sys
+        file = file or sys.stdout
+        for k, v in sorted(vars(self).items()):
+            if k.startswith("_") and k.endswith("_"):
+                continue
+            if isinstance(v, Config):
+                print("  " * indent + k + ":", file=file)
+                v.print_(indent + 1, file)
+            else:
+                print("  " * indent + "%s: %r" % (k, v), file=file)
+
+
+#: The global configuration tree (ref: veles/config.py:152).
+root = Config("root")
+
+# -- package defaults (ref: veles/config.py:178-291) ----------------------
+
+root.common.update({
+    "dirs": {
+        "cache": os.path.join(
+            os.environ.get("XDG_CACHE_HOME", str(Path.home() / ".cache")),
+            "veles_tpu"),
+        "snapshots": os.path.join(os.getcwd(), "snapshots"),
+        "datasets": os.environ.get(
+            "VELES_TPU_DATA", os.path.join(os.getcwd(), "data")),
+    },
+    "precision": {
+        # dtype policy: compute dtype for matmuls/convs, accumulation dtype,
+        # parameter dtype (replaces the reference's dtype/PRECISION_LEVEL
+        # macro layer, ocl/defines.cl:1-69).
+        "compute_dtype": "bfloat16",
+        "accum_dtype": "float32",
+        "param_dtype": "float32",
+        # 0 = default XLA; 1/2 map to jax.lax.Precision.HIGH/HIGHEST
+        # (replaces Kahan/multipartial PRECISION_LEVEL knobs,
+        # ocl/matrix_multiplication_precise.cl:1-46).
+        "level": 0,
+    },
+    "engine": {
+        "backend": os.environ.get("VELES_TPU_BACKEND", "auto"),
+    },
+    "timings": False,
+    "trace": {"run": False},
+    "web": {"host": "localhost", "port": 8090},
+})
+root.common.protect("dirs")
+
+
+def apply_config_file(path, extra_globals=None):
+    """Execute a per-run config file: plain Python mutating ``root``
+    (ref: veles/__main__.py:436-438)."""
+    g = {"root": root, "Config": Config}
+    if extra_globals:
+        g.update(extra_globals)
+    runpy.run_path(path, init_globals=g)
+
+
+def apply_override(snippet):
+    """Apply a ``-c "root.x.y = z"`` CLI override
+    (ref: veles/__main__.py:474-481)."""
+    exec(snippet, {"root": root, "Config": Config})
+
+
+def load_site_configs():
+    """Merge layered site overrides (ref: veles/config.py:294-308)."""
+    for p in ("/etc/default/veles_tpu",
+              str(Path.home() / ".veles_tpu"),
+              os.path.join(os.getcwd(), "site_config.py")):
+        if os.path.isfile(p):
+            try:
+                runpy.run_path(p, init_globals={"root": root})
+            except Exception:  # site files must never break startup
+                import logging
+                logging.getLogger("config").exception(
+                    "failed to apply site config %s", p)
+
+
+def get(cfg, default=None):
+    """``get(root.x.y, default)`` — unset (Config) values become default."""
+    return default if isinstance(cfg, Config) else cfg

@@ -1,0 +1,164 @@
+"""Logging mixin + event tracing.
+
+Rebuild of the reference's Logger (ref: veles/logger.py:59-332): every
+framework object mixes in :class:`Logger` and gets ``self.info/debug/...``
+bound to a class-named logger, colored console output, and ``event()``
+begin/end/single spans — the tracing backbone.
+
+The reference mirrored all records and events to MongoDB
+(veles/logger.py:292-332); here the span sink is a JSONL file (cheap,
+greppable, no daemon) plus an in-memory ring buffer that the web-status
+service reads.  ``jax.profiler`` traces cover the on-device side.
+"""
+
+import functools
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+_COLORS = {
+    logging.DEBUG: "\033[37m",
+    logging.INFO: "\033[32m",
+    logging.WARNING: "\033[33m",
+    logging.ERROR: "\033[31m",
+    logging.CRITICAL: "\033[1;31m",
+}
+_RESET = "\033[0m"
+
+
+class ColorFormatter(logging.Formatter):
+    """Colored console formatter (ref: veles/logger.py:69-114)."""
+
+    def format(self, record):
+        msg = super(ColorFormatter, self).format(record)
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelno, "")
+            return "%s%s%s" % (color, msg, _RESET)
+        return msg
+
+
+_setup_done = False
+
+
+def setup_logging(level=logging.INFO, logfile=None):
+    """Install the colored root handler once; optional file duplication
+    (ref: veles/logger.py:187)."""
+    global _setup_done
+    if _setup_done:
+        logging.getLogger().setLevel(level)
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(ColorFormatter(
+        "%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S"))
+    logging.getLogger().addHandler(handler)
+    if logfile:
+        fh = logging.FileHandler(logfile)
+        fh.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+        logging.getLogger().addHandler(fh)
+    logging.getLogger().setLevel(level)
+    _setup_done = True
+
+
+class EventSink:
+    """Process-wide span recorder (ref: Logger.event, veles/logger.py:264-289).
+
+    Spans (`begin`/`end`/`single`) go to a bounded in-memory ring (read by
+    the web status dashboard) and, when ``path`` is set, to a JSONL file.
+    """
+
+    def __init__(self, maxlen=65536):
+        self.ring = deque(maxlen=maxlen)
+        self.path = None
+        self._lock = threading.Lock()
+        self._file = None
+
+    def open(self, path):
+        with self._lock:
+            self.path = path
+            if self._file:
+                self._file.close()
+            self._file = open(path, "a")
+
+    def record(self, name, kind, **attrs):
+        ev = {"name": name, "kind": kind, "time": time.time(),
+              "pid": os.getpid(), **attrs}
+        with self._lock:
+            self.ring.append(ev)
+            if self._file:
+                self._file.write(json.dumps(ev, default=str) + "\n")
+                self._file.flush()
+        return ev
+
+
+#: global sink, analogous to the reference's shared Mongo handler.
+events = EventSink()
+
+
+class Logger:
+    """Mixin granting named logging + event spans to any class."""
+
+    def __init__(self, **kwargs):
+        super(Logger, self).__init__()
+
+    @property
+    def logger(self):
+        lg = getattr(self, "_logger_", None)
+        if lg is None:
+            lg = logging.getLogger(type(self).__name__)
+            self._logger_ = lg
+        return lg
+
+    def debug(self, msg, *args):
+        self.logger.debug(msg, *args)
+
+    def info(self, msg, *args):
+        self.logger.info(msg, *args)
+
+    def warning(self, msg, *args):
+        self.logger.warning(msg, *args)
+
+    def error(self, msg, *args):
+        self.logger.error(msg, *args)
+
+    def exception(self, msg="", *args):
+        self.logger.exception(msg, *args)
+
+    def event(self, name, kind="single", **attrs):
+        """Record a tracing span: kind in {"begin", "end", "single"}
+        (ref: veles/logger.py:264-289)."""
+        return events.record(name, kind, cls=type(self).__name__, **attrs)
+
+    def timed_event(self, name):
+        """Context manager emitting begin/end spans around a block."""
+        return _TimedEvent(self, name)
+
+
+class _TimedEvent:
+    def __init__(self, owner, name):
+        self.owner, self.name = owner, name
+
+    def __enter__(self):
+        self.owner.event(self.name, "begin")
+        return self
+
+    def __exit__(self, *exc):
+        self.owner.event(self.name, "end")
+        return False
+
+
+def timed(fn):
+    """Decorator recording a single span with duration for each call."""
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        t0 = time.time()
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            events.record(fn.__qualname__, "single",
+                          duration=time.time() - t0)
+    return wrapper

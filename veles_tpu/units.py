@@ -1,0 +1,231 @@
+"""Unit — the dataflow graph node.
+
+Rebuild of veles/units.py (IUnit/Unit, ref: units.py:59-913).  A model in
+this framework is a :class:`~veles_tpu.workflow.Workflow`: a directed
+graph of Units wired by control links (:meth:`Unit.link_from`) and data
+links (:meth:`Unit.link_attrs`).  Control flow is event-driven through
+*gates*: a unit runs when all of its incoming links have fired, unless its
+``gate_block`` Bool is set; ``gate_skip`` propagates the signal without
+running (ref: units.py:524-552).
+
+TPU-first scheduling decision: the reference walked the graph on a Twisted
+thread pool (units.py:485-505) because each unit dispatched its own GPU
+kernels and Python-level overlap mattered.  Here the heavy compute of a
+workflow segment is fused into **one jitted XLA program**
+(:mod:`veles_tpu.accelerated_units`), XLA dispatch is already async, and
+the host-side walk is microseconds — so the scheduler is a deterministic
+worklist run by the Workflow (no thread pool, no per-unit locks in the hot
+path, no re-entrancy hazards).  Service units that genuinely need threads
+(plotting, web status) manage their own.
+"""
+
+import time
+
+from veles_tpu.mutable import Bool, LinkableAttribute
+from veles_tpu.unit_registry import RegisteredDistributable
+
+
+class MissingDemand(AttributeError):
+    """A demanded attribute is absent at initialize() time — the workflow
+    re-queues the unit and tries again after its suppliers initialize
+    (ref: veles/units.py:682, workflow.py:319-341)."""
+
+    def __init__(self, unit, attrs):
+        super(MissingDemand, self).__init__(
+            "%s demands unsatisfied attribute(s): %s" %
+            (unit, ", ".join(sorted(attrs))))
+        self.unit = unit
+        self.attrs = attrs
+
+
+class Unit(RegisteredDistributable):
+    """A graph node with gates, links and a lifecycle
+    (ref: veles/units.py:108).
+
+    Lifecycle: ``__init__`` (wire the graph) → ``initialize`` (allocate,
+    validate demands) → ``run`` (once per gate opening) → ``stop``.
+    """
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, name=None, view_group=None, **kwargs):
+        super(Unit, self).__init__()
+        self._name = name
+        self.view_group = view_group or getattr(self, "VIEW_GROUP", "PLUMBING")
+        self.links_from = {}   # src Unit -> fired flag (bool)
+        self.links_to = {}     # dst Unit -> True (ordered set)
+        self.gate_block = Bool(False, "gate_block")
+        self.gate_skip = Bool(False, "gate_skip")
+        self._demanded = set()
+        self._is_initialized = False
+        self.timers = {"run": 0.0, "runs": 0}
+        self._workflow = None
+        if workflow is not None:
+            self.workflow = workflow
+
+    def init_unpickled(self):
+        super(Unit, self).init_unpickled()
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def name(self):
+        return self._name or type(self).__name__
+
+    @name.setter
+    def name(self, value):
+        self._name = value
+
+    @property
+    def id(self):
+        return type(self).__id__
+
+    def __repr__(self):
+        return "<%s \"%s\">" % (type(self).__name__, self.name)
+
+    # -- workflow membership ----------------------------------------------
+
+    @property
+    def workflow(self):
+        return self._workflow
+
+    @workflow.setter
+    def workflow(self, wf):
+        if self._workflow is not None:
+            self._workflow.del_ref(self)
+        self._workflow = wf
+        wf.add_ref(self)
+
+    @property
+    def is_standalone(self):
+        return self._workflow.is_standalone if self._workflow else True
+
+    @property
+    def is_master(self):
+        return self._workflow.is_master if self._workflow else False
+
+    @property
+    def is_slave(self):
+        return self._workflow.is_slave if self._workflow else False
+
+    # -- graph wiring (ref: units.py:554-680) -------------------------------
+
+    def link_from(self, *units):
+        """Add control edges ``unit → self``; self runs after all fire."""
+        for src in units:
+            self.links_from[src] = False
+            src.links_to[self] = True
+        return self
+
+    def unlink_from(self, *units):
+        for src in units:
+            self.links_from.pop(src, None)
+            src.links_to.pop(self, None)
+        return self
+
+    def unlink_all(self):
+        self.unlink_before()
+        self.unlink_after()
+
+    def unlink_before(self):
+        for src in list(self.links_from):
+            self.unlink_from(src)
+
+    def unlink_after(self):
+        for dst in list(self.links_to):
+            dst.unlink_from(self)
+
+    def link_attrs(self, other, *args, two_way=False):
+        """Data links: each arg is ``"attr"`` (same name both sides) or
+        ``("own_name", "other_name")`` (ref: veles/units.py:638)."""
+        for arg in args:
+            if isinstance(arg, str):
+                own, theirs = arg, arg
+            else:
+                own, theirs = arg
+            LinkableAttribute(self, own, (other, theirs), two_way=two_way)
+        return self
+
+    def demand(self, *attrs):
+        """Declare attributes that must be non-None before initialize
+        (ref: veles/units.py:682)."""
+        self._demanded.update(attrs)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def verify_demands(self):
+        missing = {a for a in self._demanded
+                   if getattr(self, a, None) is None}
+        if missing:
+            raise MissingDemand(self, missing)
+
+    def initialize(self, **kwargs):
+        """Validate demands and allocate.  Subclasses call super() first."""
+        self.verify_demands()
+        self._is_initialized = True
+
+    @property
+    def is_initialized(self):
+        return self._is_initialized
+
+    def run(self):
+        """One firing of this unit.  Subclasses override."""
+        pass
+
+    def stop(self):
+        """Called on workflow shutdown; release external resources."""
+        pass
+
+    # -- gate machinery (ref: units.py:524-552, 782-803) --------------------
+
+    def open_gate(self, src):
+        """Mark the ``src → self`` edge fired; True when all inputs fired
+        (flags then reset for the next wave)."""
+        if src is not None and src in self.links_from:
+            self.links_from[src] = True
+        if all(self.links_from.values()) or not self.links_from:
+            for k in self.links_from:
+                self.links_from[k] = False
+            return True
+        return False
+
+    def _check_gate_and_run(self, src):
+        """Scheduler entry: signal arriving over the ``src → self`` edge."""
+        if self.gate_block:
+            return
+        if not self.open_gate(src):
+            return
+        if not self.gate_skip:
+            if self._workflow is not None and self._workflow.stopped:
+                return
+            self._run_wrapped()
+        self.run_dependent()
+
+    def _run_wrapped(self):
+        """run() with timing + initialization check
+        (ref: units.py:805-845)."""
+        if not self._is_initialized:
+            raise RuntimeError("%s.run() before initialize()" % self)
+        t0 = time.time()
+        try:
+            self.run()
+        finally:
+            dt = time.time() - t0
+            self.timers["run"] += dt
+            self.timers["runs"] += 1
+            from veles_tpu.config import root
+            if root.common.get("timings"):
+                self.debug("%s ran in %.4fs", self.name, dt)
+
+    def run_dependent(self):
+        """Propagate the control signal to successors
+        (ref: units.py:485-505) — enqueues on the workflow scheduler."""
+        for dst in self.links_to:
+            self._workflow.schedule(dst, self)
+
+    # -- export metadata ----------------------------------------------------
+
+    def export_config(self):
+        """Picklable kwargs snapshot for package_export (overridden by
+        units with meaningful config)."""
+        return {}

@@ -1,0 +1,253 @@
+"""Workflow — the unit container and scheduler.
+
+Rebuild of veles/workflow.py:87-1051.  A Workflow owns a set of Units plus
+``start_point``/``end_point``, initializes them in dependency order (with
+re-queue on unsatisfied demands), and runs the graph to completion with a
+deterministic worklist scheduler (see the design note in
+:mod:`veles_tpu.units`).
+
+A Workflow is itself a Unit, so workflows nest (ref: workflow.py:87).  The
+top-level workflow's parent is the Launcher, which supplies the runtime
+mode (standalone / coordinator / worker) and the device.
+"""
+
+import hashlib
+import inspect
+import time
+from collections import deque
+
+from veles_tpu.mutable import Bool
+from veles_tpu.plumbing import StartPoint, EndPoint
+from veles_tpu.result_provider import IResultProvider
+from veles_tpu.units import MissingDemand, Unit
+
+
+class NoMoreJobs(Exception):
+    """Raised by the data feed when the job queue is exhausted
+    (ref: veles/workflow.py:500-502)."""
+
+
+class Workflow(Unit):
+    """Directed graph of units with start/end points
+    (ref: veles/workflow.py:87)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        self.units = []          # before super() — add_ref may fire early
+        self._sched_queue_ = deque()
+        super(Workflow, self).__init__(workflow, name=name, **kwargs)
+        self.stopped = Bool(False, "stopped")
+        self.start_point = StartPoint(self)
+        self.end_point = EndPoint(self)
+        self._run_time = 0.0
+
+    def init_unpickled(self):
+        super(Workflow, self).init_unpickled()
+        self._sched_queue_ = deque()
+        # volatile (often a launcher closure) — never snapshotted
+        self.run_is_finished_callback_ = None
+
+    # -- membership ---------------------------------------------------------
+
+    def add_ref(self, unit):
+        if unit is not self and unit not in self.units:
+            self.units.append(unit)
+
+    def del_ref(self, unit):
+        if unit in self.units:
+            self.units.remove(unit)
+
+    def __iter__(self):
+        return iter(self.units)
+
+    def __len__(self):
+        return len(self.units)
+
+    def __getitem__(self, key):
+        """Units by name or index (ref: workflow.py:~250)."""
+        if isinstance(key, str):
+            for u in self.units:
+                if u.name == key:
+                    return u
+            raise KeyError(key)
+        return self.units[key]
+
+    # -- mode flags (delegated to the launcher) ----------------------------
+
+    @property
+    def launcher(self):
+        w = self._workflow
+        while isinstance(w, Workflow):
+            w = w._workflow
+        return w
+
+    @property
+    def is_standalone(self):
+        l = self.launcher
+        return l.mode == "standalone" if l is not None else True
+
+    @property
+    def is_master(self):
+        l = self.launcher
+        return l.mode == "master" if l is not None else False
+
+    @property
+    def is_slave(self):
+        l = self.launcher
+        return l.mode == "slave" if l is not None else False
+
+    # -- initialization (ref: workflow.py:303-341) --------------------------
+
+    def initialize(self, **kwargs):
+        """Initialize all units in dependency order: a unit raising
+        :class:`MissingDemand` is re-queued until its supplier has
+        initialized; no-progress passes raise."""
+        self.verify_demands()
+        pending = list(self.units)
+        while pending:
+            requeue, last_err = [], None
+            for u in pending:
+                try:
+                    u.initialize(**kwargs)
+                except MissingDemand as e:
+                    requeue.append(u)
+                    last_err = e
+            if len(requeue) == len(pending):
+                raise last_err
+            pending = requeue
+        self._is_initialized = True
+
+    # -- scheduling ---------------------------------------------------------
+
+    def schedule(self, unit, src):
+        self._sched_queue_.append((unit, src))
+
+    def run(self):
+        """Run the graph to completion (one full wave from start_point
+        until end_point fires or the queue drains)
+        (ref: workflow.py:351-377)."""
+        self.stopped.set(False)
+        self._sched_queue_.clear()
+        t0 = time.time()
+        self.event("workflow run", "begin")
+        try:
+            self.schedule(self.start_point, None)
+            while self._sched_queue_ and not self.stopped:
+                unit, src = self._sched_queue_.popleft()
+                unit._check_gate_and_run(src)
+        finally:
+            self._run_time += time.time() - t0
+            self.event("workflow run", "end")
+        if self.run_is_finished_callback_ is not None:
+            self.run_is_finished_callback_()
+
+    def on_workflow_finished(self):
+        self.stopped.set(True)
+
+    def stop(self):
+        self.stopped.set(True)
+        for u in self.units:
+            u.stop()
+
+    # -- master–worker aggregation (IDistributable over all units,
+    #    ref: workflow.py:478-558) — used by the elastic DCN layer ---------
+
+    def _unit_key(self, u):
+        # unique payload key: units may share a default name, and
+        # construction order is deterministic on both ends
+        return "%s#%d" % (u.name, self.units.index(u))
+
+    def generate_data_for_slave(self, slave=None):
+        return {self._unit_key(u): u.generate_data_for_slave(slave)
+                for u in self.units if u.negotiates_on_connect}
+
+    def apply_data_from_master(self, data):
+        for u in self.units:
+            k = self._unit_key(u)
+            if u.negotiates_on_connect and k in data:
+                u.apply_data_from_master(data[k])
+
+    def generate_data_for_master(self):
+        return {self._unit_key(u): u.generate_data_for_master()
+                for u in self.units if u.negotiates_on_connect}
+
+    def apply_data_from_slave(self, data, slave=None):
+        for u in self.units:
+            k = self._unit_key(u)
+            if u.negotiates_on_connect and k in data:
+                u.apply_data_from_slave(data[k], slave)
+
+    def drop_slave(self, slave=None):
+        for u in self.units:
+            if u.negotiates_on_connect:
+                u.drop_slave(slave)
+
+    def do_job(self, data, update, callback):
+        """Worker-side: apply job payload, run the local graph, send the
+        update back (ref: workflow.py:558)."""
+        self.apply_data_from_master(data)
+        if update is not None:
+            self.apply_data_from_master(update)
+        self.run()
+        callback(self.generate_data_for_master())
+
+    # -- results (ref: workflow.py:827-849) ---------------------------------
+
+    def gather_results(self):
+        metrics = {}
+        for u in self.units:
+            if isinstance(u, IResultProvider):
+                metrics.update(u.get_metric_values() or {})
+        return metrics
+
+    # -- introspection ------------------------------------------------------
+
+    def checksum(self):
+        """Stable digest of the workflow's defining source — coordinator /
+        worker handshakes compare it (ref: workflow.py:852)."""
+        from veles_tpu.mutable import unshadow
+        cls = unshadow(type(self))
+        try:
+            src = inspect.getsource(cls)
+        except (OSError, TypeError):
+            src = cls.__qualname__
+        return hashlib.sha256(src.encode()).hexdigest()
+
+    _GROUP_COLORS = {
+        "PLUMBING": "lightgrey", "LOADER": "lightblue",
+        "WORKER": "palegreen", "TRAINER": "gold",
+        "EVALUATOR": "plum", "SERVICE": "white",
+    }
+
+    def generate_graph(self, filename=None):
+        """Graphviz DOT export of the unit graph
+        (ref: workflow.py:628)."""
+        lines = ["digraph %s {" % type(self).__name__.replace(" ", "_"),
+                 "  rankdir=TB;"]
+        ids = {u: "u%d" % i for i, u in enumerate(self.units)}
+        for u, nid in ids.items():
+            color = self._GROUP_COLORS.get(u.view_group, "white")
+            lines.append('  %s [label="%s", style=filled, fillcolor=%s];'
+                         % (nid, u.name, color))
+        for u, nid in ids.items():
+            for dst in u.links_to:
+                if dst in ids:
+                    lines.append("  %s -> %s;" % (nid, ids[dst]))
+        lines.append("}")
+        dot = "\n".join(lines)
+        if filename:
+            with open(filename, "w") as f:
+                f.write(dot)
+        return dot
+
+    def print_stats(self, top=5):
+        """Top-N per-unit run-time table (ref: workflow.py:788-825)."""
+        stats = sorted(((u.timers["run"], u.timers["runs"], u.name)
+                        for u in self.units), reverse=True)[:top]
+        total = self._run_time or sum(s[0] for s in stats) or 1e-9
+        self.info("---- unit run-time stats (total %.2fs) ----", total)
+        for t, n, name in stats:
+            self.info("  %-30s %8.3fs  %6d runs  %5.1f%%",
+                      name, t, n, 100.0 * t / total)
+        return stats
