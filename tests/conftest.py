@@ -8,7 +8,9 @@ master+slave-in-one-process tests, veles/tests/test_network.py:52-149).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard-set, not setdefault: the ambient environment may select a TPU
+# platform (e.g. JAX_PLATFORMS=axon) and tests must stay on virtual CPUs
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,77 @@
+"""Dtype / precision policy.
+
+Replaces the reference's dtype macro layer (ref: ocl/defines.cl:1-69,
+veles/opencl_types.py:1-78) and the PRECISION_LEVEL Kahan/multipartial
+summation knobs (ref: ocl/matrix_multiplication_precise.cl:1-46,
+veles/config.py:245-248).  On TPU the equivalents are:
+
+- a *compute dtype* for matmul/conv operands (bfloat16 feeds the MXU at
+  full rate),
+- an *accumulation dtype* (float32 — the MXU always accumulates in f32;
+  exposing it as policy keeps the reference's "more precise summation"
+  capability),
+- a *parameter dtype* for master weights,
+- a ``jax.lax.Precision`` level: 0 → DEFAULT, 1 → HIGH, 2 → HIGHEST,
+  mirroring the reference's three GEMM precision levels.
+
+All knobs live in ``root.common.precision`` so per-run config files tune
+them exactly like the reference's ``root.common.precision_type``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.config import root
+
+#: name -> dtype map covering everything the reference's dtype_map did
+#: (veles/opencl_types.py:24-42) plus TPU-native types.
+dtype_map = {
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "uint8": jnp.uint8,
+    "uint16": jnp.uint16,
+    "uint32": jnp.uint32,
+    "uint64": jnp.uint64,
+}
+
+_PRECISION_LEVELS = {
+    0: jax.lax.Precision.DEFAULT,
+    1: jax.lax.Precision.HIGH,
+    2: jax.lax.Precision.HIGHEST,
+}
+
+
+def compute_dtype():
+    """Operand dtype for MXU ops (matmul/conv)."""
+    return dtype_map[root.common.precision.get("compute_dtype", "bfloat16")]
+
+
+def accum_dtype():
+    """Accumulation / reduction dtype."""
+    return dtype_map[root.common.precision.get("accum_dtype", "float32")]
+
+
+def param_dtype():
+    """Master-copy parameter dtype."""
+    return dtype_map[root.common.precision.get("param_dtype", "float32")]
+
+
+def matmul_precision():
+    """``jax.lax.Precision`` from ``root.common.precision.level``
+    (0/1/2 — the reference's PRECISION_LEVEL ladder)."""
+    return _PRECISION_LEVELS[int(root.common.precision.get("level", 0))]
+
+
+def as_numpy_dtype(dt):
+    return numpy.dtype(dt)
+
+
+def itemsize(dt):
+    return numpy.dtype(dt).itemsize

@@ -1,0 +1,18 @@
+"""Parallelism layer — meshes, shardings, collectives.
+
+This package replaces the reference's entire L5 distributed layer
+(veles/server.py, client.py, txzmq/ — the ZeroMQ master–slave star) with
+the TPU-native model: SPMD ``pjit`` over a :class:`jax.sharding.Mesh`,
+gradient sync as ``lax.psum`` over ICI, cross-slice traffic over DCN, and
+a thin elastic coordinator for job-queue workloads (ensemble/genetics).
+
+Modules:
+
+- :mod:`veles_tpu.parallel.mesh`      — mesh construction + axis conventions
+- :mod:`veles_tpu.parallel.sharding`  — NamedSharding specs for dp/tp/pp/sp/ep
+- :mod:`veles_tpu.parallel.collectives` — psum/all_gather/ppermute wrappers
+- :mod:`veles_tpu.parallel.ring`      — ring attention (sequence/context parallel)
+- :mod:`veles_tpu.parallel.coordinator` — elastic job-queue service (asyncio)
+"""
+
+from veles_tpu.parallel.mesh import MeshConfig, build_mesh  # noqa: F401

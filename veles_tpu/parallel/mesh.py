@@ -1,0 +1,74 @@
+"""Device mesh construction and axis conventions.
+
+The framework's canonical mesh axes (SURVEY.md §2.3 "TPU mapping"):
+
+- ``dp``  — data parallel (gradient psum; replaces the reference's whole
+  master–slave weight-delta exchange, veles/server.py + client.py),
+- ``fsdp`` — data parallel with sharded parameters (reduce_scatter /
+  all_gather riding ICI),
+- ``tp``  — tensor parallel (activation/weight sharding inside a layer),
+- ``pp``  — pipeline parallel (stage dimension),
+- ``sp``  — sequence/context parallel (ring attention axis),
+- ``ep``  — expert parallel.
+
+The reference had only elastic DP over ZeroMQ; here every strategy is a
+mesh axis and XLA inserts the collectives.
+"""
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import numpy
+from jax.sharding import Mesh
+
+#: canonical axis order — outer (slowest, DCN-friendly) to inner
+#: (fastest, ICI-friendly).  dp outermost so cross-slice traffic is the
+#: infrequent gradient reduction; tp/sp innermost so their chatty
+#: collectives ride ICI.
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclass
+class MeshConfig:
+    """Declarative mesh spec: axis name -> size; -1 = absorb remaining
+    devices."""
+
+    axes: dict = field(default_factory=lambda: {"dp": -1})
+
+    def resolve(self, n_devices):
+        sizes = dict(self.axes)
+        fixed = math.prod(s for s in sizes.values() if s > 0)
+        wild = [a for a, s in sizes.items() if s <= 0]
+        if len(wild) > 1:
+            raise ValueError("at most one -1 axis: %s" % wild)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    "%d devices not divisible by fixed axes %s"
+                    % (n_devices, sizes))
+            sizes[wild[0]] = n_devices // fixed
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError("mesh %s != %d devices" % (sizes, n_devices))
+        return {a: sizes[a] for a in AXIS_ORDER if a in sizes} | {
+            a: s for a, s in sizes.items() if a not in AXIS_ORDER}
+
+
+def build_mesh(axes, devices=None):
+    """Build a :class:`jax.sharding.Mesh` from ``{axis: size}``.
+
+    Axes are laid out in :data:`AXIS_ORDER` so inner (chatty) axes map to
+    physically adjacent devices.  ``-1`` absorbs the remaining devices.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = MeshConfig(dict(axes)).resolve(len(devices))
+    names = tuple(sizes)
+    shape = tuple(sizes[a] for a in names)
+    dev_array = numpy.array(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def single_device_mesh(axis="dp", device=None):
+    """A 1-element mesh so the same pjit code path runs on one chip."""
+    dev = device or jax.devices()[0]
+    return Mesh(numpy.array([dev]), (axis,))

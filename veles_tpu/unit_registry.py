@@ -28,10 +28,12 @@ class UnitRegistry(type):
 
     def __init__(cls, name, bases, namespace):
         super(UnitRegistry, cls).__init__(name, bases, namespace)
-        if namespace.get("hide_from_registry", False):
-            return
+        # every class gets a stable id (tooling reads .id on any unit);
+        # hidden classes just stay out of the catalog
         cls.__id__ = namespace.get(
             "__id__", str(uuid.uuid5(_NAMESPACE, cls.__module__ + "." + name)))
+        if namespace.get("hide_from_registry", False):
+            return
         UnitRegistry.units[name] = cls
         UnitRegistry.by_id[cls.__id__] = cls
 

@@ -153,28 +153,29 @@ class Workflow(Unit):
     # -- master–worker aggregation (IDistributable over all units,
     #    ref: workflow.py:478-558) — used by the elastic DCN layer ---------
 
-    def _unit_key(self, u):
-        # unique payload key: units may share a default name, and
+    def _unit_keys(self):
+        # unique payload keys: units may share a default name, and
         # construction order is deterministic on both ends
-        return "%s#%d" % (u.name, self.units.index(u))
+        return {u: "%s#%d" % (u.name, i)
+                for i, u in enumerate(self.units)}
 
     def generate_data_for_slave(self, slave=None):
-        return {self._unit_key(u): u.generate_data_for_slave(slave)
-                for u in self.units if u.negotiates_on_connect}
+        return {k: u.generate_data_for_slave(slave)
+                for u, k in self._unit_keys().items()
+                if u.negotiates_on_connect}
 
     def apply_data_from_master(self, data):
-        for u in self.units:
-            k = self._unit_key(u)
+        for u, k in self._unit_keys().items():
             if u.negotiates_on_connect and k in data:
                 u.apply_data_from_master(data[k])
 
     def generate_data_for_master(self):
-        return {self._unit_key(u): u.generate_data_for_master()
-                for u in self.units if u.negotiates_on_connect}
+        return {k: u.generate_data_for_master()
+                for u, k in self._unit_keys().items()
+                if u.negotiates_on_connect}
 
     def apply_data_from_slave(self, data, slave=None):
-        for u in self.units:
-            k = self._unit_key(u)
+        for u, k in self._unit_keys().items():
             if u.negotiates_on_connect and k in data:
                 u.apply_data_from_slave(data[k], slave)
 
