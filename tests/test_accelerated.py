@@ -1,0 +1,212 @@
+"""Jit compilation layer tests (SURVEY.md §7 step 3).
+
+Covers: standalone jitted units, fused-segment compilation of linear
+chains, state donation (in-place HBM update), gate_skip fallback, eager
+mode, and the DeviceBenchmark probe — the TPU equivalents of the
+reference's accelerated-unit suite (veles/tests/test_accelerated_unit.py).
+"""
+
+import numpy
+import pytest
+
+from veles_tpu.accelerated_units import (
+    AcceleratedUnit, AcceleratedWorkflow, DeviceBenchmark, FusedSegment)
+from veles_tpu.backends import Device
+from veles_tpu.memory import Array
+
+
+class Scale(AcceleratedUnit):
+    READS = ("input",)
+    WRITES = ("output",)
+
+    def __init__(self, workflow, factor=2.0, **kwargs):
+        super(Scale, self).__init__(workflow, **kwargs)
+        self.factor = factor
+        self.input = None
+        self.output = Array()
+        self.demand("input")
+
+    def initialize(self, device=None, **kwargs):
+        if self.input is None or not bool(self.input):
+            from veles_tpu.units import MissingDemand
+            raise MissingDemand(self, {"input"})
+        self.output.reset(numpy.zeros_like(self.input.mem))
+        super(Scale, self).initialize(device=device, **kwargs)
+
+    def step(self, input):
+        return {"output": input * self.factor}
+
+
+class Accumulate(AcceleratedUnit):
+    """Stateful: total += input.sum() — exercises donation."""
+
+    READS = ("input", "total")
+    WRITES = ("total",)
+
+    def __init__(self, workflow, **kwargs):
+        super(Accumulate, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.total = Array(numpy.zeros((), numpy.float32))
+
+    def step(self, input, total):
+        return {"total": total + input.sum()}
+
+
+def _wire(wf, *units):
+    prev = wf.start_point
+    for u in units:
+        u.link_from(prev)
+        prev = u
+    wf.end_point.link_from(prev)
+
+
+@pytest.fixture
+def device():
+    return Device(backend="numpy")
+
+
+def make_chain(device, n=3):
+    wf = AcceleratedWorkflow(None, name="chain")
+    units = []
+    src = Array(numpy.arange(8, dtype=numpy.float32))
+    for i in range(n):
+        u = Scale(wf, factor=2.0, name="scale%d" % i)
+        if i == 0:
+            u.input = src
+        else:
+            u.link_attrs(units[-1], ("input", "output"))
+        units.append(u)
+    _wire(wf, *units)
+    wf.initialize(device=device)
+    return wf, units, src
+
+
+class TestStandalone:
+    def test_single_unit_jit(self, device):
+        wf = AcceleratedWorkflow(None, name="solo")
+        u = Scale(wf, factor=3.0)
+        u.input = Array(numpy.ones(4, numpy.float32))
+        v = Scale(wf, factor=5.0)   # diamond-ish: two succs of start
+        v.input = Array(numpy.ones(4, numpy.float32))
+        u.link_from(wf.start_point)
+        v.link_from(wf.start_point)
+        wf.end_point.link_from(u, v)
+        wf.initialize(device=device)
+        assert u._segment_ is None and v._segment_ is None
+        wf.run()
+        assert numpy.allclose(u.output[...], 3)
+        assert numpy.allclose(v.output[...], 5)
+
+    def test_eager_mode(self, device, monkeypatch):
+        from veles_tpu.config import root
+        monkeypatch.setitem(vars(root.common.engine), "eager", True)
+        wf = AcceleratedWorkflow(None, name="eager")
+        u = Scale(wf, factor=4.0)
+        u.input = Array(numpy.ones(2, numpy.float32))
+        _wire(wf, u)
+        wf.initialize(device=device)
+        wf.run()
+        assert numpy.allclose(u.output[...], 4)
+
+
+class TestFusion:
+    def test_chain_fuses_into_one_segment(self, device):
+        wf, units, _ = make_chain(device, n=3)
+        assert len(wf._segments_) == 1
+        assert wf._segments_[0].units == units
+        assert all(u._segment_ is wf._segments_[0] for u in units)
+
+    def test_fused_result(self, device):
+        wf, units, src = make_chain(device, n=3)
+        wf.run()
+        assert numpy.allclose(
+            units[-1].output[...],
+            numpy.arange(8, dtype=numpy.float32) * 8)
+
+    def test_fused_repeat_iterations(self, device):
+        wf, units, _ = make_chain(device, n=2)
+        wf.run()
+        first = units[-1].output[...].copy()
+        wf.run()
+        assert numpy.allclose(units[-1].output[...], first)
+
+    def test_state_donation_accumulates(self, device):
+        wf = AcceleratedWorkflow(None, name="acc")
+        s = Scale(wf, factor=1.0)
+        s.input = Array(numpy.ones(4, numpy.float32))
+        a = Accumulate(wf)
+        a.link_attrs(s, ("input", "output"))
+        _wire(wf, s, a)
+        wf.initialize(device=device)
+        assert len(wf._segments_) == 1
+        for i in range(3):
+            wf.run()
+        assert numpy.sum(a.total[...]) == pytest.approx(12.0)
+
+    def test_gate_skip_falls_back(self, device):
+        wf, units, _ = make_chain(device, n=3)
+        units[1].gate_skip.set(True)
+        wf.run()  # skipped unit leaves its output zeros
+        assert numpy.allclose(units[1].output[...], 0)
+        # regression: the downstream member must still run standalone
+        # (scale2 of zeros is zeros, so check scale0 ran and scale2's
+        # output reflects scale1's (zero) output, not stale garbage)
+        assert numpy.allclose(
+            units[0].output[...], numpy.arange(8, dtype=numpy.float32) * 2)
+        assert numpy.allclose(units[2].output[...], 0)
+        # and a later clean iteration returns to the fused path
+        units[1].gate_skip.set(False)
+        wf.run()
+        assert numpy.allclose(
+            units[2].output[...], numpy.arange(8, dtype=numpy.float32) * 8)
+
+    def test_gate_block_recovery(self, device):
+        # regression: a blocked member cuts propagation; the next clean
+        # iteration must not treat stale pending entries as satisfied
+        wf, units, _ = make_chain(device, n=3)
+        wf.run()
+        units[1].gate_block.set(True)
+        wf.run()
+        units[1].gate_block.set(False)
+        wf.run()
+        assert numpy.allclose(
+            units[2].output[...], numpy.arange(8, dtype=numpy.float32) * 8)
+
+    def test_plan_classification(self, device):
+        wf = AcceleratedWorkflow(None, name="plan")
+        s = Scale(wf, factor=1.0)
+        s.input = Array(numpy.ones(4, numpy.float32))
+        a = Accumulate(wf)
+        a.link_attrs(s, ("input", "output"))
+        _wire(wf, s, a)
+        wf.initialize(device=device)
+        seg = wf._segments_[0]
+        unit_io, donated, held, outputs = seg.plan()
+        # total is donated (read+written); s.input is held; both
+        # s.output (=a.input) and a.total appear in outputs
+        assert len(donated) == 1 and len(held) == 1
+        assert len(outputs) == 2
+
+    def test_no_fuse_flag(self, device, monkeypatch):
+        from veles_tpu.config import root
+        monkeypatch.setitem(vars(root.common.engine), "fuse", False)
+        wf, units, _ = make_chain(device, n=3)
+        assert wf._segments_ == []
+        wf.run()
+        assert numpy.allclose(
+            units[-1].output[...],
+            numpy.arange(8, dtype=numpy.float32) * 8)
+
+
+class TestBenchmark:
+    def test_device_benchmark(self, device, tmp_path, monkeypatch):
+        from veles_tpu.config import root
+        monkeypatch.setitem(vars(root.common.dirs), "cache", str(tmp_path))
+        wf = AcceleratedWorkflow(None, name="bench")
+        b = DeviceBenchmark(wf)
+        b.BENCHMARK_N = 32
+        device.BENCHMARK_N = 32
+        _wire(wf, b)
+        wf.initialize(device=device)
+        assert b.computing_power > 0
+        assert wf.computing_power == b.computing_power

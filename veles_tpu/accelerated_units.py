@@ -1,0 +1,356 @@
+"""AcceleratedUnit — the jit compilation layer.
+
+Rebuild of veles/accelerated_units.py (130-866).  The reference bound
+per-backend methods (``ocl_run``/``cuda_run``/``numpy_run``), assembled
+kernel source with Jinja2 and cached compiled binaries per device.  The
+TPU-native design replaces all of that with *tracing*:
+
+- An accelerated unit declares the attributes it READS and WRITES and
+  implements one **pure** :meth:`AcceleratedUnit.step` over jax values.
+  There is no per-backend code: the same traced function runs on TPU and
+  on (virtual multi-device) CPU, which is what made the reference keep
+  three kernel dialects in sync.
+- ``Array`` objects are the SSA registers between units: ``link_attrs``
+  aliases an attribute to the upstream unit's Array, so the segment
+  compiler can key the dataflow by Array identity.
+- Consecutive accelerated units **fuse into one jitted XLA program**
+  (:class:`FusedSegment`) — the north-star design decision (SURVEY.md §7):
+  one device dispatch per workflow segment per minibatch instead of the
+  reference's per-unit kernel launches.  Read-write (state) Arrays are
+  donated so parameters update in place in HBM.
+- The binary cache (ref: accelerated_units.py:605-673 tar.gz of PTX) is
+  XLA's persistent compilation cache, enabled once per process.
+
+Standalone (unfused) accelerated units still jit their own step; eager
+mode (``root.common.engine.eager = True``) skips jit entirely for
+debugging, like the reference's numpy fallback path.
+"""
+
+import jax
+
+from veles_tpu.config import root
+from veles_tpu.memory import Array
+from veles_tpu.units import Unit
+from veles_tpu.workflow import Workflow
+
+_compile_cache_enabled = [False]
+
+
+def enable_persistent_compile_cache():
+    """XLA's on-disk compile cache — replaces the reference's tar.gz
+    kernel binary cache (ref: veles/accelerated_units.py:605-673)."""
+    if _compile_cache_enabled[0]:
+        return
+    cache_dir = root.common.dirs.get("cache")
+    if cache_dir:
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            _compile_cache_enabled[0] = True
+        except Exception:
+            pass
+
+
+class AcceleratedUnit(Unit):
+    """A unit whose run() is a pure traced function over its declared
+    attributes (ref: veles/accelerated_units.py:130).
+
+    Subclasses declare::
+
+        READS  = ("input", "weights", "bias")   # consumed attrs (Arrays)
+        WRITES = ("output", "weights", "bias")  # produced attrs
+
+    and implement :meth:`step`.  An attr in both READS and WRITES is
+    *state* — its buffer is donated to the compiled program so updates
+    happen in place in HBM.
+    """
+
+    hide_from_registry = True
+
+    READS = ()
+    WRITES = ()
+    #: units that override run() or mutate host state per-iteration set
+    #: this False so fuse() leaves them standalone
+    FUSABLE = True
+
+    def __init__(self, workflow, **kwargs):
+        super(AcceleratedUnit, self).__init__(workflow, **kwargs)
+        self.device = None
+
+    def init_unpickled(self):
+        super(AcceleratedUnit, self).init_unpickled()
+        self._jit_step_ = None
+        self._segment_ = None
+
+    @property
+    def reads(self):
+        return self.READS
+
+    @property
+    def writes(self):
+        return self.WRITES
+
+    # -- subclass contract ---------------------------------------------------
+
+    def step(self, **tensors):
+        """Pure function: ``{read attr: jax value} -> {write attr: jax
+        value}``.  Traced under jit; no side effects, no Python branches
+        on tensor values."""
+        raise NotImplementedError(
+            "%s must implement step()" % type(self).__name__)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def initialize(self, device=None, **kwargs):
+        super(AcceleratedUnit, self).initialize(**kwargs)
+        if device is not None:
+            self.device = device
+        enable_persistent_compile_cache()
+        for attr in set(self.reads) | set(self.writes):
+            arr = getattr(self, attr, None)
+            if isinstance(arr, Array):
+                arr.initialize(self.device)
+
+    def run(self):
+        if self._segment_ is not None:
+            self._segment_.run_for(self)
+        else:
+            self._run_standalone()
+
+    # -- standalone execution ------------------------------------------------
+
+    def _gather(self):
+        tensors = {}
+        for attr in self.reads:
+            val = getattr(self, attr)
+            tensors[attr] = val.devmem if isinstance(val, Array) else val
+        return tensors
+
+    def _scatter(self, outputs):
+        for attr, val in outputs.items():
+            target = getattr(self, attr, None)
+            if isinstance(target, Array):
+                target.devmem = val
+            else:
+                setattr(self, attr, val)
+
+    def _run_standalone(self):
+        if root.common.engine.get("eager"):
+            self._scatter(self.step(**self._gather()))
+            return
+        if self._jit_step_ is None:
+            def stepper(donated, held):
+                return self.step(**donated, **held)
+
+            self._jit_step_ = jax.jit(stepper, donate_argnums=(0,))
+        tensors = self._gather()
+        wset = set(self.writes)
+        donated = {a: t for a, t in tensors.items() if a in wset}
+        held = {a: t for a, t in tensors.items() if a not in wset}
+        self._scatter(self._jit_step_(donated, held))
+
+
+class FusedSegment:
+    """A maximal chain of accelerated units compiled into ONE jitted XLA
+    program (the TPU answer to per-unit kernel dispatch, SURVEY.md §7).
+
+    The scheduler still walks every unit's gates; the first member to run
+    in an iteration executes the whole fused program, and the remaining
+    members' run() calls are satisfied from it.
+    """
+
+    def __init__(self, units):
+        self.units = list(units)
+        self._pending = set()
+        self._fallback = False
+        self._jit = None
+        # stable Array registry: id -> (index, array)
+        self._arrays = []
+        self._plan = None
+
+    # -- planning ------------------------------------------------------------
+
+    def _array_key(self, arr, registry):
+        key = registry.get(id(arr))
+        if key is None:
+            key = len(self._arrays)
+            registry[id(arr)] = key
+            self._arrays.append(arr)
+        return key
+
+    def plan(self):
+        """Resolve each unit's attrs to Array slots; classify slots into
+        donated (read+written) / held (read-only) inputs and outputs."""
+        registry = {}
+        unit_io = []
+        written = set()
+        read_before_write = set()
+        all_written = set()
+        for u in self.units:
+            ins, outs = {}, {}
+            for attr in u.reads:
+                arr = getattr(u, attr)
+                if not isinstance(arr, Array):
+                    raise TypeError("%s.%s is not an Array" % (u, attr))
+                k = self._array_key(arr, registry)
+                ins[attr] = k
+                if k not in written:
+                    read_before_write.add(k)
+            for attr in u.writes:
+                arr = getattr(u, attr)
+                if not isinstance(arr, Array):
+                    raise TypeError("%s.%s is not an Array" % (u, attr))
+                k = self._array_key(arr, registry)
+                outs[attr] = k
+                written.add(k)
+                all_written.add(k)
+            unit_io.append((u, ins, outs))
+        donated = sorted(read_before_write & all_written)
+        held = sorted(read_before_write - all_written)
+        outputs = sorted(all_written)
+        self._plan = (unit_io, donated, held, outputs)
+        return self._plan
+
+    def _fused(self, donated_vals, held_vals):
+        unit_io, donated, held, outputs = self._plan
+        env = dict(zip(donated, donated_vals))
+        env.update(zip(held, held_vals))
+        for u, ins, outs in unit_io:
+            tensors = {a: env[k] for a, k in ins.items()}
+            result = u.step(**tensors)
+            for a, k in outs.items():
+                env[k] = result[a]
+        return tuple(env[k] for k in outputs)
+
+    # -- execution -----------------------------------------------------------
+
+    def _execute(self):
+        if self._plan is None:
+            self.plan()
+        _, donated, held, outputs = self._plan
+        donated_vals = tuple(self._arrays[k].devmem for k in donated)
+        held_vals = tuple(self._arrays[k].devmem for k in held)
+        if root.common.engine.get("eager"):
+            results = self._fused(donated_vals, held_vals)
+        else:
+            if self._jit is None:
+                self._jit = jax.jit(self._fused, donate_argnums=(0,))
+            results = self._jit(donated_vals, held_vals)
+        for k, v in zip(outputs, results):
+            self._arrays[k].devmem = v
+
+    def run_for(self, unit):
+        """Called from each member's run().  The scheduler already
+        enforces gates, so a member whose gate_skip/gate_block is set
+        never arrives here — an iteration where any member's gate is
+        engaged must therefore run per-unit, not fused."""
+        if unit not in self._pending:
+            # new iteration: either the previous one drained, or it never
+            # did because a gate_block cut propagation mid-chain
+            expected = {u for u in self.units
+                        if not u.gate_skip and not u.gate_block}
+            self._fallback = expected != set(self.units)
+            if not self._fallback:
+                self._execute()
+            self._pending = expected
+        self._pending.discard(unit)
+        if self._fallback:
+            unit._run_standalone()
+
+    def __repr__(self):
+        return "<FusedSegment %s>" % [u.name for u in self.units]
+
+
+class AcceleratedWorkflow(Workflow):
+    """Workflow owning a device; fuses accelerated-unit chains at
+    initialize time (ref: veles/accelerated_units.py:827)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, **kwargs):
+        super(AcceleratedWorkflow, self).__init__(workflow, **kwargs)
+        self.device = None
+
+    def init_unpickled(self):
+        super(AcceleratedWorkflow, self).init_unpickled()
+        self._segments_ = []
+
+    def initialize(self, device=None, **kwargs):
+        if device is None:
+            from veles_tpu.backends import Device
+            device = Device()
+        self.device = device
+        super(AcceleratedWorkflow, self).initialize(device=device, **kwargs)
+        if root.common.engine.get("fuse", True):
+            self.fuse()
+
+    def fuse(self):
+        """Find maximal linear chains u1→u2→…→uN of accelerated units
+        (single successor / single predecessor edges) and compile each
+        into a :class:`FusedSegment`."""
+        self._segments_ = []
+
+        def fusable(u):
+            return isinstance(u, AcceleratedUnit) and u.FUSABLE
+
+        accel = [u for u in self.units if fusable(u)]
+        in_chain = set()
+
+        def chain_next(u):
+            if len(u.links_to) != 1:
+                return None
+            (nxt,) = u.links_to
+            if (fusable(nxt) and nxt not in in_chain
+                    and len(nxt.links_from) == 1):
+                return nxt
+            return None
+
+        for u in accel:
+            if u in in_chain:
+                continue
+            # only start a chain at a unit with no fusable single-pred
+            prev_ok = (len(u.links_from) == 1 and
+                       fusable(next(iter(u.links_from)))
+                       and len(next(iter(u.links_from)).links_to) == 1)
+            if prev_ok:
+                continue
+            chain = [u]
+            in_chain.add(u)
+            nxt = chain_next(u)
+            while nxt is not None:
+                chain.append(nxt)
+                in_chain.add(nxt)
+                nxt = chain_next(nxt)
+            if len(chain) > 1:
+                seg = FusedSegment(chain)
+                for member in chain:
+                    member._segment_ = seg
+                self._segments_.append(seg)
+        if self._segments_:
+            self.debug("fused %d segment(s): %s", len(self._segments_),
+                       self._segments_)
+        return self._segments_
+
+    @property
+    def computing_power(self):
+        """Device rating for the elastic coordinator handshake
+        (ref: veles/accelerated_units.py:843-858)."""
+        return self.device.compute_power() if self.device else 0.0
+
+
+class DeviceBenchmark(AcceleratedUnit):
+    """Unit exposing the GEMM roofline probe in-graph
+    (ref: veles/accelerated_units.py:706)."""
+
+    FUSABLE = False  # no step(); runs host-side at initialize
+
+    def __init__(self, workflow, **kwargs):
+        super(DeviceBenchmark, self).__init__(workflow, **kwargs)
+        self.computing_power = 0.0
+
+    def initialize(self, device=None, **kwargs):
+        super(DeviceBenchmark, self).initialize(device=device, **kwargs)
+        if self.device is not None:
+            self.computing_power = self.device.compute_power()
+
+    def run(self):
+        pass

@@ -1,0 +1,19 @@
+"""ops — the kernel layer (rebuild of cuda/ + ocl/).
+
+Every kernel the reference shipped as OpenCL/CUDA source has a TPU-native
+equivalent here: XLA-traced jnp/lax ops where the compiler already does
+the right thing, and Pallas kernels where fusion control matters
+(SURVEY.md §2.2):
+
+- :mod:`veles_tpu.ops.gemm`      — policy matmul + Pallas tiled GEMM with
+  fused epilogue hook (ref: ocl/matrix_multiplication*.cl, gemm.cl)
+- :mod:`veles_tpu.ops.normalize` — mean/dispersion normalizer
+  (ref: ocl/mean_disp_normalizer.cl)
+- :mod:`veles_tpu.ops.join`      — N-input concat (ref: ocl/join.jcl)
+- :mod:`veles_tpu.ops.random`    — device PRNG fill (ref: ocl/random.cl)
+"""
+
+from veles_tpu.ops.gemm import matmul  # noqa: F401
+from veles_tpu.ops.join import InputJoiner  # noqa: F401
+from veles_tpu.ops.normalize import MeanDispNormalizer  # noqa: F401
+from veles_tpu.ops.random import Uniform  # noqa: F401

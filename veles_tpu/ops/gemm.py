@@ -1,0 +1,117 @@
+"""GEMM — MXU matmul under the precision policy, plus a Pallas tiled
+kernel with a fused-epilogue hook.
+
+Rebuild of ocl/matrix_multiplication*.cl (351 LoC of hand-tiled
+shared-memory GEMM in 3 precision levels) and the ``STORE_OUTPUT``
+epilogue-injection hook (ref: ocl/gemm.store_output.cl).  On TPU:
+
+- :func:`matmul` is the framework-wide matrix multiply: casts operands to
+  the policy compute dtype (bf16 feeds the MXU at full rate), accumulates
+  in the policy accumulation dtype, applies the policy
+  ``jax.lax.Precision``.  The reference's Kahan/multipartial
+  PRECISION_LEVEL ladder maps onto that precision enum + f32 accumulation
+  (documented delta: SURVEY.md §7 "Numerics parity knobs").
+- :func:`pallas_matmul` is the hand-tiled path for cases XLA cannot fuse:
+  an arbitrary ``epilogue`` traced into the same kernel right before the
+  store — the STORE_OUTPUT capability, TPU-style.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu import dtypes
+
+
+def matmul(a, b, out_dtype=None):
+    """Policy matmul: ``a @ b`` on the MXU.
+
+    Operands cast to ``root.common.precision.compute_dtype``,
+    accumulation in ``accum_dtype``, output cast to ``out_dtype`` (default
+    accum dtype — callers keeping bf16 activations pass it explicitly).
+    """
+    cd = dtypes.compute_dtype()
+    ad = dtypes.accum_dtype()
+    out = jax.lax.dot_general(
+        a.astype(cd), b.astype(cd),
+        dimension_numbers=(((a.ndim - 1,), (0,)), ((), ())),
+        precision=dtypes.matmul_precision(),
+        preferred_element_type=ad)
+    return out.astype(out_dtype) if out_dtype is not None else out
+
+
+def _mm_kernel(a_ref, b_ref, out_ref, acc_ref, *, k_steps, epilogue,
+               precision):
+    """Tiled GEMM kernel body: accumulate over the K grid axis in VMEM
+    scratch, run the epilogue on the final step, store."""
+    import jax.experimental.pallas as pl
+
+    @pl.when(pl.program_id(2) == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot_general(
+        a_ref[...], b_ref[...],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=precision,
+        preferred_element_type=jnp.float32)
+
+    @pl.when(pl.program_id(2) == k_steps - 1)
+    def _store():
+        acc = acc_ref[...]
+        if epilogue is not None:
+            acc = epilogue(acc)
+        out_ref[...] = acc.astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_m", "block_n", "block_k", "epilogue",
+                     "out_dtype", "interpret", "precision"))
+def pallas_matmul(a, b, block_m=256, block_n=256, block_k=512,
+                  epilogue=None, out_dtype=jnp.float32, interpret=False,
+                  precision=None):
+    """Hand-tiled MXU GEMM with a fused epilogue.
+
+    ``epilogue(acc) -> acc`` is traced into the kernel between the last
+    accumulation and the store — the TPU-native STORE_OUTPUT hook
+    (ref: ocl/gemm.store_output.cl usage in matrix_multiplication.cl).
+    Shapes must tile evenly; callers pad (the framework zero-pads batches
+    anyway for jit shape stability).
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2, (a.shape, b.shape)
+    block_m = min(block_m, m)
+    block_n = min(block_n, n)
+    block_k = min(block_k, k)
+    assert m % block_m == 0 and n % block_n == 0 and k % block_k == 0, \
+        "shapes must tile evenly; pad first (%s @ %s)" % (a.shape, b.shape)
+    if precision is None:
+        # f32 operands default to exact f32 passes; bf16 operands are
+        # already the policy's fast path
+        precision = (jax.lax.Precision.HIGHEST
+                     if a.dtype == jnp.float32 else
+                     jax.lax.Precision.DEFAULT)
+    k_steps = k // block_k
+    grid = (m // block_m, n // block_n, k_steps)
+    kernel = functools.partial(_mm_kernel, k_steps=k_steps,
+                               epilogue=epilogue, precision=precision)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((block_k, block_n), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(a, b)

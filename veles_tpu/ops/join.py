@@ -1,0 +1,55 @@
+"""InputJoiner — concatenate N inputs along the feature axis.
+
+Rebuild of veles/input_joiner.py:49-212 and its Jinja-templated copy
+kernel (ocl/join.jcl, cuda/join.jcu).  On TPU this is ``jnp.concatenate``
+inside the fused segment; XLA lays out the copies.
+"""
+
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Array
+from veles_tpu.units import MissingDemand
+
+
+class InputJoiner(AcceleratedUnit):
+    """Joins ``inputs`` (list of Arrays) into ``output`` along axis 1,
+    flattening trailing dims (ref: veles/input_joiner.py:49)."""
+
+    WRITES = ("output",)
+
+    def __init__(self, workflow, inputs=None, **kwargs):
+        super(InputJoiner, self).__init__(workflow, **kwargs)
+        self.inputs = list(inputs) if inputs else []
+        self.output = Array()
+        for i, arr in enumerate(self.inputs):
+            setattr(self, "input_%d" % i, arr)
+
+    @property
+    def reads(self):
+        return tuple("input_%d" % i for i in range(len(self.inputs)))
+
+    def link_inputs(self, other, *attrs):
+        """Append ``other``'s attrs to the join list
+        (ref: input_joiner.py link protocol)."""
+        for a in attrs:
+            arr = getattr(other, a)
+            setattr(self, "input_%d" % len(self.inputs), arr)
+            self.inputs.append(arr)
+        return self
+
+    def initialize(self, device=None, **kwargs):
+        if not self.inputs or not all(bool(a) for a in self.inputs):
+            raise MissingDemand(self, {"inputs"})
+        batch = self.inputs[0].shape[0]
+        width = sum(int(numpy.prod(a.shape[1:])) for a in self.inputs)
+        self.output.reset(numpy.zeros((batch, width),
+                                      self.inputs[0].dtype))
+        super(InputJoiner, self).initialize(device=device, **kwargs)
+
+    def step(self, **tensors):
+        flat = [tensors["input_%d" % i].reshape(tensors["input_%d" % i]
+                                                .shape[0], -1)
+                for i in range(len(self.inputs))]
+        return {"output": jnp.concatenate(flat, axis=1)}

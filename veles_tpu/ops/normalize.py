@@ -1,0 +1,49 @@
+"""MeanDispNormalizer — ``out = (x - mean) * rdisp``.
+
+Rebuild of veles/mean_disp_normalizer.py:50-138 and its kernels
+(ocl/mean_disp_normalizer.cl:1-20, cuda/mean_disp_normalizer.cu).  On TPU
+this is a single traced elementwise expression that XLA fuses into
+whatever consumes it — there is deliberately no hand-written kernel.
+"""
+
+import numpy
+
+from veles_tpu import dtypes
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Array
+from veles_tpu.units import MissingDemand
+
+
+def mean_disp_normalize(x, mean, rdisp, out_dtype=None):
+    """The traced op — broadcast over leading (batch) dims."""
+    out = (x - mean) * rdisp
+    return out.astype(out_dtype or dtypes.compute_dtype())
+
+
+class MeanDispNormalizer(AcceleratedUnit):
+    """Unit form (ref: veles/mean_disp_normalizer.py:50): normalizes
+    ``input`` with per-feature ``mean`` and reciprocal dispersion
+    ``rdisp``, writing ``output`` in the compute dtype."""
+
+    READS = ("input", "mean", "rdisp")
+    WRITES = ("output",)
+
+    def __init__(self, workflow, **kwargs):
+        super(MeanDispNormalizer, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.mean = None
+        self.rdisp = None
+        self.output = Array()
+        self.demand("input", "mean", "rdisp")
+
+    def initialize(self, device=None, **kwargs):
+        if not all(isinstance(getattr(self, a, None), Array) and
+                   bool(getattr(self, a))
+                   for a in ("input", "mean", "rdisp")):
+            raise MissingDemand(self, {"input", "mean", "rdisp"})
+        out_dt = dtypes.as_numpy_dtype(dtypes.compute_dtype())
+        self.output.reset(numpy.zeros(self.input.shape, out_dt))
+        super(MeanDispNormalizer, self).initialize(device=device, **kwargs)
+
+    def step(self, input, mean, rdisp):
+        return {"output": mean_disp_normalize(input, mean, rdisp)}

@@ -1,0 +1,107 @@
+"""Device-side PRNG fill (rebuild of ocl/random.cl, cuda/random.cu and
+the veles/prng/uniform.py unit).
+
+The reference ran a xorshift1024* kernel filling a buffer with random
+bits for dropout masks.  Two TPU-native paths:
+
+- :class:`Uniform` (the unit) draws with threefry *keys-as-data*: the
+  per-run key is an input tensor, so the traced step stays pure and every
+  draw is reproducible from the framework RNG — this is the default.
+- :func:`pallas_uniform` is the raw hardware-PRNG kernel
+  (``pltpu.prng_random_bits``) for hot fused kernels (e.g. in-kernel
+  dropout masks) where key plumbing is overhead; :func:`uniform` picks it
+  automatically on TPU when given a plain int seed.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Array
+
+
+def _pallas_uniform_kernel(seed_ref, out_ref):
+    from jax.experimental.pallas import tpu as pltpu
+    pltpu.prng_seed(seed_ref[0])
+    # logical (unsigned) shift keeps the top bit from smearing; Mosaic
+    # can't cast uint32->f32, so bitcast back to int32 (top 8 bits are
+    # zero after the shift, value is non-negative) before the cast
+    bits = pltpu.bitcast(pltpu.prng_random_bits(out_ref.shape), jnp.uint32)
+    small = pltpu.bitcast(bits >> 8, jnp.int32)
+    # 24 mantissa-safe bits -> [0, 1)
+    out_ref[...] = small.astype(jnp.float32) * (1.0 / (1 << 24))
+
+
+@functools.partial(jax.jit, static_argnames=("shape",))
+def pallas_uniform(seed, shape):
+    """Uniform [0,1) floats from the TPU hardware PRNG."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    return pl.pallas_call(
+        _pallas_uniform_kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(shape, jnp.float32),
+    )(jnp.asarray([seed], jnp.int32))
+
+
+def uniform(key_or_seed, shape, use_pallas=None):
+    """Uniform [0,1) tensor.  Picks the Pallas hardware-PRNG path on TPU,
+    threefry elsewhere (both deterministic in their seed)."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas and isinstance(key_or_seed, int):
+        # the hardware PRNG seed register is 32-bit
+        return pallas_uniform(key_or_seed & 0x7FFFFFFF, shape)
+    key = (jax.random.key(key_or_seed)
+           if isinstance(key_or_seed, int) else key_or_seed)
+    return jax.random.uniform(key, shape)
+
+
+class Uniform(AcceleratedUnit):
+    """Unit filling ``output`` with fresh uniforms each run
+    (ref: veles/prng/uniform.py:49) — dropout masks etc.
+
+    Randomness is *data*: the per-run key is an input to the traced step,
+    so the fused program stays pure and reproducible.
+    """
+
+    READS = ("key",)
+    WRITES = ("output",)
+    # run() mutates the key Array host-side before stepping; inside a
+    # fused segment that refresh would land after the segment executed
+    FUSABLE = False
+
+    def __init__(self, workflow, output_shape=None, prng_key="default",
+                 **kwargs):
+        super(Uniform, self).__init__(workflow, **kwargs)
+        self.output_shape = tuple(output_shape or ())
+        self.prng_name = prng_key
+        self.output = Array()
+        self.key = Array()
+
+    def initialize(self, device=None, **kwargs):
+        self.output.reset(numpy.zeros(self.output_shape, numpy.float32))
+        gen = prng.get(self.prng_name)
+        self.key.reset(numpy.zeros(2, numpy.uint32))
+        self._refresh_key(gen)
+        super(Uniform, self).initialize(device=device, **kwargs)
+
+    def _refresh_key(self, gen=None):
+        gen = gen or prng.get(self.prng_name)
+        raw = jax.random.key_data(gen.key())
+        self.key.map_invalidate()
+        self.key.mem[...] = numpy.asarray(raw)
+        self.key.unmap()
+
+    def run(self):
+        self._refresh_key()
+        super(Uniform, self).run()
+
+    def step(self, key):
+        k = jax.random.wrap_key_data(key.astype(jnp.uint32))
+        return {"output": jax.random.uniform(k, self.output_shape)}

@@ -1,0 +1,11 @@
+"""Reproducible seeded randomness (rebuild of veles/prng/).
+
+``get(name)`` returns process-wide named generators exactly like the
+reference (ref: veles/prng/random_generator.py:289); every generator
+yields both a host-side numpy stream (loader shuffles, CPU init) and
+deterministic JAX threefry keys (device-side randomness inside jit),
+derived from the same seed.
+"""
+
+from veles_tpu.prng.random_generator import (  # noqa: F401
+    RandomGenerator, get)

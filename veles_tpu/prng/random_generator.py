@@ -1,0 +1,163 @@
+"""RandomGenerator — one seed, two deterministic streams.
+
+Rebuild of veles/prng/random_generator.py:64-289.  The reference kept
+named numpy ``RandomState`` instances whose states were saved/restored
+around ``initialize()`` so a resumed run re-initialized bit-identically
+(ref: veles/units.py:859-885).  Here each generator derives:
+
+- a **host stream**: ``numpy.random.Generator`` (PCG64) for loader
+  shuffling and eager init — full state is pickled with snapshots;
+- a **device stream**: JAX threefry keys via a monotone counter —
+  ``key()`` folds the counter into ``jax.random.key(seed)``, and
+  :meth:`key_for` additionally folds mesh coordinates so sharded
+  programs draw independent yet reproducible streams per device
+  (SURVEY.md §7 "Reproducible RNG across sharding").
+
+Both streams are functions of ``(seed, counter)`` alone, so snapshots
+capture them exactly.
+"""
+
+import contextlib
+
+import numpy
+
+from veles_tpu.distributable import Pickleable
+
+
+class RandomGenerator(Pickleable):
+    """Named reproducible RNG (ref: veles/prng/random_generator.py:64)."""
+
+    def __init__(self, name="default", seed=None):
+        self.name = name
+        self._seed = 42 if seed is None else int(seed)
+        self._counter = 0
+        super(RandomGenerator, self).__init__()
+
+    def init_unpickled(self):
+        super(RandomGenerator, self).init_unpickled()
+        self._np_ = None
+        self._np_state = getattr(self, "_np_state", None)
+
+    # -- seeding -------------------------------------------------------------
+
+    @property
+    def seed_value(self):
+        return self._seed
+
+    def seed(self, seed):
+        """(Re)seed both streams (ref: random_generator.py:106)."""
+        if isinstance(seed, (bytes, str)):
+            seed = int.from_bytes(
+                seed.encode() if isinstance(seed, str) else seed,
+                "little") % (1 << 63)
+        elif isinstance(seed, numpy.ndarray):
+            seed = int(numpy.sum(seed.view(numpy.uint8).astype(numpy.uint64))
+                       % (1 << 63))
+        self._seed = int(seed)
+        self._counter = 0
+        self._np_ = None
+        self._np_state = None
+        return self
+
+    # -- host stream ---------------------------------------------------------
+
+    @property
+    def np(self):
+        if self._np_ is None:
+            self._np_ = numpy.random.Generator(
+                numpy.random.PCG64(self._seed))
+            if self._np_state is not None:
+                self._np_.bit_generator.state = self._np_state
+        return self._np_
+
+    def __getstate__(self):
+        if self._np_ is not None:
+            self._np_state = self._np_.bit_generator.state
+        return super(RandomGenerator, self).__getstate__()
+
+    # numpy-facing API used by loaders / eager init
+    def shuffle(self, arr):
+        self.np.shuffle(arr)
+
+    def permutation(self, n):
+        return self.np.permutation(n)
+
+    def randint(self, low, high=None, size=None):
+        return self.np.integers(low, high, size=size)
+
+    def rand(self, *shape):
+        return self.np.random(shape)
+
+    def normal(self, loc=0.0, scale=1.0, size=None):
+        return self.np.normal(loc, scale, size)
+
+    def fill(self, arr, vmin=-1.0, vmax=1.0):
+        """Uniform fill of a numpy array in place
+        (ref: random_generator.py:fill)."""
+        arr[...] = self.np.uniform(vmin, vmax, arr.shape).astype(arr.dtype)
+
+    def fill_normal(self, arr, mean=0.0, stddev=1.0):
+        arr[...] = self.np.normal(mean, stddev, arr.shape).astype(arr.dtype)
+
+    # -- device stream -------------------------------------------------------
+
+    def key(self):
+        """A fresh deterministic jax PRNG key; advances the counter."""
+        import jax
+        self._counter += 1
+        return jax.random.fold_in(jax.random.key(self._seed), self._counter)
+
+    def peek_key(self, offset=0):
+        """The key the (offset+1)-th future :meth:`key` call would return,
+        without advancing — ``peek_key(0)`` is the *next* draw (for traced
+        loops that derive per-step keys with fold_in inside jit)."""
+        import jax
+        return jax.random.fold_in(
+            jax.random.key(self._seed), self._counter + 1 + offset)
+
+    def key_for(self, *folds):
+        """A key with extra folds (e.g. mesh axis indices) so each shard
+        draws an independent reproducible stream."""
+        import jax
+        k = self.key()
+        for f in folds:
+            k = jax.random.fold_in(k, int(f))
+        return k
+
+    # -- state capture (ref: veles/units.py:859-885) -------------------------
+
+    @property
+    def state(self):
+        if self._np_ is not None:
+            self._np_state = self._np_.bit_generator.state
+        return {"seed": self._seed, "counter": self._counter,
+                "np_state": self._np_state}
+
+    @state.setter
+    def state(self, value):
+        self._seed = value["seed"]
+        self._counter = value["counter"]
+        self._np_state = value["np_state"]
+        self._np_ = None
+
+    @contextlib.contextmanager
+    def preserve_state(self):
+        """Run a block, then restore the RNG state — the reference wrapped
+        ``initialize()`` with this so restored snapshots re-initialize
+        identically."""
+        saved = self.state
+        try:
+            yield self
+        finally:
+            self.state = saved
+
+
+_generators = {}
+
+
+def get(key="default"):
+    """Process-wide named generator (ref: random_generator.py:289)."""
+    gen = _generators.get(key)
+    if gen is None:
+        gen = _generators[key] = RandomGenerator(name=key)
+    return gen
