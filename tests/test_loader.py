@@ -1,0 +1,270 @@
+"""Loader stack tests (SURVEY.md §7 step 5; models veles/tests/
+test_loader.py, test_minibatches_saver_loader.py)."""
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.loader import (
+    TEST, TRAIN, VALID, FullBatchLoader, FullBatchLoaderMSE, Loader)
+from veles_tpu.loader.pickles import PicklesLoader
+from veles_tpu.loader.saver import MinibatchesLoader, MinibatchesSaver
+from veles_tpu import normalization
+from veles_tpu.workflow import Workflow
+
+
+class SyntheticLoader(FullBatchLoader):
+    """70 train / 20 validation / 10 test rows of 8 features; label =
+    row index % 3 (as strings, to exercise labels_mapping)."""
+
+    def __init__(self, workflow, n_test=10, n_valid=20, n_train=70,
+                 features=8, labeled=True, **kwargs):
+        super(SyntheticLoader, self).__init__(workflow, **kwargs)
+        self.sizes = (n_test, n_valid, n_train)
+        self.features = features
+        self.labeled = labeled
+
+    def load_data(self):
+        total = sum(self.sizes)
+        self.class_lengths[:] = list(self.sizes)
+        rng = numpy.random.default_rng(0)
+        self.original_data = rng.normal(
+            size=(total, self.features)).astype(numpy.float32)
+        # make rows identifiable: first feature = row index
+        self.original_data[:, 0] = numpy.arange(total)
+        if self.labeled:
+            self.original_labels = ["lbl%d" % (i % 3) for i in range(total)]
+
+
+def make_loader(device=None, **kwargs):
+    wf = Workflow(None, name="wf")
+    loader = SyntheticLoader(wf, **kwargs)
+    loader.initialize(device=device)
+    return loader
+
+
+class TestLoaderBase:
+    def test_class_offsets(self):
+        l = make_loader()
+        assert l.class_end_offsets == [10, 30, 100]
+        assert l.total_samples == 100
+
+    def test_label_mapping_built(self):
+        l = make_loader()
+        assert l.labels_mapping == {"lbl0": 0, "lbl1": 1, "lbl2": 2}
+
+    def test_epoch_walk_covers_all_classes(self):
+        # reference semantics: one walk is test -> validation -> train;
+        # epoch_ended fires at the END of the validation span (the
+        # evaluate-then-train cycle, ref base.py:862-878), train_ended at
+        # the end of the train span
+        l = make_loader(minibatch_size=32)
+        classes_seen = []
+        served = 0
+        epoch_end_marks = []
+        for _ in range(100):
+            l.run()
+            classes_seen.append(l.minibatch_class)
+            served += l.minibatch_size
+            if l.epoch_ended:
+                epoch_end_marks.append(served)
+            if l.train_ended:
+                break
+        assert served == 100
+        assert set(classes_seen) == {TEST, VALID, TRAIN}
+        assert epoch_end_marks == [30]  # end of validation span
+        assert l.epoch_number == 1
+
+    def test_minibatch_never_crosses_class_boundary(self):
+        l = make_loader(minibatch_size=32)
+        for _ in range(10):
+            l.run()
+            idx = l.minibatch_indices.mem[:l.minibatch_size]
+            offs = l.minibatch_offset
+            lo = offs - l.minibatch_size
+            cls = {l._class_by_offset(i)[0] for i in range(lo, offs)}
+            assert len(cls) == 1
+            if l.epoch_ended:
+                break
+
+    def test_tail_padding(self):
+        l = make_loader(minibatch_size=32)
+        sizes = []
+        for _ in range(10):
+            l.run()
+            sizes.append(l.minibatch_size)
+            if l.minibatch_size < 32:
+                assert numpy.all(
+                    l.minibatch_indices.mem[l.minibatch_size:] == -1)
+            if l.epoch_ended:
+                break
+        assert 10 in sizes and 20 in sizes  # test + valid tails
+
+    def test_shuffle_between_epochs(self):
+        l = make_loader(minibatch_size=100)
+        orders = []
+        for _ in range(2):
+            # run one full walk (break at the end of the train span)
+            for _ in range(10):
+                l.run()
+                if l.minibatch_class == TRAIN:
+                    orders.append(
+                        numpy.array(l.minibatch_indices.mem[:l.minibatch_size]))
+                if l.train_ended:
+                    break
+        assert not numpy.array_equal(orders[0], orders[1])
+        # train indices stay within the train span
+        for o in orders:
+            assert (o >= 30).all()
+
+    def test_shuffle_limit_zero_is_deterministic(self):
+        l = make_loader(minibatch_size=100, shuffle_limit=0)
+        orders = []
+        for _ in range(2):
+            for _ in range(10):
+                l.run()
+                if l.minibatch_class == TRAIN:
+                    orders.append(
+                        numpy.array(l.minibatch_indices.mem[:l.minibatch_size]))
+                if l.train_ended:
+                    break
+        assert numpy.array_equal(orders[0], orders[1])
+
+    def test_data_rows_match_indices(self):
+        l = make_loader(minibatch_size=16)
+        l.run()
+        idx = l.minibatch_indices.mem[:l.minibatch_size]
+        l.minibatch_data.map_read()
+        rows = l.minibatch_data.mem[:l.minibatch_size, 0]
+        assert numpy.allclose(rows, idx)
+
+    def test_train_ratio(self):
+        l = make_loader(minibatch_size=100, train_ratio=0.5)
+        assert l.effective_total_samples == 65
+
+
+class TestDeviceGather:
+    def test_device_resident_gather(self):
+        dev = Device(backend="numpy")
+        l = make_loader(device=dev, minibatch_size=16)
+        assert l._dataset_dev_ is not None
+        l.run()
+        idx = l.minibatch_indices.mem[:l.minibatch_size]
+        l.minibatch_data.map_read()
+        assert numpy.allclose(l.minibatch_data.mem[:l.minibatch_size, 0], idx)
+
+    def test_force_numpy_fallback(self):
+        dev = Device(backend="numpy")
+        l = make_loader(device=dev, minibatch_size=16, force_numpy=True)
+        assert l._dataset_dev_ is None
+        l.run()
+        idx = l.minibatch_indices.mem[:l.minibatch_size]
+        assert numpy.allclose(l.minibatch_data.mem[:l.minibatch_size, 0], idx)
+
+
+class TestDistributedServing:
+    def test_master_serves_indices_worker_fills(self):
+        master = make_loader(minibatch_size=16)
+        worker = make_loader(minibatch_size=16)
+        job = master.generate_data_for_slave("w1")
+        assert len(job["indices"]) == job["minibatch_size"]
+        worker.apply_data_from_master(job)
+        worker.serve_next_minibatch(None)
+        worker.minibatch_data.map_read()
+        assert numpy.allclose(
+            worker.minibatch_data.mem[:worker.minibatch_size, 0],
+            job["indices"])
+        master.apply_data_from_slave(True, "w1")
+        assert not any(master.pending_minibatches_.values())
+
+    def test_drop_slave_requeues(self):
+        master = make_loader(minibatch_size=16)
+        job = master.generate_data_for_slave("w1")
+        master.drop_slave("w1")
+        assert master.failed_minibatches
+        job2 = master.generate_data_for_slave("w2")
+        assert job2["minibatch_offset"] == job["minibatch_offset"]
+
+
+class TestNormalizers:
+    @pytest.mark.parametrize("kind", ["none", "linear", "range_linear",
+                                      "mean_disp", "internal_mean", "exp",
+                                      "pointwise"])
+    def test_roundtrip_shapes(self, kind):
+        n = normalization.get_normalizer(kind)
+        data = numpy.random.rand(20, 5).astype(numpy.float32) * 4 - 2
+        n.analyze(data)
+        out = n.normalize(data.copy())
+        assert out.shape == data.shape
+
+    def test_mean_disp_values(self):
+        n = normalization.get_normalizer("mean_disp")
+        data = numpy.random.rand(50, 4).astype(numpy.float32)
+        n.analyze(data)
+        out = n.normalize(data.copy())
+        assert abs(out.mean()) < 0.1
+        back = n.denormalize(out)
+        assert numpy.allclose(back, data, atol=1e-5)
+
+    def test_state_transfer(self):
+        n1 = normalization.get_normalizer("range_linear")
+        data = numpy.random.rand(30, 4).astype(numpy.float32)
+        n1.analyze(data)
+        n2 = normalization.get_normalizer("range_linear")
+        n2.state = n1.state
+        assert numpy.allclose(n2.normalize(data.copy()),
+                              n1.normalize(data.copy()))
+
+    def test_loader_normalizes(self):
+        l = make_loader(minibatch_size=100,
+                        normalization_type="internal_mean")
+        assert l.normalizer.is_initialized
+        # train mean of normalized dataset ~ 0 (analysis ran on raw train)
+        lo, hi = l.class_end_offsets[VALID], l.class_end_offsets[TRAIN]
+        assert abs(l.original_data[lo:hi, 1:].mean()) < 0.2
+
+
+class TestPicklesLoader:
+    def test_roundtrip(self, tmp_path):
+        import pickle as pkl
+        rng = numpy.random.default_rng(1)
+        for name, n in (("train", 40), ("valid", 10)):
+            with open(tmp_path / (name + ".pickle"), "wb") as f:
+                pkl.dump((rng.normal(size=(n, 6)).astype(numpy.float32),
+                          [i % 2 for i in range(n)]), f)
+        wf = Workflow(None, name="wf")
+        l = PicklesLoader(
+            wf, train_path=str(tmp_path / "train.pickle"),
+            validation_path=str(tmp_path / "valid.pickle"),
+            minibatch_size=16)
+        l.initialize()
+        assert l.class_lengths == [0, 10, 40]
+        l.run()
+        assert l.minibatch_size > 0
+
+
+class TestSaverLoader:
+    def test_save_then_replay(self, tmp_path):
+        path = str(tmp_path / "mb.pickle.gz")
+        src = make_loader(minibatch_size=32)
+        wf = src.workflow
+        saver = MinibatchesSaver(wf, path=path)
+        saver.loader = src
+        saver.initialize()
+        for _ in range(10):
+            src.run()
+            saver.run()
+            if src.train_ended:
+                break
+        saver.stop()
+
+        wf2 = Workflow(None, name="wf2")
+        replay = MinibatchesLoader(wf2, path=path)
+        replay.initialize()
+        assert replay.total_samples == 100
+        replay.run()
+        assert replay.minibatch_size > 0
+        replay.minibatch_data.map_read()
+        # rows keep their identity feature
+        idx_feature = replay.minibatch_data.mem[:replay.minibatch_size, 0]
+        assert ((0 <= idx_feature) & (idx_feature < 100)).all()

@@ -280,6 +280,12 @@ class AcceleratedWorkflow(Workflow):
             device = Device()
         self.device = device
         super(AcceleratedWorkflow, self).initialize(device=device, **kwargs)
+        # always clear stale segment bindings from a previous initialize
+        # (graph may have been rewired, or fusion turned off)
+        self._segments_ = []
+        for u in self.units:
+            if isinstance(u, AcceleratedUnit):
+                u._segment_ = None
         if root.common.engine.get("fuse", True):
             self.fuse()
 

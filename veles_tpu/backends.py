@@ -76,6 +76,12 @@ class Device(Logger, metaclass=BackendRegistry):
             raise RuntimeError(
                 "no %s devices available" % (self.BACKEND or "jax"))
 
+    def __reduce__(self):
+        # devices are runtime context: snapshots store (backend, index)
+        # and reconstruct a live handle at load (the reference re-created
+        # devices on resume too, veles/__main__.py:604-616)
+        return (Device, (self.BACKEND, self.device_index))
+
     # -- discovery (subclasses) --------------------------------------------
 
     _PLATFORM = None

@@ -1,0 +1,17 @@
+"""Data loading stack (rebuild of veles/loader/, 5.1 kLoC, 17 modules).
+
+- :mod:`veles_tpu.loader.base`       — Loader: minibatch serving, class
+  split, shuffling, epoch flags, failed-minibatch requeue
+- :mod:`veles_tpu.loader.fullbatch`  — device-resident dataset + traced
+  gather (the TPU path for datasets that fit in HBM)
+- :mod:`veles_tpu.loader.pickles`    — datasets from pickle files
+- :mod:`veles_tpu.loader.image`      — directory/file image datasets (PIL)
+- :mod:`veles_tpu.loader.saver`      — minibatch stream save / replay
+- :mod:`veles_tpu.loader.interactive`— feed minibatches from code
+- :mod:`veles_tpu.loader.restful`    — feed minibatches from HTTP (serving)
+"""
+
+from veles_tpu.loader.base import (  # noqa: F401
+    CLASS_NAME, TEST, TRAIN, VALID, ILoader, Loader)
+from veles_tpu.loader.fullbatch import (  # noqa: F401
+    FullBatchLoader, FullBatchLoaderMSE)

@@ -1,0 +1,361 @@
+"""Loader — the minibatch-serving unit.
+
+Rebuild of veles/loader/base.py:100-1181.  Serves minibatches from three
+sample classes (test / validation / train, ref: base.py:80), walking the
+concatenated index space ``[test | validation | train]`` each epoch,
+shuffling the train span between epochs, zero-padding the tail minibatch
+to ``max_minibatch_size`` (which doubles as the jit static-shape
+guarantee on TPU — every minibatch the compiled program sees has the
+same shape, ref tail-pad: base.py:749-753).
+
+Distributed behavior (the elastic DCN job-queue layer, SURVEY.md §2.3):
+the coordinator serves *index ranges* to workers
+(``generate_data_for_slave``), requeues ranges from dropped workers
+(``failed_minibatches``, ref: base.py:679-687), and workers fill data
+locally from their own dataset copy.
+"""
+
+import numpy
+
+from veles_tpu import prng as prng_mod
+from veles_tpu.distributable import IDistributable
+from veles_tpu.memory import Array
+from veles_tpu.mutable import Bool
+from veles_tpu.normalization import get_normalizer
+from veles_tpu.units import Unit
+from veles_tpu.result_provider import IResultProvider
+
+TEST, VALID, TRAIN = 0, 1, 2
+CLASS_NAME = ("test", "validation", "train")
+
+INDEX_DTYPE = numpy.int32
+LABEL_DTYPE = numpy.int32
+
+
+class ILoader:
+    """The subclass contract (ref: base.py:100-120)."""
+
+    def load_data(self):
+        """Discover the dataset: set ``class_lengths`` and load/locate
+        sample storage."""
+        raise NotImplementedError()
+
+    def create_minibatch_data(self):
+        """Allocate ``minibatch_data`` (shape [max_minibatch_size, ...])."""
+        raise NotImplementedError()
+
+    def fill_minibatch(self):
+        """Copy rows ``minibatch_indices[:minibatch_size]`` of the dataset
+        into minibatch_data/labels."""
+        raise NotImplementedError()
+
+
+class Loader(Unit, ILoader, IDistributable, IResultProvider):
+    """Minibatch server (ref: veles/loader/base.py:120)."""
+
+    hide_from_registry = True
+    VIEW_GROUP = "LOADER"
+    negotiates_on_connect = True
+
+    def __init__(self, workflow, minibatch_size=100, shuffle_limit=None,
+                 train_ratio=1.0, normalization_type="none",
+                 normalization_parameters=None, prng_key="loader", **kwargs):
+        super(Loader, self).__init__(workflow, **kwargs)
+        self.max_minibatch_size = minibatch_size
+        #: how many times shuffle() may still permute the train span
+        #: (None = unlimited; 0 = deterministic order, ref base.py)
+        self.shuffle_limit = shuffle_limit
+        self.train_ratio = train_ratio
+        self.prng = prng_mod.get(prng_key)
+
+        self.class_lengths = [0, 0, 0]
+        self.class_end_offsets = [0, 0, 0]
+
+        self.minibatch_class = TRAIN
+        self.minibatch_size = 0
+        self.minibatch_offset = 0
+        self.minibatch_data = Array()
+        self.minibatch_labels = Array()
+        self.minibatch_indices = Array()
+        self.raw_minibatch_labels = []
+        self.labels_mapping = {}
+
+        self.shuffled_indices = Array()
+        self.global_offset = 0
+        self.epoch_number = 0
+        self.samples_served = 0
+        self.last_minibatch = Bool(False, "last_minibatch")
+        self.epoch_ended = Bool(False, "epoch_ended")
+        self.train_ended = Bool(False, "train_ended")
+        self.failed_minibatches = []
+
+        self.normalization_type = normalization_type
+        self.normalization_parameters = normalization_parameters or {}
+        self._normalizer = None
+
+    def init_unpickled(self):
+        super(Loader, self).init_unpickled()
+        #: worker-id -> list of in-flight (offset, size) jobs — volatile,
+        #: a restart abandons in-flight bookkeeping (ref: base.py:205)
+        self.pending_minibatches_ = {}
+
+    # -- derived quantities ---------------------------------------------------
+
+    @property
+    def total_samples(self):
+        return sum(self.class_lengths)
+
+    @property
+    def effective_total_samples(self):
+        """train_ratio < 1 trims the train span (ref: base.py:391)."""
+        return self.total_samples - int(
+            (1.0 - self.train_ratio) * self.class_lengths[TRAIN])
+
+    @property
+    def has_labels(self):
+        return bool(self.labels_mapping) or any(
+            l is not None for l in self.raw_minibatch_labels)
+
+    @property
+    def normalizer(self):
+        if self._normalizer is None:
+            self._normalizer = get_normalizer(
+                self.normalization_type, **self.normalization_parameters)
+        return self._normalizer
+
+    @property
+    def class_ended(self):
+        return self.global_offset in self.class_end_offsets \
+            or self.global_offset == self.effective_total_samples
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def initialize(self, **kwargs):
+        super(Loader, self).initialize(**kwargs)
+        self.load_data()
+        if self.total_samples == 0:
+            raise ValueError("%s: load_data() produced no samples" % self)
+        self._calc_class_end_offsets()
+        self.info("samples: test %d, validation %d, train %d",
+                  *self.class_lengths)
+        self.minibatch_indices.reset(
+            numpy.zeros(self.max_minibatch_size, INDEX_DTYPE))
+        self.minibatch_labels.reset(
+            numpy.zeros(self.max_minibatch_size, LABEL_DTYPE))
+        self.raw_minibatch_labels = [None] * self.max_minibatch_size
+        self.create_minibatch_data()
+        if not self.minibatch_data:
+            raise ValueError(
+                "%s: create_minibatch_data() must allocate minibatch_data"
+                % self)
+        self._analyze_dataset()
+        if not self.shuffled_indices:
+            self.shuffled_indices.mem = numpy.arange(
+                self.total_samples, dtype=INDEX_DTYPE)
+            self.shuffle()
+
+    def _calc_class_end_offsets(self):
+        total = 0
+        for i, n in enumerate(self.class_lengths):
+            total += int(n)
+            self.class_end_offsets[i] = total
+
+    def _analyze_dataset(self):
+        """One pass over the train set accumulating normalizer stats and
+        the label mapping (ref: base.py analyze_dataset, simplified: the
+        subclass exposes train data via iterate_train())."""
+        from veles_tpu.normalization import StatelessNormalizer
+        need_stats = not isinstance(self.normalizer, StatelessNormalizer) \
+            and not self.normalizer.is_initialized
+        need_labels = not self.labels_mapping
+        if not (need_stats or need_labels):
+            return
+        labels = set()
+        for data, batch_labels in self.iterate_train():
+            if need_stats:
+                self.normalizer.analyze(data)
+            if need_labels and batch_labels is not None:
+                labels.update(batch_labels)
+        if need_labels and labels:
+            self.labels_mapping = {
+                l: i for i, l in enumerate(sorted(labels))}
+
+    def iterate_train(self):
+        """Yield (data, labels) batches of the train set for analysis.
+        Subclasses with device-resident data override."""
+        return iter(())
+
+    # -- shuffling ------------------------------------------------------------
+
+    def shuffle(self):
+        """Permute the train span of shuffled_indices
+        (ref: base.py:711)."""
+        if self.class_lengths[TRAIN] == 0:
+            return
+        if self.shuffle_limit is not None:
+            if self.shuffle_limit <= 0:
+                return
+            self.shuffle_limit -= 1
+        self.shuffled_indices.map_write()
+        self.prng.shuffle(
+            self.shuffled_indices.mem[self.class_end_offsets[VALID]:])
+
+    # -- serving (ref: base.py:726-910) ---------------------------------------
+
+    def run(self):
+        self.pending_minibatches_.pop(None, None)
+        self.serve_next_minibatch(None)
+        self._on_successful_serve()
+
+    def serve_next_minibatch(self, slave_id):
+        try:
+            minibatch_def = self.failed_minibatches.pop()
+        except IndexError:
+            minibatch_def = self._advance_global_offset()
+        offset, size = minibatch_def
+        self.pending_minibatches_.setdefault(slave_id, []).append(
+            minibatch_def)
+        self.minibatch_offset, self.minibatch_size = offset, size
+
+        self.minibatch_data.map_invalidate()
+        self.minibatch_labels.map_invalidate()
+        self.minibatch_indices.map_invalidate()
+        self.shuffled_indices.map_read()
+        self.minibatch_indices.mem[:size] = \
+            self.shuffled_indices.mem[offset - size:offset]
+
+        if self.is_master:
+            return
+        self.fill_minibatch()
+        self._normalize_minibatch()
+        self._map_minibatch_labels()
+        if size < self.max_minibatch_size:
+            self._pad_tail(size)
+        self.minibatch_data.unmap()
+        self.minibatch_labels.unmap()
+        self.minibatch_indices.unmap()
+
+    def _pad_tail(self, size):
+        """Zero-pad the tail minibatch so jitted consumers always see the
+        same shape (ref: base.py:749-753 + TPU static-shape requirement).
+        Device-gather loaders override the data part."""
+        self.minibatch_data.mem[size:] = 0
+        self.minibatch_labels.mem[size:] = -1
+        self.minibatch_indices.mem[size:] = -1
+
+    def _normalize_minibatch(self):
+        size = self.minibatch_size
+        self.minibatch_data.mem[:size] = self.normalizer.normalize(
+            self.minibatch_data.mem[:size])
+
+    def _map_minibatch_labels(self):
+        if not self.labels_mapping:
+            return
+        for i, l in enumerate(self.raw_minibatch_labels[:self.minibatch_size]):
+            if l is None:
+                continue
+            self.minibatch_labels.mem[i] = self.labels_mapping[l]
+
+    def _class_by_offset(self, offset):
+        for ci, end in enumerate(self._effective_end_offsets()):
+            if offset < end:
+                return ci, end - offset
+        raise AssertionError("offset %d beyond dataset" % offset)
+
+    def _effective_end_offsets(self):
+        ends = list(self.class_end_offsets)
+        ends[TRAIN] -= int(
+            (1.0 - self.train_ratio) * self.class_lengths[TRAIN])
+        return ends
+
+    def _advance_global_offset(self):
+        """Pick the next (offset, size); wraps + reshuffles at epoch end
+        (ref: base.py:880)."""
+        if self.is_slave:
+            return self.minibatch_offset, self.minibatch_size
+        if self.global_offset >= self.effective_total_samples:
+            self.global_offset = 0
+            self.shuffle()
+        self.minibatch_class, remainder = self._class_by_offset(
+            self.global_offset)
+        size = min(remainder, self.max_minibatch_size)
+        self.global_offset += size
+        self.train_ended.set(
+            self.global_offset >= self.effective_total_samples)
+        return self.global_offset, size
+
+    def _update_flags(self):
+        if self.is_slave:
+            return
+        # in-flight jobs only gate the flags on the coordinator — in
+        # standalone mode the just-served minibatch is still "pending"
+        # at this point (ref: base.py:862-878)
+        last_mb = (self.class_ended and not self.failed_minibatches
+                   and (not self.is_master
+                        or not any(self.pending_minibatches_.values())))
+        self.last_minibatch.set(last_mb)
+        self.epoch_ended.set(last_mb and (
+            self.minibatch_class == VALID or
+            (self.minibatch_class == TEST and
+             self.class_lengths[TRAIN] == self.class_lengths[VALID] == 0) or
+            (self.minibatch_class == TRAIN and
+             self.class_lengths[VALID] == 0)))
+
+    def _on_successful_serve(self):
+        self.samples_served += self.minibatch_size
+        if self.samples_served and self.effective_total_samples:
+            self.epoch_number = \
+                self.samples_served // self.effective_total_samples
+        self._update_flags()
+        for jobs in self.pending_minibatches_.values():
+            if (self.minibatch_offset, self.minibatch_size) in jobs:
+                jobs.remove((self.minibatch_offset, self.minibatch_size))
+                break
+
+    # -- distributed contract (ref: base.py:628-687) ---------------------------
+
+    def generate_data_for_slave(self, slave=None):
+        self.serve_next_minibatch(slave)
+        return {
+            "indices": numpy.array(
+                self.minibatch_indices.mem[:self.minibatch_size]),
+            "minibatch_class": self.minibatch_class,
+            "minibatch_size": self.minibatch_size,
+            "minibatch_offset": self.minibatch_offset,
+            "epoch_number": self.epoch_number,
+        }
+
+    def apply_data_from_master(self, data):
+        for attr in ("minibatch_class", "minibatch_size",
+                     "minibatch_offset", "epoch_number"):
+            setattr(self, attr, data[attr])
+        self.last_minibatch.set(False)
+        self.epoch_ended.set(False)
+        self.train_ended.set(False)
+        indices = data["indices"]
+        assert len(indices) == self.minibatch_size
+        self.shuffled_indices.map_write()
+        self.shuffled_indices.mem[
+            self.minibatch_offset - self.minibatch_size:
+            self.minibatch_offset] = indices
+
+    def generate_data_for_master(self):
+        return True
+
+    def apply_data_from_slave(self, data, slave=None):
+        jobs = self.pending_minibatches_.get(slave)
+        if jobs:
+            self.minibatch_offset, self.minibatch_size = jobs.pop()
+            self._on_successful_serve()
+
+    def drop_slave(self, slave=None):
+        jobs = self.pending_minibatches_.pop(slave, None)
+        if jobs:
+            self.failed_minibatches.extend(jobs)
+            self.info("requeued %d minibatch(es) from dropped worker %s",
+                      len(jobs), slave)
+
+    # -- results ---------------------------------------------------------------
+
+    def get_metric_values(self):
+        return {"Total epochs": self.epoch_number}

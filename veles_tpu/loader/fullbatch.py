@@ -1,0 +1,199 @@
+"""FullBatchLoader — whole dataset resident in device HBM.
+
+Rebuild of veles/loader/fullbatch.py:79-566.  The reference uploaded the
+dataset to GPU memory and gathered minibatches with a dedicated kernel
+(ocl/fullbatch_loader.cl / cuda/fullbatch_loader.cu) with CPU fallback on
+OOM.  TPU-native: the dataset is one ``jax.Array`` in HBM, the minibatch
+gather is a jitted ``jnp.take`` (XLA emits the dynamic-gather), and the
+normalizer runs once over the whole dataset at upload time instead of
+per-minibatch.  Falls back to host-side numpy gather when the dataset
+exceeds the HBM budget.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.loader.base import (
+    INDEX_DTYPE, LABEL_DTYPE, TRAIN, VALID, Loader)
+from veles_tpu.memory import Array
+
+
+class FullBatchLoader(Loader):
+    """Device-resident dataset loader (ref: loader/fullbatch.py:79).
+
+    Subclasses implement :meth:`load_data` filling ``original_data``
+    (numpy [total, ...]) + optionally ``original_labels`` (list/array of
+    labels, one per sample) and ``class_lengths``.
+    """
+
+    hide_from_registry = True
+
+    #: fraction of free device memory the dataset may occupy before
+    #: falling back to host gather (ref OOM fallback: fullbatch.py:158-242)
+    DEVICE_MEMORY_FRACTION = 0.8
+
+    def __init__(self, workflow, force_numpy=False, **kwargs):
+        super(FullBatchLoader, self).__init__(workflow, **kwargs)
+        self.original_data = None
+        self.original_labels = None
+        self.force_numpy = force_numpy
+        self.device = None
+
+    def init_unpickled(self):
+        super(FullBatchLoader, self).init_unpickled()
+        self._dataset_dev_ = None
+        self._gather_jit_ = None
+
+    # -- ILoader ---------------------------------------------------------------
+
+    def create_minibatch_data(self):
+        shape = (self.max_minibatch_size,) + self.original_data.shape[1:]
+        self.minibatch_data.reset(
+            numpy.zeros(shape, self.original_data.dtype))
+
+    def iterate_train(self):
+        lo = self.class_end_offsets[VALID]
+        hi = self.class_end_offsets[TRAIN]
+        step = max(1, self.max_minibatch_size)
+        for start in range(lo, hi, step):
+            stop = min(start + step, hi)
+            labels = None
+            if self.original_labels is not None:
+                labels = list(self.original_labels[start:stop])
+            yield self.original_data[start:stop], labels
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def initialize(self, device=None, **kwargs):
+        if device is not None:
+            self.device = device
+        super(FullBatchLoader, self).initialize(**kwargs)
+        self._post_load()
+
+    def _post_load(self):
+        # normalize the whole dataset once (device path applies it here
+        # rather than per minibatch)
+        if self.class_lengths[TRAIN] > 0:
+            self.original_data = numpy.ascontiguousarray(
+                self.normalizer.normalize(self.original_data))
+        self._numeric_labels = None
+        if self.original_labels is not None:
+            if self.labels_mapping:
+                self._numeric_labels = numpy.array(
+                    [self.labels_mapping.get(l, -1)
+                     for l in self.original_labels], LABEL_DTYPE)
+            else:
+                self._numeric_labels = numpy.asarray(
+                    self.original_labels, LABEL_DTYPE)
+        self._maybe_upload()
+
+    def _maybe_upload(self):
+        if self.force_numpy or self.device is None:
+            return
+        nbytes = self.original_data.nbytes
+        stats = self.device.memory_stats()
+        limit = stats.get("bytes_limit")
+        if limit and nbytes > self.DEVICE_MEMORY_FRACTION * limit:
+            self.warning(
+                "dataset (%.1f MiB) exceeds device budget — host gather",
+                nbytes / 2**20)
+            return
+        self._dataset_dev_ = jax.device_put(
+            self.original_data, self.device.jax_device)
+
+        # computation follows the dataset's committed placement; padded
+        # tail rows are zeroed in-kernel (size is traced, shapes static)
+        def gather(ds, idx, size):
+            rows = jnp.take(ds, idx, axis=0, mode="clip")
+            mask = jnp.arange(rows.shape[0]) < size
+            return jnp.where(
+                mask.reshape((-1,) + (1,) * (rows.ndim - 1)), rows, 0)
+
+        self._gather_jit_ = jax.jit(gather)
+
+    # -- serving ---------------------------------------------------------------
+
+    def fill_minibatch(self):
+        size = self.minibatch_size
+        idx = self.minibatch_indices.mem[:size]
+        if self._dataset_dev_ is not None:
+            full_idx = numpy.zeros(self.max_minibatch_size, INDEX_DTYPE)
+            full_idx[:size] = idx
+            self.minibatch_data.devmem = self._gather_jit_(
+                self._dataset_dev_, jnp.asarray(full_idx),
+                numpy.int32(size))
+        else:
+            self.minibatch_data.mem[:size] = self.original_data[idx]
+        if self._numeric_labels is not None:
+            self.minibatch_labels.mem[:size] = self._numeric_labels[idx]
+
+    def _normalize_minibatch(self):
+        pass  # already normalized at upload
+
+    def _map_minibatch_labels(self):
+        pass  # numeric labels gathered directly
+
+    def _pad_tail(self, size):
+        if self._dataset_dev_ is not None:
+            # data rows already zero-masked in the gather kernel
+            self.minibatch_labels.mem[size:] = -1
+            self.minibatch_indices.mem[size:] = -1
+        else:
+            super(FullBatchLoader, self)._pad_tail(size)
+
+    def __getstate__(self):
+        state = super(FullBatchLoader, self).__getstate__()
+        # the dataset is reloadable via load_data(); keep snapshots small
+        # (ref: fullbatch.py stored datasets out-of-line similarly)
+        state.pop("original_data", None)
+        state.pop("original_labels", None)
+        state.pop("_numeric_labels", None)
+        return state
+
+
+class FullBatchLoaderMSE(FullBatchLoader):
+    """Adds regression targets (ref: fullbatch.py MSE variants):
+    ``original_targets`` [total, ...] gathered into
+    ``minibatch_targets``."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(FullBatchLoaderMSE, self).__init__(workflow, **kwargs)
+        self.original_targets = None
+        self.minibatch_targets = Array()
+
+    def init_unpickled(self):
+        super(FullBatchLoaderMSE, self).init_unpickled()
+        self._targets_dev_ = None
+
+    def create_minibatch_data(self):
+        super(FullBatchLoaderMSE, self).create_minibatch_data()
+        shape = (self.max_minibatch_size,) + self.original_targets.shape[1:]
+        self.minibatch_targets.reset(
+            numpy.zeros(shape, self.original_targets.dtype))
+
+    def _maybe_upload(self):
+        super(FullBatchLoaderMSE, self)._maybe_upload()
+        if self._dataset_dev_ is not None:
+            self._targets_dev_ = jax.device_put(
+                self.original_targets, self.device.jax_device)
+
+    def fill_minibatch(self):
+        super(FullBatchLoaderMSE, self).fill_minibatch()
+        size = self.minibatch_size
+        idx = self.minibatch_indices.mem[:size]
+        if self._targets_dev_ is not None:
+            full_idx = numpy.zeros(self.max_minibatch_size, INDEX_DTYPE)
+            full_idx[:size] = idx
+            self.minibatch_targets.devmem = self._gather_jit_(
+                self._targets_dev_, jnp.asarray(full_idx),
+                numpy.int32(size))
+        else:
+            self.minibatch_targets.mem[:size] = self.original_targets[idx]
+
+    def __getstate__(self):
+        state = super(FullBatchLoaderMSE, self).__getstate__()
+        state.pop("original_targets", None)
+        return state

@@ -189,6 +189,10 @@ class Array(Pickleable):
     def map_write(self):
         """Host mirror current *and* about to be written."""
         self.map_read()
+        if self._mem is not None and not self._mem.flags.writeable:
+            # map_read may have adopted a read-only view of the device
+            # buffer; writers need their own copy
+            self._mem = numpy.array(self._mem)
         self._state = HOST_DIRTY
         return self
 
@@ -196,6 +200,8 @@ class Array(Pickleable):
         """Host will be fully overwritten — skip the device→host copy."""
         if self._mem is None and self._devmem_ is not None:
             self._mem = numpy.zeros(self._devmem_.shape, self._devmem_.dtype)
+        elif self._mem is not None and not self._mem.flags.writeable:
+            self._mem = numpy.array(self._mem)
         self._state = HOST_DIRTY
         return self
 

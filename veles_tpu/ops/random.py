@@ -87,7 +87,11 @@ class Uniform(AcceleratedUnit):
     def initialize(self, device=None, **kwargs):
         self.output.reset(numpy.zeros(self.output_shape, numpy.float32))
         gen = prng.get(self.prng_name)
-        self.key.reset(numpy.zeros(2, numpy.uint32))
+        # key width depends on the active jax PRNG impl (threefry=2 words,
+        # rbg=4) — size the buffer from an actual key
+        key_shape = numpy.asarray(
+            jax.random.key_data(gen.peek_key())).shape
+        self.key.reset(numpy.zeros(key_shape, numpy.uint32))
         self._refresh_key(gen)
         super(Uniform, self).initialize(device=device, **kwargs)
 

@@ -46,13 +46,15 @@ class RandomGenerator(Pickleable):
 
     def seed(self, seed):
         """(Re)seed both streams (ref: random_generator.py:106)."""
-        if isinstance(seed, (bytes, str)):
+        if isinstance(seed, str):
+            seed = seed.encode()
+        if isinstance(seed, numpy.ndarray):
+            seed = seed.tobytes()
+        if isinstance(seed, bytes):
+            # hash, don't sum: entropy-file seeding must be order-sensitive
+            import hashlib
             seed = int.from_bytes(
-                seed.encode() if isinstance(seed, str) else seed,
-                "little") % (1 << 63)
-        elif isinstance(seed, numpy.ndarray):
-            seed = int(numpy.sum(seed.view(numpy.uint8).astype(numpy.uint64))
-                       % (1 << 63))
+                hashlib.sha256(seed).digest()[:8], "little") % (1 << 63)
         self._seed = int(seed)
         self._counter = 0
         self._np_ = None

@@ -53,9 +53,11 @@ class MappedUnitRegistry(UnitRegistry):
         mapping = namespace.get("MAPPING")
         if mapping is None:
             return
-        # find the hierarchy root: nearest base flagged as mapping_root
+        # find the hierarchy root: nearest base that *declares*
+        # mapping_root in its own body (inherited copies don't count, or
+        # intermediate bases would capture the family)
         for base in cls.__mro__[1:]:
-            if getattr(base, "mapping_root", False):
+            if vars(base).get("mapping_root", False):
                 MappedUnitRegistry.registries.setdefault(
                     base.__name__, {})[mapping] = cls
                 break
