@@ -1,0 +1,373 @@
+"""NN layer + trainer tests (the Znicz-surface reconstruction,
+SURVEY.md §7 steps 6-7 model layer)."""
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.memory import Array
+from veles_tpu.models import (
+    All2All, All2AllSoftmax, All2AllTanh, AvgPooling, Conv, DecisionGD,
+    Depooling, DropoutForward, EvaluatorMSE, EvaluatorSoftmax,
+    GradientDescent, MaxPooling, Rollback)
+from veles_tpu.models.solvers import SOLVERS
+from veles_tpu.accelerated_units import AcceleratedWorkflow
+from veles_tpu.workflow import Workflow
+
+
+@pytest.fixture(scope="module")
+def device():
+    return Device(backend="numpy")
+
+
+class BlobsLoader(FullBatchLoader):
+    """Linearly separable 3-class blobs: 150 train / 60 valid."""
+
+    def load_data(self):
+        rng = numpy.random.default_rng(3)
+        n_per, classes, dim = 70, 3, 8
+        centers = rng.normal(scale=4.0, size=(classes, dim))
+        data, labels = [], []
+        for c in range(classes):
+            data.append(centers[c] + rng.normal(size=(n_per, dim)))
+            labels += [c] * n_per
+        data = numpy.concatenate(data).astype(numpy.float32)
+        labels = numpy.array(labels)
+        perm = rng.permutation(len(data))
+        data, labels = data[perm], labels[perm]
+        self.class_lengths[:] = [0, 60, len(data) - 60]
+        # loader layout is [test | valid | train]
+        self.original_data = data
+        self.original_labels = labels.tolist()
+
+
+def build_mlp_workflow(device, solver="sgd", lr=0.05, dropout=False,
+                       **gd_kwargs):
+    wf = AcceleratedWorkflow(None, name="mlp")
+    loader = BlobsLoader(wf, minibatch_size=32, prng_key="blobs")
+    loader.initialize(device=device)
+
+    layers = []
+    l1 = All2AllTanh(wf, output_sample_shape=(16,), name="fc1")
+    l1.input = loader.minibatch_data
+    layers.append(l1)
+    if dropout:
+        dr = DropoutForward(wf, dropout_ratio=0.2, name="drop")
+        layers.append(dr)
+    head = All2AllSoftmax(wf, output_sample_shape=(3,), name="head")
+    layers.append(head)
+    prev_out = loader.minibatch_data
+    for u in layers:
+        u.input = prev_out
+        u.initialize(device=device)
+        prev_out = u.output
+
+    ev = EvaluatorSoftmax(wf, name="ev")
+    ev.output = head.output
+    ev.labels = loader.minibatch_labels
+    ev.loader = loader
+    ev.initialize(device=device)
+
+    gd = GradientDescent(wf, forwards=layers, evaluator=ev, loader=loader,
+                         solver=solver, learning_rate=lr, **gd_kwargs)
+    gd.initialize(device=device)
+    return wf, loader, layers, ev, gd
+
+
+def run_epochs(loader, gd, n_epochs=3, extra=None):
+    walks = 0
+    while walks < n_epochs:
+        loader.run()
+        gd.run()
+        if extra is not None:
+            extra()
+        if loader.train_ended:
+            walks += 1
+
+
+class TestForwardLayers:
+    def test_all2all_shapes_and_values(self, device):
+        wf = AcceleratedWorkflow(None, name="fc")
+        u = All2All(wf, output_sample_shape=(4,))
+        u.input = Array(numpy.ones((8, 5), numpy.float32))
+        u.initialize(device=device)
+        assert u.weights.shape == (5, 4)
+        u.run()
+        u.output.map_read()
+        want = numpy.ones((8, 5)) @ u.weights.mem + u.bias.mem
+        assert numpy.allclose(u.output.mem, want, atol=0.05)
+
+    def test_softmax_probs(self, device):
+        wf = AcceleratedWorkflow(None, name="sm")
+        u = All2AllSoftmax(wf, output_sample_shape=(7,))
+        u.input = Array(numpy.random.rand(4, 3).astype(numpy.float32))
+        u.initialize(device=device)
+        u.run()
+        u.output.map_read()
+        assert numpy.allclose(u.output.mem.sum(axis=1), 1.0, atol=1e-3)
+        assert u.max_idx[...].shape == (4,)
+
+    def test_conv_same_padding(self, device):
+        wf = AcceleratedWorkflow(None, name="conv")
+        u = Conv(wf, n_kernels=6, kx=3, ky=3, padding="same")
+        u.input = Array(numpy.random.rand(2, 8, 8, 3).astype(numpy.float32))
+        u.initialize(device=device)
+        assert u.weights.shape == (3, 3, 3, 6)
+        u.run()
+        assert u.output.shape == (2, 8, 8, 6)
+
+    def test_conv_stride_valid(self, device):
+        wf = AcceleratedWorkflow(None, name="conv2")
+        u = Conv(wf, n_kernels=4, kx=2, ky=2, sliding=(2, 2),
+                 padding="valid")
+        u.input = Array(numpy.random.rand(2, 8, 8, 3).astype(numpy.float32))
+        u.initialize(device=device)
+        u.run()
+        assert u.output.shape == (2, 4, 4, 4)
+
+    def test_conv_asymmetric_stride_is_xy(self, device):
+        # znicz convention: sliding=(sx, sy); x is horizontal (W axis)
+        wf = AcceleratedWorkflow(None, name="conv-asym")
+        u = Conv(wf, n_kernels=2, kx=1, ky=1, sliding=(4, 2),
+                 padding="valid")
+        u.input = Array(numpy.random.rand(1, 8, 8, 3).astype(numpy.float32))
+        u.initialize(device=device)
+        u.run()
+        # H strided by sy=2 -> 4; W strided by sx=4 -> 2
+        assert u.output.shape == (1, 4, 2, 2)
+
+    def test_pooling_asymmetric_window_is_xy(self, device):
+        wf = AcceleratedWorkflow(None, name="pool-asym")
+        u = MaxPooling(wf, kx=4, ky=2)  # horizontal window 4, vertical 2
+        u.input = Array(numpy.random.rand(1, 8, 8, 1).astype(numpy.float32))
+        u.initialize(device=device)
+        u.run()
+        assert u.output.shape == (1, 4, 2, 1)
+
+    def test_conv_grouping(self, device):
+        wf = AcceleratedWorkflow(None, name="conv3")
+        u = Conv(wf, n_kernels=8, kx=3, ky=3, n_groups=2, padding="same")
+        u.input = Array(numpy.random.rand(2, 6, 6, 4).astype(numpy.float32))
+        u.initialize(device=device)
+        assert u.weights.shape == (3, 3, 2, 8)
+        u.run()
+        assert u.output.shape == (2, 6, 6, 8)
+
+    def test_pooling(self, device):
+        wf = AcceleratedWorkflow(None, name="pool")
+        x = numpy.arange(16, dtype=numpy.float32).reshape(1, 4, 4, 1)
+        mp = MaxPooling(wf, kx=2, ky=2)
+        mp.input = Array(x)
+        mp.initialize(device=device)
+        mp.run()
+        mp.output.map_read()
+        assert numpy.allclose(mp.output.mem[0, :, :, 0],
+                              [[5, 7], [13, 15]])
+        ap = AvgPooling(wf, kx=2, ky=2)
+        ap.input = Array(x)
+        ap.initialize(device=device)
+        ap.run()
+        ap.output.map_read()
+        assert numpy.allclose(ap.output.mem[0, :, :, 0],
+                              [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_depooling_inverts_shape(self, device):
+        wf = AcceleratedWorkflow(None, name="depool")
+        u = Depooling(wf, kx=2, ky=2)
+        u.input = Array(numpy.random.rand(1, 4, 4, 2).astype(numpy.float32))
+        u.initialize(device=device)
+        u.run()
+        assert u.output.shape == (1, 8, 8, 2)
+
+    def test_forward_chain_fuses(self, device):
+        wf = AcceleratedWorkflow(None, name="chain")
+        a = All2AllTanh(wf, output_sample_shape=(6,), name="a")
+        a.input = Array(numpy.random.rand(4, 8).astype(numpy.float32))
+        b = All2AllSoftmax(wf, output_sample_shape=(3,), name="b")
+        b.input = a.output
+        a.link_from(wf.start_point)
+        b.link_from(a)
+        wf.end_point.link_from(b)
+        wf.initialize(device=device)
+        assert len(wf._segments_) == 1
+        wf.run()
+        b.output.map_read()
+        assert numpy.allclose(b.output.mem.sum(axis=1), 1.0, atol=1e-3)
+
+
+class TestTrainer:
+    def test_mlp_learns_blobs(self, device):
+        wf, loader, layers, ev, gd = build_mlp_workflow(device, lr=0.1)
+        errors = []
+
+        run_epochs(loader, gd, n_epochs=5)
+        # final validation pass
+        n_err = total = 0
+        while True:
+            loader.run()
+            gd.run()
+            if loader.minibatch_class == VALID:
+                gd.n_err.map_read()
+                n_err += int(gd.n_err.mem)
+                total += loader.minibatch_size
+            if loader.epoch_ended:
+                break
+        err_pct = 100.0 * n_err / max(total, 1)
+        assert err_pct < 10.0, "MLP failed to learn blobs: %.1f%%" % err_pct
+
+    def test_eval_batches_do_not_update(self, device):
+        wf, loader, layers, ev, gd = build_mlp_workflow(device)
+        # force a validation minibatch
+        while True:
+            loader.run()
+            if loader.minibatch_class == VALID:
+                break
+        w_before = numpy.array(layers[0].weights[...])
+        gd.run()
+        w_after = numpy.array(layers[0].weights[...])
+        assert numpy.array_equal(w_before, w_after)
+
+    def test_train_batches_do_update(self, device):
+        wf, loader, layers, ev, gd = build_mlp_workflow(device)
+        while True:
+            loader.run()
+            if loader.minibatch_class == TRAIN:
+                break
+        w_before = numpy.array(layers[0].weights[...])
+        gd.run()
+        w_after = numpy.array(layers[0].weights[...])
+        assert not numpy.array_equal(w_before, w_after)
+
+    @pytest.mark.parametrize("solver", sorted(SOLVERS))
+    def test_all_solvers_reduce_loss(self, device, solver):
+        lr = {"sgd": 0.1, "adagrad": 0.2, "adadelta": 1.0,
+              "adam": 0.01}[solver]
+        wf, loader, layers, ev, gd = build_mlp_workflow(
+            device, solver=solver, lr=lr)
+        first_losses, last_losses = [], []
+
+        def collect():
+            if loader.minibatch_class == TRAIN:
+                gd.loss.map_read()
+                (first_losses if loader.epoch_number < 1
+                 else last_losses).append(float(gd.loss.mem))
+
+        run_epochs(loader, gd, n_epochs=4, extra=collect)
+        assert numpy.mean(last_losses[-5:]) < numpy.mean(first_losses[:5])
+
+    def test_dropout_training(self, device):
+        wf, loader, layers, ev, gd = build_mlp_workflow(
+            device, dropout=True, lr=0.1)
+        run_epochs(loader, gd, n_epochs=2)
+        gd.loss.map_read()
+        assert numpy.isfinite(gd.loss.mem)
+
+    def test_mse_trainer(self, device):
+        # autoencoder-style: reconstruct input via the real MSE loader
+        # path (original_targets -> minibatch_targets device gather)
+        from veles_tpu.loader.fullbatch import FullBatchLoaderMSE
+
+        class BlobsAELoader(FullBatchLoaderMSE, BlobsLoader):
+            def load_data(self):
+                BlobsLoader.load_data(self)
+                self.original_targets = self.original_data.copy()
+                self.original_labels = None
+
+        wf = AcceleratedWorkflow(None, name="ae")
+        loader = BlobsAELoader(wf, minibatch_size=32, prng_key="ae")
+        loader.initialize(device=device)
+        enc = All2AllTanh(wf, output_sample_shape=(4,), name="enc")
+        enc.input = loader.minibatch_data
+        enc.initialize(device=device)
+        dec = All2All(wf, output_sample_shape=(8,), name="dec")
+        dec.input = enc.output
+        dec.initialize(device=device)
+        ev = EvaluatorMSE(wf)
+        ev.output = dec.output
+        ev.target = loader.minibatch_targets
+        ev.loader = loader
+        ev.initialize(device=device)
+        gd = GradientDescent(wf, forwards=[enc, dec], evaluator=ev,
+                             loader=loader, learning_rate=0.02)
+        gd.initialize(device=device)
+        losses = []
+        walks = 0
+        while walks < 3:
+            loader.run()
+            gd.run()
+            if loader.minibatch_class == TRAIN:
+                gd.loss.map_read()
+                losses.append(float(gd.loss.mem))
+            if loader.train_ended:
+                walks += 1
+        assert losses[-1] < losses[0]
+
+    def test_mse_without_targets_fails_loudly(self, device):
+        from veles_tpu.units import MissingDemand
+        wf = AcceleratedWorkflow(None, name="mse-bad")
+        loader = BlobsLoader(wf, minibatch_size=32, prng_key="mseb")
+        loader.initialize(device=device)
+        fc = All2All(wf, output_sample_shape=(8,))
+        fc.input = loader.minibatch_data
+        fc.initialize(device=device)
+        ev = EvaluatorMSE(wf)
+        ev.output = fc.output
+        ev.target = fc.output
+        ev.loader = loader
+        ev.initialize(device=device)
+        gd = GradientDescent(wf, forwards=[fc], evaluator=ev,
+                             loader=loader)
+        with pytest.raises(MissingDemand):
+            gd.initialize(device=device)
+
+    def test_per_layer_lr_override(self, device):
+        wf, loader, layers, ev, gd = build_mlp_workflow(device, lr=0.1)
+        layers[0].learning_rate = 0.0  # freeze first layer
+        gd._train_step_ = None  # rebuild with new hp
+        while True:
+            loader.run()
+            if loader.minibatch_class == TRAIN:
+                break
+        w0 = numpy.array(layers[0].weights[...])
+        wh = numpy.array(layers[-1].weights[...])
+        gd.run()
+        assert numpy.allclose(numpy.array(layers[0].weights[...]), w0)
+        assert not numpy.array_equal(
+            numpy.array(layers[-1].weights[...]), wh)
+
+
+class TestDecisionRollback:
+    def test_decision_tracks_and_completes(self, device):
+        wf, loader, layers, ev, gd = build_mlp_workflow(device, lr=0.1)
+        dec = DecisionGD(wf, fail_iterations=2, max_epochs=3)
+        dec.loader = loader
+        dec.trainer = gd
+        dec.initialize()
+        while not dec.complete:
+            loader.run()
+            gd.run()
+            dec.run()
+        assert loader.epoch_number <= 4
+        m = dec.get_metric_values()
+        assert "min_validation_n_err" in m
+
+    def test_rollback_restores_best(self, device):
+        wf, loader, layers, ev, gd = build_mlp_workflow(device, lr=0.1)
+        dec = DecisionGD(wf, fail_iterations=100)
+        dec.loader = loader
+        dec.trainer = gd
+        dec.initialize()
+        rb = Rollback(wf, fail_iterations=1, lr_plus=0.5)
+        rb.decision = dec
+        rb.trainer = gd
+        rb.initialize()
+        run_epochs(loader, gd, n_epochs=2,
+                   extra=lambda: (dec.run(), rb.run()))
+        assert rb.saved_params is not None
+        lr_before = gd.lr_multiplier
+        rb.restore()
+        assert gd.lr_multiplier == lr_before * 0.5
+        w = numpy.array(layers[0].weights[...])
+        assert numpy.allclose(w, rb.saved_params[0]["weights"])

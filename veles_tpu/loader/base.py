@@ -303,14 +303,19 @@ class Loader(Unit, ILoader, IDistributable, IResultProvider):
 
     def _on_successful_serve(self):
         self.samples_served += self.minibatch_size
-        if self.samples_served and self.effective_total_samples:
+        if not self.is_slave and self.effective_total_samples:
+            # workers get epoch_number from the coordinator; deriving it
+            # from a worker's partial samples_served would clobber it
             self.epoch_number = \
                 self.samples_served // self.effective_total_samples
         self._update_flags()
-        for jobs in self.pending_minibatches_.values():
-            if (self.minibatch_offset, self.minibatch_size) in jobs:
-                jobs.remove((self.minibatch_offset, self.minibatch_size))
-                break
+        # only clear the standalone (None) slot here: completed worker
+        # jobs were already popped in apply_data_from_slave, and offsets
+        # repeat across epochs so a blind scan could delete another
+        # worker's identical in-flight job
+        jobs = self.pending_minibatches_.get(None)
+        if jobs and (self.minibatch_offset, self.minibatch_size) in jobs:
+            jobs.remove((self.minibatch_offset, self.minibatch_size))
 
     # -- distributed contract (ref: base.py:628-687) ---------------------------
 

@@ -73,8 +73,9 @@ class FullBatchLoader(Loader):
 
     def _post_load(self):
         # normalize the whole dataset once (device path applies it here
-        # rather than per minibatch)
-        if self.class_lengths[TRAIN] > 0:
+        # rather than per minibatch); an inference-only loader whose
+        # normalizer state was transferred from training still normalizes
+        if self.normalizer.is_initialized:
             self.original_data = numpy.ascontiguousarray(
                 self.normalizer.normalize(self.original_data))
         self._numeric_labels = None

@@ -74,11 +74,20 @@ class MinibatchesLoader(Loader):
         with gzip.open(self.path, "rb") as f:
             header = pickle.load(f)
             self.max_minibatch_size = header["max_minibatch_size"]
+            want_shape = tuple(header["data_shape"])
+            want_dtype = header["data_dtype"]
             while True:
                 try:
                     ci, size, data, lbls = pickle.load(f)
                 except EOFError:
                     break
+                if tuple(data.shape[1:]) != want_shape \
+                        or str(data.dtype) != want_dtype:
+                    raise ValueError(
+                        "corrupt minibatch stream %s: chunk %s/%s vs "
+                        "header %s/%s" % (self.path, data.shape[1:],
+                                          data.dtype, want_shape,
+                                          want_dtype))
                 chunks[ci].append(data[:size])
                 labels[ci].append(lbls[:size])
         datas, lbl_list = [], []

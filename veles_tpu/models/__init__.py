@@ -1,0 +1,39 @@
+"""models — the NN layer/trainer surface (reconstruction of the Znicz
+plugin, whose source is absent upstream — see SURVEY.md §0; the surface
+is pinned by docs/source/manualrst_veles_algorithms.rst:150-164 and
+BASELINE.json's configs).
+
+TPU-first redesign of the training path: the reference hand-wrote one
+backward (GD) unit per layer kind with bespoke CUDA/OpenCL gradient
+kernels; here the :class:`~veles_tpu.models.gd.GradientDescent` trainer
+unit composes the forward chain + evaluator loss into ONE jitted
+``jax.value_and_grad`` program with the solver update fused in — forward,
+backward, optimizer, and (when data-parallel) the gradient ``psum`` all
+execute as a single XLA program per minibatch.
+
+Modules:
+- nn_units:    ForwardBase (params, smart weight init, per-layer hypers)
+- activations: activation registry (linear/tanh/relu/sigmoid/sincos/...)
+- all2all:     fully-connected layers incl. softmax head
+- conv:        convolution (+grouping/padding/sliding) and deconvolution
+- pooling:     max/avg pooling and depooling
+- dropout:     dropout forward
+- evaluator:   softmax / MSE evaluators (loss + error metrics)
+- solvers:     sgd / momentum / adagrad / adadelta / adam registry
+- lr_adjust:   learning-rate schedules
+- gd:          the fused autodiff trainer
+- decision:    DecisionGD stopping logic + Rollback
+"""
+
+from veles_tpu.models.all2all import (  # noqa: F401
+    All2All, All2AllRELU, All2AllSigmoid, All2AllSoftmax,
+    All2AllStrictRELU, All2AllTanh)
+from veles_tpu.models.activations import Activation  # noqa: F401
+from veles_tpu.models.conv import Conv, ConvRELU, ConvTanh, Deconv  # noqa: F401
+from veles_tpu.models.pooling import (  # noqa: F401
+    AvgPooling, Depooling, MaxPooling)
+from veles_tpu.models.dropout import DropoutForward  # noqa: F401
+from veles_tpu.models.evaluator import (  # noqa: F401
+    EvaluatorMSE, EvaluatorSoftmax)
+from veles_tpu.models.gd import GradientDescent  # noqa: F401
+from veles_tpu.models.decision import DecisionGD, Rollback  # noqa: F401

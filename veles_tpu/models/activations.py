@@ -1,0 +1,88 @@
+"""Activation function registry (reconstruction of znicz activation
+units, surface per manualrst_veles_algorithms.rst "Activation function
+customization (like SinCos activation function)").
+
+Every activation is a pure jax function usable inside any traced step;
+:class:`Activation` wraps one as a standalone forward unit for graphs
+that insert explicit activation nodes.
+"""
+
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Array
+from veles_tpu.units import MissingDemand
+
+
+def linear(x):
+    return x
+
+
+def tanh(x):
+    # znicz used the LeCun-scaled tanh: 1.7159 * tanh(2/3 x)
+    return 1.7159 * jnp.tanh(0.6666 * x)
+
+
+def relu(x):
+    # znicz "relu" was log(1 + exp(x)) (softplus); strict_relu is max(0,x).
+    # logaddexp is the overflow-safe form (log1p(exp(88.)) is inf in f32)
+    return jnp.logaddexp(x, 0.0)
+
+
+def strict_relu(x):
+    return jnp.maximum(x, 0)
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + jnp.exp(-x))
+
+
+def sincos(x):
+    """Even feature indices get sin, odd get cos."""
+    idx = jnp.arange(x.shape[-1])
+    return jnp.where(idx % 2 == 0, jnp.sin(x), jnp.cos(x))
+
+
+ACTIVATIONS = {
+    "linear": linear,
+    "tanh": tanh,
+    "relu": relu,
+    "strict_relu": strict_relu,
+    "sigmoid": sigmoid,
+    "sincos": sincos,
+}
+
+
+def get_activation(name):
+    if callable(name):
+        return name
+    try:
+        return ACTIVATIONS[name]
+    except KeyError:
+        raise KeyError("unknown activation %r (have: %s)"
+                       % (name, sorted(ACTIVATIONS)))
+
+
+class Activation(AcceleratedUnit):
+    """Standalone activation node."""
+
+    READS = ("input",)
+    WRITES = ("output",)
+
+    def __init__(self, workflow, activation="linear", **kwargs):
+        super(Activation, self).__init__(workflow, **kwargs)
+        self.activation = activation
+        self.input = None
+        self.output = Array()
+        self.demand("input")
+
+    def initialize(self, device=None, **kwargs):
+        if not isinstance(self.input, Array) or not bool(self.input):
+            raise MissingDemand(self, {"input"})
+        self.output.reset(numpy.zeros(self.input.shape,
+                                      self.input.dtype))
+        super(Activation, self).initialize(device=device, **kwargs)
+
+    def step(self, input):
+        return {"output": get_activation(self.activation)(input)}

@@ -1,0 +1,102 @@
+"""Fully-connected layers (reconstruction of znicz all2all, surface per
+manualrst_veles_algorithms.rst "Fully-connected Neural Networks"; the
+GEMM rides the MXU through :func:`veles_tpu.ops.gemm.matmul`)."""
+
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.memory import Array
+from veles_tpu.models.activations import get_activation
+from veles_tpu.models.nn_units import ForwardBase
+from veles_tpu.ops.gemm import matmul
+
+
+class All2All(ForwardBase):
+    """y = activation(x @ W + b) with x flattened to [batch, features]
+    (znicz All2All; weights stored [in, out] so the forward GEMM is
+    layout-natural for the MXU)."""
+
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, output_sample_shape=None,
+                 output_samples_number=None, activation=None, **kwargs):
+        super(All2All, self).__init__(workflow, **kwargs)
+        if output_sample_shape is None and output_samples_number is None:
+            raise ValueError("output_sample_shape is required")
+        self.output_sample_shape = tuple(
+            numpy.atleast_1d(output_sample_shape
+                             or output_samples_number).tolist())
+        self.activation = activation or self.ACTIVATION
+
+    @property
+    def neurons_number(self):
+        return int(numpy.prod(self.output_sample_shape))
+
+    def output_shape_for(self, input_shape):
+        return (input_shape[0],) + self.output_sample_shape
+
+    def fill_params(self):
+        fan_in = int(numpy.prod(self.input.shape[1:]))
+        fan_out = self.neurons_number
+        self.weights.reset(numpy.zeros((fan_in, fan_out), numpy.float32))
+        self._fill(self.weights.mem, self.weights_filling,
+                   self.weights_stddev, fan_in, fan_out)
+        if self.include_bias:
+            self.bias.reset(numpy.zeros((fan_out,), numpy.float32))
+            self._fill(self.bias.mem, self.bias_filling,
+                       self.bias_stddev or 0.0, fan_in, fan_out)
+
+    def apply(self, params, x):
+        y = matmul(x.reshape(x.shape[0], -1), params["weights"])
+        if self.include_bias:
+            y = y + params["bias"]
+        y = get_activation(self.activation)(y)
+        return y.reshape((x.shape[0],) + self.output_sample_shape)
+
+
+class All2AllTanh(All2All):
+    ACTIVATION = "tanh"
+
+
+class All2AllRELU(All2All):
+    ACTIVATION = "relu"
+
+
+class All2AllStrictRELU(All2All):
+    ACTIVATION = "strict_relu"
+
+
+class All2AllSigmoid(All2All):
+    ACTIVATION = "sigmoid"
+
+
+class All2AllSoftmax(All2All):
+    """FC + softmax head (znicz All2AllSoftmax): ``output`` holds the
+    probabilities, ``max_idx`` the argmax per sample."""
+
+    ACTIVATION = "linear"
+    WRITES = ("output", "max_idx")
+
+    def __init__(self, workflow, **kwargs):
+        super(All2AllSoftmax, self).__init__(workflow, **kwargs)
+        self.max_idx = Array()
+
+    def initialize(self, device=None, **kwargs):
+        super(All2AllSoftmax, self).initialize(device=device, **kwargs)
+        self.max_idx.reset(numpy.zeros((self.input.shape[0],),
+                                       numpy.int32))
+
+    def logits(self, params, x):
+        """Pre-softmax scores — the trainer's softmax-CE loss composes
+        over these for numerical stability."""
+        return super(All2AllSoftmax, self).apply(params, x)
+
+    def apply(self, params, x):
+        z = self.logits(params, x)
+        probs = jnp.exp(z - jnp.max(z, axis=-1, keepdims=True))
+        return probs / jnp.sum(probs, axis=-1, keepdims=True)
+
+    def step(self, input, **params):
+        probs = self.apply(params, input)
+        return {"output": probs,
+                "max_idx": jnp.argmax(probs, axis=-1).astype(jnp.int32)}

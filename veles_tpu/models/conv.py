@@ -1,0 +1,173 @@
+"""Convolutional layers (reconstruction of znicz conv, surface per
+manualrst_veles_algorithms.rst: grouping, padding, stride — "sliding" in
+Veles terms — and Deconvolution).
+
+Data layout is NHWC with HWIO kernels — the layouts XLA:TPU tiles onto
+the MXU without transposes.  The convolution itself is
+``lax.conv_general_dilated`` (one XLA op; the reference lowered conv to
+im2col + its hand-tiled GEMM).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu import dtypes
+from veles_tpu.models.activations import get_activation
+from veles_tpu.models.nn_units import ForwardBase
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v[:2])
+    return (int(v), int(v))
+
+
+class Conv(ForwardBase):
+    """y = activation(conv(x, W) + b), x: [N, H, W, C]
+    (znicz conv.Conv; kwargs kx/ky/n_kernels/sliding/padding match the
+    reference surface, grouping via ``n_groups``)."""
+
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, n_kernels=None, kx=3, ky=3,
+                 sliding=(1, 1), padding="same", n_groups=1,
+                 activation=None, **kwargs):
+        super(Conv, self).__init__(workflow, **kwargs)
+        if n_kernels is None:
+            raise ValueError("n_kernels is required")
+        self.n_kernels = int(n_kernels)
+        self.kx, self.ky = int(kx), int(ky)
+        #: user-facing (sliding_x, sliding_y) — the znicz convention
+        #: (kx = horizontal); internally NHWC wants (stride_H, stride_W)
+        self.sliding = _pair(sliding)
+        self.padding = padding  # "same" | "valid" | ((t,b),(l,r)) | int
+        self.n_groups = int(n_groups)
+        self.activation = activation or self.ACTIVATION
+
+    @property
+    def _hw_strides(self):
+        sx, sy = self.sliding
+        return (sy, sx)
+
+    def _lax_padding(self):
+        if isinstance(self.padding, str):
+            return self.padding.upper()
+        if isinstance(self.padding, int):
+            p = self.padding
+            return ((p, p), (p, p))
+        return tuple(tuple(int(x) for x in p) for p in self.padding)
+
+    def output_shape_for(self, input_shape):
+        n, h, w, _ = input_shape
+        out = jax.eval_shape(
+            lambda x, k: self._conv(x, k),
+            jax.ShapeDtypeStruct(input_shape, jnp.float32),
+            jax.ShapeDtypeStruct(self._kernel_shape(input_shape[-1]),
+                                 jnp.float32))
+        return out.shape
+
+    def _kernel_shape(self, in_channels):
+        return (self.ky, self.kx, in_channels // self.n_groups,
+                self.n_kernels)
+
+    def _conv(self, x, kernel):
+        cd = dtypes.compute_dtype()
+        return jax.lax.conv_general_dilated(
+            x.astype(cd), kernel.astype(cd),
+            window_strides=self._hw_strides,
+            padding=self._lax_padding(),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.n_groups,
+            precision=dtypes.matmul_precision(),
+            preferred_element_type=dtypes.accum_dtype())
+
+    def fill_params(self):
+        in_ch = self.input.shape[-1]
+        kshape = self._kernel_shape(in_ch)
+        fan_in = self.kx * self.ky * in_ch // self.n_groups
+        fan_out = self.n_kernels
+        self.weights.reset(numpy.zeros(kshape, numpy.float32))
+        self._fill(self.weights.mem, self.weights_filling,
+                   self.weights_stddev, fan_in, fan_out)
+        if self.include_bias:
+            self.bias.reset(numpy.zeros((self.n_kernels,), numpy.float32))
+            self._fill(self.bias.mem, self.bias_filling,
+                       self.bias_stddev or 0.0, fan_in, fan_out)
+
+    def apply(self, params, x):
+        y = self._conv(x, params["weights"])
+        if self.include_bias:
+            y = y + params["bias"]
+        return get_activation(self.activation)(y)
+
+
+class ConvTanh(Conv):
+    ACTIVATION = "tanh"
+
+
+class ConvRELU(Conv):
+    ACTIVATION = "relu"
+
+
+class ConvStrictRELU(Conv):
+    ACTIVATION = "strict_relu"
+
+
+class Deconv(ForwardBase):
+    """Transposed convolution (znicz deconv; extras item 1) — used by the
+    convolutional autoencoders."""
+
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, n_kernels=None, kx=3, ky=3,
+                 sliding=(1, 1), padding="same", activation=None, **kwargs):
+        super(Deconv, self).__init__(workflow, **kwargs)
+        if n_kernels is None:
+            raise ValueError("n_kernels is required")
+        self.n_kernels = int(n_kernels)
+        self.kx, self.ky = int(kx), int(ky)
+        self.sliding = _pair(sliding)  # (sx, sy), znicz convention
+        self.padding = padding
+        self.activation = activation or self.ACTIVATION
+
+    def _kernel_shape(self, in_channels):
+        return (self.ky, self.kx, self.n_kernels, in_channels)
+
+    def _deconv(self, x, kernel):
+        cd = dtypes.compute_dtype()
+        pad = self.padding.upper() if isinstance(self.padding, str) \
+            else self.padding
+        sx, sy = self.sliding
+        return jax.lax.conv_transpose(
+            x.astype(cd), kernel.astype(cd),
+            strides=(sy, sx), padding=pad,
+            dimension_numbers=("NHWC", "HWOI", "NHWC"),
+            precision=dtypes.matmul_precision())
+
+    def output_shape_for(self, input_shape):
+        out = jax.eval_shape(
+            lambda x, k: self._deconv(x, k),
+            jax.ShapeDtypeStruct(input_shape, jnp.float32),
+            jax.ShapeDtypeStruct(self._kernel_shape(input_shape[-1]),
+                                 jnp.float32))
+        return out.shape
+
+    def fill_params(self):
+        in_ch = self.input.shape[-1]
+        kshape = self._kernel_shape(in_ch)
+        fan_in = self.kx * self.ky * in_ch
+        fan_out = self.n_kernels
+        self.weights.reset(numpy.zeros(kshape, numpy.float32))
+        self._fill(self.weights.mem, self.weights_filling,
+                   self.weights_stddev, fan_in, fan_out)
+        if self.include_bias:
+            self.bias.reset(numpy.zeros((self.n_kernels,), numpy.float32))
+            self._fill(self.bias.mem, self.bias_filling,
+                       self.bias_stddev or 0.0, fan_in, fan_out)
+
+    def apply(self, params, x):
+        y = self._deconv(x, params["weights"])
+        if self.include_bias:
+            y = y + params["bias"]
+        return get_activation(self.activation)(y.astype(jnp.float32))

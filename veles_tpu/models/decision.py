@@ -1,0 +1,196 @@
+"""DecisionGD + Rollback — training control (reconstruction of znicz
+decision.py / rollback.py; extras item 11).
+
+DecisionGD accumulates per-class error counts over each epoch, tracks
+the best validation error, raises ``improved`` when a new minimum lands
+(the snapshotter gates on it) and ``complete`` when validation stopped
+improving for ``fail_iterations`` epochs or ``max_epochs`` passed (the
+workflow's end gate).
+
+Rollback keeps a host-side copy of the best parameters; on plateau it
+restores them and scales the trainer's learning rate.
+"""
+
+import numpy
+
+from veles_tpu.loader.base import CLASS_NAME, TEST, TRAIN, VALID
+from veles_tpu.mutable import Bool
+from veles_tpu.result_provider import IResultProvider
+from veles_tpu.units import Unit
+
+
+class DecisionGD(Unit, IResultProvider):
+    """Stopping / bookkeeping logic (znicz decision.DecisionGD)."""
+
+    VIEW_GROUP = "PLUMBING"
+
+    def __init__(self, workflow, fail_iterations=100, max_epochs=None,
+                 **kwargs):
+        super(DecisionGD, self).__init__(workflow, **kwargs)
+        self.fail_iterations = fail_iterations
+        self.max_epochs = max_epochs
+        self.loader = None
+        self.trainer = None      # supplies n_err/loss Arrays
+        self.complete = Bool(False, "complete")
+        self.improved = Bool(False, "improved")
+        self.epoch_n_err = [0, 0, 0]
+        self.epoch_samples = [0, 0, 0]
+        self.epoch_loss_sum = [0.0, 0.0, 0.0]
+        self.epoch_metrics = {}
+        self.min_validation_n_err = None
+        self.min_validation_n_err_epoch = -1
+        self.best_train_n_err = None
+        self.demand("loader", "trainer")
+
+    def _loss_driven(self):
+        from veles_tpu.models.evaluator import EvaluatorMSE
+        ev = getattr(self.trainer, "evaluator", None)
+        return isinstance(ev, EvaluatorMSE)
+
+    @property
+    def fail_count(self):
+        return (self.loader.epoch_number -
+                max(self.min_validation_n_err_epoch, 0))
+
+    def run(self):
+        l = self.loader
+        cls = l.minibatch_class
+        self.trainer.n_err.map_read()
+        self.trainer.loss.map_read()
+        self.epoch_n_err[cls] += int(self.trainer.n_err.mem)
+        self.epoch_samples[cls] += l.minibatch_size
+        self.epoch_loss_sum[cls] += float(self.trainer.loss.mem) \
+            * l.minibatch_size
+        self.improved.set(False)
+        if l.epoch_ended:
+            self._on_epoch_ended()
+        if l.train_ended:
+            # end of a full walk: reset train accounting
+            self._maybe_complete()
+            self.epoch_n_err[TRAIN] = 0
+            self.epoch_samples[TRAIN] = 0
+            self.epoch_loss_sum[TRAIN] = 0.0
+
+    def _error_pct(self, cls):
+        n = self.epoch_samples[cls]
+        return 100.0 * self.epoch_n_err[cls] / n if n else 0.0
+
+    def _on_epoch_ended(self):
+        l = self.loader
+        for cls in (TEST, VALID):
+            if self.epoch_samples[cls]:
+                self.epoch_metrics["%s_error_pct" % CLASS_NAME[cls]] = \
+                    self._error_pct(cls)
+                self.epoch_metrics["%s_loss" % CLASS_NAME[cls]] = \
+                    self.epoch_loss_sum[cls] / self.epoch_samples[cls]
+        cls = VALID if self.epoch_samples[VALID] else TEST
+        n_err = self.epoch_n_err[cls]
+        loss = self.epoch_loss_sum[cls] / max(self.epoch_samples[cls], 1)
+        # MSE workflows carry no n_err signal — improvement is tracked on
+        # the validation loss instead (znicz decision tracked epoch_metrics
+        # per evaluator kind)
+        metric = loss if self._loss_driven() else n_err
+        if self.min_validation_n_err is None \
+                or metric < self.min_validation_n_err:
+            self.min_validation_n_err = metric
+            self.min_validation_n_err_epoch = l.epoch_number
+            self.improved.set(True)
+        self.info(
+            "epoch %d: validation err %.2f%% (best %s @ epoch %d), "
+            "val loss %.4f",
+            l.epoch_number, self._error_pct(VALID),
+            self.min_validation_n_err, self.min_validation_n_err_epoch,
+            self.epoch_metrics.get("validation_loss", float("nan")))
+        self._maybe_complete()
+        for cls in (TEST, VALID):
+            self.epoch_n_err[cls] = 0
+            self.epoch_samples[cls] = 0
+            self.epoch_loss_sum[cls] = 0.0
+
+    def _maybe_complete(self):
+        l = self.loader
+        if self.max_epochs is not None \
+                and l.epoch_number >= self.max_epochs:
+            self.complete.set(True)
+        if self.min_validation_n_err is not None \
+                and self.fail_count > self.fail_iterations:
+            self.info("no improvement for %d epochs — stopping",
+                      self.fail_iterations)
+            self.complete.set(True)
+        if self.complete and self._workflow is not None:
+            self._workflow.on_workflow_finished()
+
+    def get_metric_values(self):
+        out = dict(self.epoch_metrics)
+        if self.min_validation_n_err is not None:
+            out["min_validation_n_err"] = self.min_validation_n_err
+            out["min_validation_n_err_epoch"] = \
+                self.min_validation_n_err_epoch
+        return out
+
+
+class Rollback(Unit):
+    """Best-state keeper (znicz rollback; extras item 11): saves params
+    on improvement; after ``fail_iterations`` epochs without improvement
+    restores them and multiplies the trainer's learning rate by
+    ``lr_plus``."""
+
+    VIEW_GROUP = "SERVICE"
+
+    def __init__(self, workflow, fail_iterations=10, lr_plus=0.5, **kwargs):
+        super(Rollback, self).__init__(workflow, **kwargs)
+        self.fail_iterations = fail_iterations
+        self.lr_plus = lr_plus
+        self.decision = None
+        self.trainer = None
+        self.saved_params = None
+        self.saved_opt_state = None
+        self._last_restore_epoch = -1
+        self.demand("decision", "trainer")
+
+    def run(self):
+        d = self.decision
+        if d.improved:
+            self.save()
+        elif (self.saved_params is not None
+              and d.loader.epoch_ended
+              and d.fail_count and d.fail_count % self.fail_iterations == 0
+              and d.loader.epoch_number != self._last_restore_epoch):
+            self.restore()
+            self._last_restore_epoch = d.loader.epoch_number
+
+    def save(self):
+        params = {}
+        for i, u in enumerate(self.trainer.forwards):
+            params[i] = {}
+            for name, arr in u.param_arrays().items():
+                arr.map_read()
+                params[i][name] = numpy.array(arr.mem)
+        # solver state (momentum/Adam moments) belongs to the trajectory:
+        # restoring weights under stale velocity would immediately push
+        # them back toward the diverged region
+        opt = {}
+        for i, layer in self.trainer.opt_state.items():
+            opt[i] = {}
+            for name, slots in layer.items():
+                opt[i][name] = {}
+                for s, arr in slots.items():
+                    arr.map_read()
+                    opt[i][name][s] = numpy.array(arr.mem)
+        self.saved_params = params
+        self.saved_opt_state = opt
+
+    def restore(self):
+        self.info("rolling back to best params; lr *= %s", self.lr_plus)
+        for i, u in enumerate(self.trainer.forwards):
+            for name, arr in u.param_arrays().items():
+                arr.map_invalidate()
+                arr.mem[...] = self.saved_params[i][name]
+                arr.unmap()
+        for i, layer in self.trainer.opt_state.items():
+            for name, slots in layer.items():
+                for s, arr in slots.items():
+                    arr.map_invalidate()
+                    arr.mem[...] = self.saved_opt_state[i][name][s]
+                    arr.unmap()
+        self.trainer.lr_multiplier *= self.lr_plus

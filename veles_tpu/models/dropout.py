@@ -1,0 +1,42 @@
+"""Dropout (reconstruction of znicz dropout; extras item 2).
+
+In the trainer's fused program the mask is drawn from a traced key
+(:meth:`DropoutForward.apply_train`); the in-graph forward step is
+identity scaled for inference, matching the reference's
+forward-vs-training split.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.memory import Array
+from veles_tpu.models.nn_units import ForwardBase
+from veles_tpu.units import MissingDemand
+
+
+class DropoutForward(ForwardBase):
+    """znicz dropout.DropoutForward: ``dropout_ratio`` of inputs zeroed
+    during training; inference passes through unscaled (inverted dropout
+    scales at train time)."""
+
+    PARAMS = ()
+
+    def __init__(self, workflow, dropout_ratio=0.5, **kwargs):
+        super(DropoutForward, self).__init__(workflow, **kwargs)
+        self.dropout_ratio = float(dropout_ratio)
+
+    def fill_params(self):
+        pass
+
+    def output_shape_for(self, input_shape):
+        return input_shape
+
+    def apply(self, params, x):
+        # inference path: identity (inverted dropout)
+        return x
+
+    def apply_train(self, params, x, key):
+        keep = 1.0 - self.dropout_ratio
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)

@@ -1,0 +1,154 @@
+"""Evaluators — loss + error metrics (reconstruction of znicz
+evaluator.EvaluatorSoftmax / EvaluatorMSE; loss surface per
+manualrst_veles_algorithms.rst "Loss functions: mse, softmax").
+
+Each evaluator plays two roles:
+
+- a pure ``loss(y, target, size)`` the trainer traces into its fused
+  autodiff program (``y`` is logits for softmax, raw output for MSE);
+  padded tail rows are masked by ``size``;
+- an in-graph unit computing per-minibatch metrics (n_err / confusion
+  for softmax, mse per sample for MSE) from the forward chain's output.
+
+The unit is not fused: it reads the loader's host-side ``minibatch_size``
+each run (FUSABLE=False keeps the refresh ordered before execution).
+"""
+
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Array
+from veles_tpu.units import MissingDemand
+
+
+class EvaluatorBase(AcceleratedUnit):
+    hide_from_registry = True
+    VIEW_GROUP = "EVALUATOR"
+    FUSABLE = False
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorBase, self).__init__(workflow, **kwargs)
+        self.output = None       # linked from the head forward unit
+        self.batch_size = Array()
+        self.loader = None       # linked for minibatch_size refresh
+        self.demand("output")
+
+    def initialize(self, device=None, **kwargs):
+        if not isinstance(self.output, Array) or not bool(self.output):
+            raise MissingDemand(self, {"output"})
+        self.batch_size.reset(numpy.zeros((), numpy.int32))
+        super(EvaluatorBase, self).initialize(device=device, **kwargs)
+
+    def run(self):
+        if self.loader is not None:
+            self.batch_size.map_invalidate()
+            self.batch_size.mem[...] = self.loader.minibatch_size
+            self.batch_size.unmap()
+        super(EvaluatorBase, self).run()
+
+
+class EvaluatorSoftmax(EvaluatorBase):
+    """Cross-entropy over softmax probabilities; metrics: ``n_err``
+    (miscount in the minibatch) and the ``confusion_matrix``
+    (znicz EvaluatorSoftmax surface)."""
+
+    WRITES = ("n_err", "loss_out")
+
+    def __init__(self, workflow, compute_confusion_matrix=True, **kwargs):
+        super(EvaluatorSoftmax, self).__init__(workflow, **kwargs)
+        self.labels = None       # linked from loader.minibatch_labels
+        self.max_idx = None      # linked from All2AllSoftmax (optional)
+        self.n_err = Array()
+        self.loss_out = Array()
+        self.compute_confusion_matrix = compute_confusion_matrix
+        self.confusion_matrix = Array()
+        self.demand("labels")
+
+    @property
+    def reads(self):
+        return ("output", "labels", "batch_size")
+
+    @property
+    def writes(self):
+        return ("n_err", "loss_out") + (
+            ("confusion_matrix",) if self.compute_confusion_matrix else ())
+
+    def initialize(self, device=None, **kwargs):
+        super(EvaluatorSoftmax, self).initialize(device=device, **kwargs)
+        self.n_err.reset(numpy.zeros((), numpy.int32))
+        self.loss_out.reset(numpy.zeros((), numpy.float32))
+        n_classes = self.output.shape[-1]
+        if self.compute_confusion_matrix:
+            self.confusion_matrix.reset(
+                numpy.zeros((n_classes, n_classes), numpy.int32))
+
+    # -- trainer-facing loss ---------------------------------------------------
+
+    @staticmethod
+    def loss_from_logits(logits, labels, size):
+        """Masked mean softmax cross-entropy over valid rows."""
+        z = logits - jnp.max(logits, axis=-1, keepdims=True)
+        logp = z - jnp.log(jnp.sum(jnp.exp(z), axis=-1, keepdims=True))
+        picked = jnp.take_along_axis(
+            logp, jnp.clip(labels, 0)[:, None].astype(jnp.int32),
+            axis=-1)[:, 0]
+        mask = jnp.arange(logits.shape[0]) < size
+        return -jnp.sum(jnp.where(mask, picked, 0.0)) \
+            / jnp.maximum(size, 1)
+
+    def loss(self, y, labels, size):
+        return self.loss_from_logits(y, labels, size)
+
+    # -- in-graph metrics ------------------------------------------------------
+
+    def step(self, output, labels, batch_size):
+        pred = jnp.argmax(output, axis=-1).astype(jnp.int32)
+        mask = jnp.arange(output.shape[0]) < batch_size
+        wrong = jnp.where(mask, (pred != labels).astype(jnp.int32), 0)
+        out = {"n_err": jnp.sum(wrong),
+               "loss_out": self.loss_from_logits(
+                   jnp.log(jnp.clip(output, 1e-30)), labels, batch_size)}
+        if self.compute_confusion_matrix:
+            n = output.shape[-1]
+            onehot = (jnp.clip(labels, 0)[:, None] ==
+                      jnp.arange(n)[None, :]).astype(jnp.int32)
+            pred_onehot = (pred[:, None] ==
+                           jnp.arange(n)[None, :]).astype(jnp.int32)
+            cm = jnp.einsum("bi,bj->ij", onehot * mask[:, None].astype(
+                jnp.int32), pred_onehot)
+            out["confusion_matrix"] = cm.astype(jnp.int32)
+        return out
+
+
+class EvaluatorMSE(EvaluatorBase):
+    """Mean-squared-error evaluator (znicz EvaluatorMSE): metrics are the
+    batch mse and per-sample rmse."""
+
+    WRITES = ("mse", "loss_out")
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorMSE, self).__init__(workflow, **kwargs)
+        self.target = None       # linked from loader.minibatch_targets
+        self.mse = Array()
+        self.loss_out = Array()
+        self.demand("target")
+
+    @property
+    def reads(self):
+        return ("output", "target", "batch_size")
+
+    def initialize(self, device=None, **kwargs):
+        super(EvaluatorMSE, self).initialize(device=device, **kwargs)
+        self.mse.reset(numpy.zeros((), numpy.float32))
+        self.loss_out.reset(numpy.zeros((), numpy.float32))
+
+    def loss(self, y, target, size):
+        diff = (y - target).reshape(y.shape[0], -1)
+        mask = (jnp.arange(y.shape[0]) < size)[:, None]
+        return jnp.sum(jnp.where(mask, diff * diff, 0.0)) \
+            / jnp.maximum(size, 1) / diff.shape[1]
+
+    def step(self, output, target, batch_size):
+        loss = self.loss(output, target, batch_size)
+        return {"mse": loss, "loss_out": loss}

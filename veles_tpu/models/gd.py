@@ -1,0 +1,259 @@
+"""GradientDescent — the fused autodiff trainer.
+
+TPU-native replacement for the reference's per-layer backward units
+(znicz gd*.py with hand-derived CUDA/OpenCL gradient kernels; surface per
+manualrst_veles_algorithms.rst items 5, 8, 9, 11, 13).  One unit owns the
+whole training step:
+
+    loss = evaluator.loss(forward_chain(params, x), target)
+    grads = jax.grad(loss)          # replaces every hand-written kernel
+    params = solver.update(...)     # sgd/momentum/adagrad/adadelta/adam
+
+— all traced into ONE jitted XLA program with parameters and solver state
+donated (in-place HBM update).  Validation/test minibatches flow through
+the same program: ``lax.cond`` on the minibatch class skips the update
+while still returning loss/n_err, so there is exactly one compiled
+executable for the whole train/eval cycle.
+
+Per-layer hyper-parameter overrides (extras item 13) resolve at trace
+time from each forward unit's attributes; the learning-rate schedule
+(lr_adjust) is traced on the global step; when the workflow runs under a
+device mesh the gradient ``psum`` over the ``dp`` axis happens inside
+this same program (see veles_tpu.parallel).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.loader.base import TRAIN
+from veles_tpu.memory import Array
+from veles_tpu.models.all2all import All2AllSoftmax
+from veles_tpu.models.dropout import DropoutForward
+from veles_tpu.models.evaluator import EvaluatorMSE
+from veles_tpu.models.lr_adjust import get_schedule
+from veles_tpu.models.solvers import get_solver
+from veles_tpu import prng as prng_mod
+
+
+class GradientDescent(AcceleratedUnit):
+    """The trainer unit (replaces a whole chain of znicz GD units)."""
+
+    VIEW_GROUP = "TRAINER"
+    FUSABLE = False  # self-jits with donation; owns its own dispatch
+
+    def __init__(self, workflow, forwards=None, evaluator=None, loader=None,
+                 solver="sgd", learning_rate=0.01, learning_rate_bias=None,
+                 weights_decay=0.0, weights_decay_bias=None, l1_vs_l2=0.0,
+                 gradient_moment=0.0, gradient_moment_bias=None,
+                 lr_schedule="constant", lr_schedule_params=None,
+                 prng_key="trainer", **kwargs):
+        super(GradientDescent, self).__init__(workflow, **kwargs)
+        self.forwards = list(forwards) if forwards else []
+        self.evaluator = evaluator
+        self.loader = loader
+        self.solver_name = solver
+        self.learning_rate = learning_rate
+        self.learning_rate_bias = learning_rate_bias \
+            if learning_rate_bias is not None else learning_rate
+        self.weights_decay = weights_decay
+        self.weights_decay_bias = weights_decay_bias \
+            if weights_decay_bias is not None else weights_decay
+        self.l1_vs_l2 = l1_vs_l2
+        self.gradient_moment = gradient_moment
+        self.gradient_moment_bias = gradient_moment_bias \
+            if gradient_moment_bias is not None else gradient_moment
+        self.lr_schedule = lr_schedule
+        self.lr_schedule_params = lr_schedule_params or {}
+        self.prng = prng_mod.get(prng_key)
+        self.lr_multiplier = 1.0  # Rollback adjusts this
+
+        self.global_step = 0
+        self.opt_state = {}      # {layer_idx: {param: {slot: Array}}}
+        self.loss = Array()
+        self.n_err = Array()
+        self.demand("forwards", "evaluator", "loader")
+
+    def init_unpickled(self):
+        super(GradientDescent, self).init_unpickled()
+        self._train_step_ = None
+
+    # -- hyper-parameter resolution (extras item 13) ---------------------------
+
+    def _layer_hp(self, unit, param_name):
+        hp = unit.hyperparams()
+
+        def pick(specific, generic, default):
+            v = hp.get(specific)
+            if v is None:
+                v = hp.get(generic)
+            return default if v is None else v
+
+        if param_name == "bias":
+            return {
+                "lr": pick("learning_rate_bias", "learning_rate",
+                           self.learning_rate_bias),
+                "decay": pick("weights_decay_bias", "weights_decay",
+                              self.weights_decay_bias),
+                "moment": pick("gradient_moment_bias", "gradient_moment",
+                               self.gradient_moment_bias),
+                "l1_vs_l2": self.l1_vs_l2,
+            }
+        return {
+            "lr": pick("learning_rate", None, self.learning_rate),
+            "decay": pick("weights_decay", None, self.weights_decay),
+            "moment": pick("gradient_moment", None, self.gradient_moment),
+            "l1_vs_l2": self.l1_vs_l2,
+        }
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def initialize(self, device=None, **kwargs):
+        from veles_tpu.units import MissingDemand
+        if not self.forwards or self.evaluator is None \
+                or self.loader is None:
+            raise MissingDemand(self, {"forwards", "evaluator", "loader"})
+        for u in self.forwards:
+            if not u.is_initialized:
+                raise MissingDemand(self, {"forwards[%s]" % u.name})
+        if isinstance(self.evaluator, EvaluatorMSE) \
+                and getattr(self.loader, "minibatch_targets", None) is None:
+            raise MissingDemand(self, {"loader.minibatch_targets"})
+        solver = get_solver(self.solver_name)
+        if not self.opt_state:  # fresh (not restored from snapshot)
+            for i, u in enumerate(self.forwards):
+                per_param = {}
+                for name, arr in u.param_arrays().items():
+                    slots = solver.init(jnp.asarray(arr.mem))
+                    per_param[name] = {
+                        s: Array(numpy.asarray(v))
+                        for s, v in slots.items()}
+                self.opt_state[i] = per_param
+        self.loss.reset(numpy.zeros((), numpy.float32))
+        self.n_err.reset(numpy.zeros((), numpy.int32))
+        super(GradientDescent, self).initialize(device=device, **kwargs)
+        for layer in self.opt_state.values():
+            for slots in layer.values():
+                for arr in slots.values():
+                    arr.initialize(self.device)
+
+    # -- the fused program -----------------------------------------------------
+
+    def _forward(self, params, x, key, train):
+        """Compose the chain; returns the trainer-facing head output
+        (logits for a softmax head)."""
+        h = x
+        for i, u in enumerate(self.forwards):
+            p = {name: params[i][name] for name in params[i]}
+            if isinstance(u, DropoutForward):
+                if train:
+                    key, sub = jax.random.split(key)
+                    h = u.apply_train(p, h, sub)
+                else:
+                    h = u.apply(p, h)
+            elif isinstance(u, All2AllSoftmax) and i == len(
+                    self.forwards) - 1:
+                h = u.logits(p, h)
+            else:
+                h = u.apply(p, h)
+        return h
+
+    def _target_of(self, labels, targets):
+        return targets if isinstance(self.evaluator, EvaluatorMSE) \
+            else labels
+
+    def _build_train_step(self):
+        solver = get_solver(self.solver_name)
+        schedule = get_schedule(self.lr_schedule, **self.lr_schedule_params)
+        hps = {i: {name: self._layer_hp(u, name)
+                   for name in u.param_arrays()}
+               for i, u in enumerate(self.forwards)}
+        is_mse = isinstance(self.evaluator, EvaluatorMSE)
+
+        def loss_and_metrics(params, x, target, size, key, train):
+            y = self._forward(params, x, key, train)
+            loss = self.evaluator.loss(y, target, size)
+            if is_mse:
+                n_err = jnp.zeros((), jnp.int32)
+            else:
+                # argmax over logits is valid for any softmax-CE head,
+                # explicit All2AllSoftmax or plain logits layer alike
+                pred = jnp.argmax(y, axis=-1).astype(jnp.int32)
+                mask = jnp.arange(y.shape[0]) < size
+                n_err = jnp.sum(jnp.where(
+                    mask, (pred != target).astype(jnp.int32), 0))
+            return loss, n_err
+
+        def train_step(params, opt_state, x, target, size, class_id,
+                       step_no, lr_mult, key):
+            def do_train(args):
+                params, opt_state = args
+                (loss, n_err), grads = jax.value_and_grad(
+                    loss_and_metrics, has_aux=True)(
+                        params, x, target, size, key, True)
+                # lr_mult is traced so Rollback's lr changes don't
+                # recompile the whole program
+                scale = lr_mult * schedule(step_no)
+                new_params, new_opt = {}, {}
+                for i in params:
+                    new_params[i], new_opt[i] = {}, {}
+                    for name in params[i]:
+                        hp = dict(hps[i][name])
+                        hp["lr"] = hp["lr"] * scale
+                        p, s = solver.update(
+                            params[i][name], grads[i][name],
+                            opt_state[i][name], hp)
+                        new_params[i][name] = p
+                        new_opt[i][name] = s
+                return new_params, new_opt, loss, n_err
+
+            def do_eval(args):
+                params, opt_state = args
+                loss, n_err = loss_and_metrics(
+                    params, x, target, size, key, False)
+                return params, opt_state, loss, n_err
+
+            return jax.lax.cond(class_id == TRAIN, do_train, do_eval,
+                                (params, opt_state))
+
+        return jax.jit(train_step, donate_argnums=(0, 1))
+
+    # -- execution -------------------------------------------------------------
+
+    def run(self):
+        if self._train_step_ is None:
+            self._train_step_ = self._build_train_step()
+        params = {i: {name: arr.devmem
+                      for name, arr in u.param_arrays().items()}
+                  for i, u in enumerate(self.forwards)}
+        opt_state = {i: {name: {s: arr.devmem
+                                for s, arr in slots.items()}
+                         for name, slots in layer.items()}
+                     for i, layer in self.opt_state.items()}
+        l = self.loader
+        x = l.minibatch_data.devmem
+        labels = l.minibatch_labels.devmem
+        targets = getattr(l, "minibatch_targets", None)
+        target = targets.devmem if isinstance(self.evaluator, EvaluatorMSE) \
+            else labels
+        key = self.prng.peek_key(self.global_step)
+        new_params, new_opt, loss, n_err = self._train_step_(
+            params, opt_state, x, target,
+            jnp.int32(l.minibatch_size), jnp.int32(l.minibatch_class),
+            jnp.float32(self.global_step),
+            jnp.float32(self.lr_multiplier), key)
+        for i, u in enumerate(self.forwards):
+            for name, arr in u.param_arrays().items():
+                arr.devmem = new_params[i][name]
+        for i, layer in self.opt_state.items():
+            for name, slots in layer.items():
+                for s, arr in slots.items():
+                    arr.devmem = new_opt[i][name][s]
+        self.loss.devmem = loss
+        self.n_err.devmem = n_err
+        if l.minibatch_class == TRAIN:
+            self.global_step += 1
+
+    def step(self, **tensors):
+        raise RuntimeError("GradientDescent dispatches its own program")

@@ -1,0 +1,59 @@
+"""Learning-rate schedules (reconstruction of znicz lr_adjust; extras
+item 3 "Learning rate adjusting").
+
+A policy maps the global step (or epoch) to a multiplier on the base
+learning rate.  Policies are pure — the trainer traces them, so schedule
+evaluation is free inside the fused step.
+"""
+
+import jax.numpy as jnp
+
+
+class ConstantLR:
+    def __init__(self, **kwargs):
+        pass
+
+    def __call__(self, step):
+        return 1.0
+
+
+class StepLR:
+    """lr *= gamma every ``step_size`` steps (caffe 'step')."""
+
+    def __init__(self, gamma=0.1, step_size=100000, **kwargs):
+        self.gamma = gamma
+        self.step_size = step_size
+
+    def __call__(self, step):
+        return self.gamma ** jnp.floor(step / self.step_size)
+
+
+class ExpLR:
+    """lr *= gamma^step (caffe 'exp')."""
+
+    def __init__(self, gamma=0.9999, **kwargs):
+        self.gamma = gamma
+
+    def __call__(self, step):
+        return self.gamma ** step
+
+
+class InvLR:
+    """lr / (1 + gamma*step)^power (caffe 'inv')."""
+
+    def __init__(self, gamma=0.0001, power=0.75, **kwargs):
+        self.gamma = gamma
+        self.power = power
+
+    def __call__(self, step):
+        return (1.0 + self.gamma * step) ** (-self.power)
+
+
+SCHEDULES = {"constant": ConstantLR, "step": StepLR, "exp": ExpLR,
+             "inv": InvLR}
+
+
+def get_schedule(name, **kwargs):
+    if callable(name) and not isinstance(name, str):
+        return name
+    return SCHEDULES[name](**kwargs)

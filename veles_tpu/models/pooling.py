@@ -1,0 +1,71 @@
+"""Pooling layers (reconstruction of znicz pooling; extras item 1 adds
+Depooling for the conv autoencoders).  ``lax.reduce_window`` — XLA lowers
+it natively on TPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.memory import Array
+from veles_tpu.models.conv import _pair
+from veles_tpu.models.nn_units import ForwardBase
+
+
+class PoolingBase(ForwardBase):
+    """Parameterless window reduction over NHWC."""
+
+    hide_from_registry = True
+    PARAMS = ()
+
+    def __init__(self, workflow, kx=2, ky=2, sliding=None, **kwargs):
+        super(PoolingBase, self).__init__(workflow, **kwargs)
+        self.kx, self.ky = int(kx), int(ky)
+        #: user-facing (sliding_x, sliding_y); defaults to the window
+        self.sliding = _pair(sliding) if sliding is not None \
+            else (self.kx, self.ky)
+
+    def fill_params(self):
+        pass
+
+    def _window(self):
+        return (1, self.ky, self.kx, 1)
+
+    def _strides(self):
+        sx, sy = self.sliding
+        return (1, sy, sx, 1)
+
+    def output_shape_for(self, input_shape):
+        out = jax.eval_shape(
+            lambda x: self.apply({}, x),
+            jax.ShapeDtypeStruct(input_shape, jnp.float32))
+        return out.shape
+
+
+class MaxPooling(PoolingBase):
+    """znicz MaxPooling (stores ``input_offset`` argmax positions in the
+    reference for backprop; autodiff makes that bookkeeping implicit)."""
+
+    def apply(self, params, x):
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            self._window(), self._strides(), "VALID")
+
+
+class AvgPooling(PoolingBase):
+    """znicz AvgPooling."""
+
+    def apply(self, params, x):
+        summed = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add,
+            self._window(), self._strides(), "VALID")
+        return summed / (self.kx * self.ky)
+
+
+class Depooling(PoolingBase):
+    """Nearest-neighbour upsampling inverse of pooling (znicz depooling,
+    extras item 1)."""
+
+    def apply(self, params, x):
+        sx, sy = self.sliding
+        y = jnp.repeat(x, sy, axis=1)   # H
+        return jnp.repeat(y, sx, axis=2)  # W

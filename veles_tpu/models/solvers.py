@@ -1,0 +1,116 @@
+"""Gradient-descent solvers (surface per manualrst_veles_algorithms.rst:
+"Stochastic gradient descent solver with momentum", "AdaGrad/AdaDelta
+solvers", plus Adam as the modern default the reference predates).
+
+Each solver is a pair of pure functions over one parameter tensor:
+
+- ``init(param) -> state`` (dict of tensors)
+- ``update(param, grad, state, hp) -> (new_param, new_state)``
+
+``hp`` carries ``lr``, ``decay`` (L2+L1 per ``l1_vs_l2``) and
+``moment`` — resolved per layer (extras item 13).  Weight decay is
+applied as in the reference: the decay term joins the gradient before
+the solver step.
+"""
+
+import jax.numpy as jnp
+
+
+def _decayed_grad(param, grad, hp):
+    """grad + weights_decay * d/dw (l2/l1 mix)
+    (znicz gradient_descent weights_decay + l1_vs_l2 surface)."""
+    decay = hp.get("decay", 0.0)
+    l1_vs_l2 = hp.get("l1_vs_l2", 0.0)
+    if decay:
+        reg = l1_vs_l2 * jnp.sign(param) + (1.0 - l1_vs_l2) * param
+        grad = grad + decay * reg
+    return grad
+
+
+class SGD:
+    """Plain / momentum SGD (znicz GradientDescent solver)."""
+
+    name = "sgd"
+
+    @staticmethod
+    def init(param):
+        return {"v": jnp.zeros_like(param)}
+
+    @staticmethod
+    def update(param, grad, state, hp):
+        grad = _decayed_grad(param, grad, hp)
+        v = hp.get("moment", 0.0) * state["v"] - hp["lr"] * grad
+        return param + v, {"v": v}
+
+
+class AdaGrad:
+    name = "adagrad"
+    EPS = 1e-8
+
+    @staticmethod
+    def init(param):
+        return {"g2": jnp.zeros_like(param)}
+
+    @staticmethod
+    def update(param, grad, state, hp):
+        grad = _decayed_grad(param, grad, hp)
+        g2 = state["g2"] + grad * grad
+        step = hp["lr"] * grad / (jnp.sqrt(g2) + AdaGrad.EPS)
+        return param - step, {"g2": g2}
+
+
+class AdaDelta:
+    name = "adadelta"
+    RHO = 0.95
+    EPS = 1e-6
+
+    @staticmethod
+    def init(param):
+        return {"g2": jnp.zeros_like(param), "x2": jnp.zeros_like(param)}
+
+    @staticmethod
+    def update(param, grad, state, hp):
+        grad = _decayed_grad(param, grad, hp)
+        rho, eps = AdaDelta.RHO, AdaDelta.EPS
+        g2 = rho * state["g2"] + (1 - rho) * grad * grad
+        dx = -jnp.sqrt(state["x2"] + eps) / jnp.sqrt(g2 + eps) * grad
+        x2 = rho * state["x2"] + (1 - rho) * dx * dx
+        # lr acts as a scale on the adapted step (1.0 = classic AdaDelta)
+        return param + hp["lr"] * dx, {"g2": g2, "x2": x2}
+
+
+class Adam:
+    name = "adam"
+    B1 = 0.9
+    B2 = 0.999
+    EPS = 1e-8
+
+    @staticmethod
+    def init(param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param),
+                "t": jnp.zeros((), jnp.float32)}
+
+    @staticmethod
+    def update(param, grad, state, hp):
+        grad = _decayed_grad(param, grad, hp)
+        b1, b2, eps = Adam.B1, Adam.B2, Adam.EPS
+        t = state["t"] + 1
+        m = b1 * state["m"] + (1 - b1) * grad
+        v = b2 * state["v"] + (1 - b2) * grad * grad
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        return (param - hp["lr"] * mhat / (jnp.sqrt(vhat) + eps),
+                {"m": m, "v": v, "t": t})
+
+
+SOLVERS = {c.name: c for c in (SGD, AdaGrad, AdaDelta, Adam)}
+
+
+def get_solver(name):
+    if isinstance(name, type):
+        return name
+    try:
+        return SOLVERS[name]
+    except KeyError:
+        raise KeyError("unknown solver %r (have: %s)"
+                       % (name, sorted(SOLVERS)))

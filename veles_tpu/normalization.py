@@ -48,10 +48,20 @@ class NormalizerBase(metaclass=MappedUnitRegistry):
     def state(self, value):
         self.__dict__.update(value)
 
+    #: constructor configuration preserved across reset() (statistics
+    #: are discarded, configuration is not)
+    CONFIG_ATTRS = ()
+
     def reset(self):
-        keep = type(self)()
+        cfg = {a: getattr(self, a) for a in self.CONFIG_ATTRS}
+        fresh = type(self)()
         self.__dict__.clear()
-        self.__dict__.update(keep.__dict__)
+        self.__dict__.update(fresh.__dict__)
+        self.__dict__.update(cfg)
+        self._post_reset()
+
+    def _post_reset(self):
+        pass
 
     # -- contract --------------------------------------------------------------
 
@@ -105,6 +115,7 @@ class LinearNormalizer(StatelessNormalizer):
     (ref: normalization.py:347 "linear")."""
 
     MAPPING = "linear"
+    CONFIG_ATTRS = ("interval",)
 
     def __init__(self, state=None, interval=(-1.0, 1.0), **kwargs):
         self.interval = tuple(interval)
@@ -130,6 +141,7 @@ class RangeLinearNormalizer(NormalizerBase):
     (ref: normalization.py:398 "range_linear")."""
 
     MAPPING = "range_linear"
+    CONFIG_ATTRS = ("interval",)
 
     def __init__(self, state=None, interval=(-1.0, 1.0), **kwargs):
         self.interval = tuple(interval)
@@ -210,6 +222,11 @@ class ExternalMeanNormalizer(NormalizerBase):
     (ref: normalization.py "external_mean")."""
 
     MAPPING = "external_mean"
+    CONFIG_ATTRS = ("mean_source",)
+
+    def _post_reset(self):
+        if self.mean_source is not None:
+            self._initialized = True
 
     def __init__(self, state=None, mean_source=None, **kwargs):
         self.mean_source = None
