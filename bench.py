@@ -1,0 +1,83 @@
+"""Benchmark entry point — prints ONE JSON line.
+
+Measures end-to-end training throughput (samples/sec/chip) of the
+flagship workflow: the BASELINE.json config-1 MNIST-shaped MLP
+(784→100→10, SGD+momentum) trained through the full framework stack —
+FullBatchLoader device gather → fused autodiff train step — on whatever
+chip JAX provides (the real TPU under the driver).
+
+The reference publishes no throughput numbers (BASELINE.md), so the
+first recorded measurement IS the baseline; vs_baseline reports against
+the constant below once set.
+"""
+
+import json
+import sys
+import time
+
+import numpy
+
+#: samples/sec recorded on the first driver run (BASELINE.md: the rebuild
+#: establishes the baseline).  None until round 1's number lands.
+BASELINE_SAMPLES_PER_SEC = None
+
+
+def build():
+    from veles_tpu.backends import Device
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.standard import build_mlp_classifier
+
+    class SyntheticMnist(FullBatchLoader):
+        """MNIST-shaped synthetic set (zero-egress environment: no real
+        download; shapes/dtypes match config 1)."""
+
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            n_train, n_valid = 60000, 10000
+            self.class_lengths[:] = [0, n_valid, n_train]
+            tot = n_train + n_valid
+            labels = rng.integers(0, 10, tot)
+            centers = rng.normal(scale=2.0, size=(10, 784))
+            self.original_data = (
+                centers[labels] + rng.normal(size=(tot, 784))
+            ).astype(numpy.float32)
+            self.original_labels = labels.tolist()
+
+    dev = Device()
+    wf = AcceleratedWorkflow(None, name="bench-mnist")
+    loader = SyntheticMnist(wf, minibatch_size=512)
+    _, layers, ev, gd = build_mlp_classifier(
+        dev, loader, hidden=(100,), classes=10, workflow=wf,
+        gradient_moment=0.9)
+    return loader, gd
+
+
+def main():
+    loader, gd = build()
+    # warm up: compile both the gather and the train step
+    for _ in range(3):
+        loader.run()
+        gd.run()
+    gd.loss.map_read()  # sync
+    t0 = time.perf_counter()
+    served0 = loader.samples_served
+    steps = 100
+    for _ in range(steps):
+        loader.run()
+        gd.run()
+    gd.loss.map_read()  # sync
+    dt = time.perf_counter() - t0
+    sps = (loader.samples_served - served0) / dt
+    vs = sps / BASELINE_SAMPLES_PER_SEC if BASELINE_SAMPLES_PER_SEC else 1.0
+    print(json.dumps({
+        "metric": "mnist_mlp_train_throughput",
+        "value": round(sps, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

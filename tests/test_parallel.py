@@ -1,0 +1,137 @@
+"""Distributed/sharding tests on the 8-virtual-device CPU mesh
+(SURVEY.md §4: the JAX equivalent of the reference's loopback
+master+slave-in-one-process tests)."""
+
+import jax
+import numpy
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from veles_tpu.accelerated_units import AcceleratedWorkflow
+from veles_tpu.backends import Device
+from veles_tpu.models import (
+    All2AllSoftmax, All2AllTanh, EvaluatorSoftmax, GradientDescent)
+from veles_tpu.parallel import build_mesh
+from veles_tpu.parallel.mesh import MeshConfig, single_device_mesh
+from veles_tpu.parallel.sharding import batch_spec, param_spec
+
+
+@pytest.fixture(scope="module")
+def device():
+    return Device(backend="numpy")
+
+
+class TestMeshConfig:
+    def test_resolve_wildcard(self):
+        assert MeshConfig({"dp": -1, "tp": 2}).resolve(8) == \
+            {"dp": 4, "tp": 2}
+
+    def test_axis_order(self):
+        sizes = MeshConfig({"tp": 2, "dp": 2, "pp": 2}).resolve(8)
+        assert list(sizes) == ["pp", "dp", "tp"]
+
+    def test_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            MeshConfig({"dp": 3}).resolve(8)
+
+    def test_build(self, device):
+        mesh = build_mesh({"dp": 2, "tp": 4}, devices=device.jax_devices)
+        assert mesh.shape == {"dp": 2, "tp": 4}
+
+    def test_single_device(self, device):
+        mesh = single_device_mesh(device=device.jax_device)
+        assert mesh.shape == {"dp": 1}
+
+
+class TestShardingSpecs:
+    def test_batch_spec(self, device):
+        mesh = build_mesh({"dp": 4, "tp": 2}, devices=device.jax_devices)
+        assert batch_spec(mesh, 2) == P(("dp",), None)
+
+    def test_param_spec_tp(self, device):
+        mesh = build_mesh({"dp": 4, "tp": 2}, devices=device.jax_devices)
+        assert param_spec(mesh, "weights", (16, 8)) == P(None, "tp")
+        # indivisible feature dim -> replicate
+        assert param_spec(mesh, "weights", (16, 7)) == P()
+
+    def test_param_spec_no_tp(self, device):
+        mesh = build_mesh({"dp": 8}, devices=device.jax_devices)
+        assert param_spec(mesh, "weights", (16, 8)) == P()
+
+    def test_param_spec_fsdp_shards_state(self, device):
+        mesh = build_mesh({"dp": 2, "fsdp": 2, "tp": 2},
+                          devices=device.jax_devices)
+        # weights get tp on features AND fsdp on the remaining axis
+        assert param_spec(mesh, "weights", (16, 8)) == P("fsdp", "tp")
+        # bias: tp wins the last axis, fsdp finds nothing else
+        assert param_spec(mesh, "bias", (8,)) == P("tp")
+
+    def test_batch_spec_divisibility_error(self, device):
+        mesh = build_mesh({"dp": 8}, devices=device.jax_devices)
+        with pytest.raises(ValueError, match="divisible"):
+            batch_spec(mesh, 2, dim0=100)
+
+
+def _make_sharded_trainer(device, mesh, minibatch=64):
+    from tests.test_models import BlobsLoader
+    from veles_tpu.models.standard import build_mlp_classifier
+    wf = AcceleratedWorkflow(None, name="dist")
+    loader = BlobsLoader(wf, minibatch_size=minibatch, prng_key="dist")
+    wf, layers, ev, gd = build_mlp_classifier(
+        device, loader, hidden=(16,), classes=4, workflow=wf, mesh=mesh,
+        learning_rate=0.1)
+    return wf, loader, layers, gd
+
+
+class TestShardedTraining:
+    @pytest.mark.parametrize("axes", [{"dp": 8}, {"dp": 4, "tp": 2},
+                                      {"dp": 2, "fsdp": 2, "tp": 2}])
+    def test_sharded_step_runs_and_learns(self, device, axes):
+        mesh = build_mesh(axes, devices=device.jax_devices)
+        wf, loader, layers, gd = _make_sharded_trainer(device, mesh)
+        losses = []
+        walks = 0
+        while walks < 3:
+            loader.run()
+            gd.run()
+            from veles_tpu.loader.base import TRAIN
+            if loader.minibatch_class == TRAIN:
+                gd.loss.map_read()
+                losses.append(float(gd.loss.mem))
+            if loader.train_ended:
+                walks += 1
+        assert numpy.mean(losses[-5:]) < numpy.mean(losses[:5])
+
+    def test_sharded_matches_single_device(self, device):
+        # same seed, same data: the dp-sharded step must produce the
+        # same parameters as the unsharded one (psum == serial sum)
+        from veles_tpu import prng
+        mesh = build_mesh({"dp": 8}, devices=device.jax_devices)
+
+        prng.get("dist").seed(99)
+        prng.get("default").seed(7)
+        wf1, loader1, layers1, gd1 = _make_sharded_trainer(device, mesh)
+        for _ in range(5):
+            loader1.run()
+            gd1.run()
+
+        prng.get("dist").seed(99)
+        prng.get("default").seed(7)
+        wf2, loader2, layers2, gd2 = _make_sharded_trainer(device, None)
+        for _ in range(5):
+            loader2.run()
+            gd2.run()
+
+        for u1, u2 in zip(layers1, layers2):
+            w1 = numpy.array(u1.weights[...])
+            w2 = numpy.array(u2.weights[...])
+            assert numpy.allclose(w1, w2, atol=1e-5), u1.name
+
+    def test_params_actually_sharded(self, device):
+        mesh = build_mesh({"dp": 4, "tp": 2}, devices=device.jax_devices)
+        wf, loader, layers, gd = _make_sharded_trainer(device, mesh)
+        loader.run()
+        gd.run()
+        w = layers[0].weights.devmem  # (8, 16) sharded P(None, "tp")
+        shard_shapes = {s.data.shape for s in w.addressable_shards}
+        assert shard_shapes == {(8, 8)}

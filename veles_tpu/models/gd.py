@@ -48,8 +48,13 @@ class GradientDescent(AcceleratedUnit):
                  weights_decay=0.0, weights_decay_bias=None, l1_vs_l2=0.0,
                  gradient_moment=0.0, gradient_moment_bias=None,
                  lr_schedule="constant", lr_schedule_params=None,
-                 prng_key="trainer", **kwargs):
+                 prng_key="trainer", mesh=None, **kwargs):
         super(GradientDescent, self).__init__(workflow, **kwargs)
+        #: jax.sharding.Mesh — when set, the fused step is sharded over
+        #: it (dp batch split + psum, tp weight split; see
+        #: veles_tpu.parallel.sharding).  Replaces the reference's entire
+        #: ZeroMQ master-slave gradient exchange (SURVEY.md §2.3).
+        self.mesh = mesh
         self.forwards = list(forwards) if forwards else []
         self.evaluator = evaluator
         self.loader = loader
@@ -78,6 +83,7 @@ class GradientDescent(AcceleratedUnit):
     def init_unpickled(self):
         super(GradientDescent, self).init_unpickled()
         self._train_step_ = None
+        self._shardings_ = None
 
     # -- hyper-parameter resolution (extras item 13) ---------------------------
 
@@ -217,7 +223,46 @@ class GradientDescent(AcceleratedUnit):
             return jax.lax.cond(class_id == TRAIN, do_train, do_eval,
                                 (params, opt_state))
 
-        return jax.jit(train_step, donate_argnums=(0, 1))
+        if self.mesh is None:
+            return jax.jit(train_step, donate_argnums=(0, 1))
+        return self._shard_train_step(train_step)
+
+    def _shard_train_step(self, train_step):
+        """Annotate the fused step with NamedShardings over self.mesh —
+        XLA then inserts the gradient psum over dp and the tp
+        collectives on ICI."""
+        from veles_tpu.parallel import sharding as shlib
+        mesh = self.mesh
+        params_sh = {
+            i: {name: shlib.param_sharding(mesh, name, arr.mem.shape)
+                for name, arr in u.param_arrays().items()}
+            for i, u in enumerate(self.forwards)}
+        opt_sh = {
+            i: {name: {s: params_sh[i][name]
+                       for s in self.opt_state[i][name]}
+                for name in self.opt_state[i]}
+            for i in self.opt_state}
+        # Adam's step counter is a scalar — replicate it
+        for i, layer in self.opt_state.items():
+            for name, slots in layer.items():
+                for s, arr in slots.items():
+                    if arr.mem.ndim == 0:
+                        opt_sh[i][name][s] = shlib.replicated(mesh)
+        mb = self.loader.max_minibatch_size
+        x_sh = shlib.batch_sharding(
+            mesh, len(self.loader.minibatch_data.shape), dim0=mb)
+        tgt_ndim = len(self.loader.minibatch_targets.shape) \
+            if isinstance(self.evaluator, EvaluatorMSE) \
+            else len(self.loader.minibatch_labels.shape)
+        tgt_sh = shlib.batch_sharding(mesh, tgt_ndim, dim0=mb)
+        rep = shlib.replicated(mesh)
+        self._shardings_ = (params_sh, opt_sh, x_sh, tgt_sh)
+        return jax.jit(
+            train_step,
+            in_shardings=(params_sh, opt_sh, x_sh, tgt_sh,
+                          rep, rep, rep, rep, rep),
+            out_shardings=(params_sh, opt_sh, rep, rep),
+            donate_argnums=(0, 1))
 
     # -- execution -------------------------------------------------------------
 
@@ -237,6 +282,23 @@ class GradientDescent(AcceleratedUnit):
         targets = getattr(l, "minibatch_targets", None)
         target = targets.devmem if isinstance(self.evaluator, EvaluatorMSE) \
             else labels
+        if self._shardings_ is not None:
+            # redistribute onto the mesh: batch tensors every step; the
+            # state pytrees only once — afterwards they adopt the sharded
+            # step outputs directly
+            params_sh, opt_sh, x_sh, tgt_sh = self._shardings_
+            x = jax.device_put(x, x_sh)
+            target = jax.device_put(target, tgt_sh)
+            # state normally adopts the sharded step outputs; re-put only
+            # when a host-side write (rollback, snapshot resume) reset a
+            # leaf to single-device placement — one leaf check suffices
+            # since all leaves travel together
+            i0 = next(iter(params))
+            n0 = next(iter(params[i0]))
+            if params[i0][n0].sharding != params_sh[i0][n0]:
+                params = jax.tree.map(jax.device_put, params, params_sh)
+                opt_state = jax.tree.map(
+                    jax.device_put, opt_state, opt_sh)
         key = self.prng.peek_key(self.global_step)
         new_params, new_opt, loss, n_err = self._train_step_(
             params, opt_state, x, target,

@@ -1,0 +1,46 @@
+"""Standard workflow builders (seed of the znicz StandardWorkflow
+surface): one call wires loader → forward layers → evaluator → trainer.
+
+Used by samples, bench, and the driver entry points so the unit
+handshake lives in exactly one place.
+"""
+
+from veles_tpu.accelerated_units import AcceleratedWorkflow
+from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+from veles_tpu.models.evaluator import EvaluatorSoftmax
+from veles_tpu.models.gd import GradientDescent
+
+
+def build_mlp_classifier(device, loader, hidden=(100,), classes=10,
+                         mesh=None, workflow=None, name="mlp",
+                         hidden_cls=All2AllTanh, **gd_kwargs):
+    """loader (already constructed, not yet initialized) →
+    tanh hidden layers → softmax head → evaluator → fused trainer.
+
+    Returns (workflow, layers, evaluator, trainer)."""
+    wf = workflow or AcceleratedWorkflow(None, name=name)
+    loader.initialize(device=device)
+    layers = []
+    prev_out = loader.minibatch_data
+    for li, width in enumerate(hidden):
+        u = hidden_cls(wf, output_sample_shape=(width,),
+                       name="fc%d" % li)
+        u.input = prev_out
+        u.initialize(device=device)
+        layers.append(u)
+        prev_out = u.output
+    head = All2AllSoftmax(wf, output_sample_shape=(classes,), name="head")
+    head.input = prev_out
+    head.initialize(device=device)
+    layers.append(head)
+    ev = EvaluatorSoftmax(wf, name="evaluator")
+    ev.output = head.output
+    ev.labels = loader.minibatch_labels
+    ev.loader = loader
+    ev.initialize(device=device)
+    gd_kwargs.setdefault("solver", "sgd")
+    gd_kwargs.setdefault("learning_rate", 0.05)
+    gd = GradientDescent(wf, forwards=layers, evaluator=ev,
+                         loader=loader, mesh=mesh, name="gd", **gd_kwargs)
+    gd.initialize(device=device)
+    return wf, layers, ev, gd
