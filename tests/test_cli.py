@@ -1,0 +1,84 @@
+"""CLI + end-to-end slice tests (models veles/tests/test_velescli.py).
+
+Drives Main with fake argv through the full MNIST sample: train,
+snapshot, resume, result-file, visualize — the reference's
+minimum-end-to-end milestone (SURVEY.md §7 step 6).
+"""
+
+import json
+import os
+
+import pytest
+
+from veles_tpu.__main__ import Main
+from veles_tpu.cmdline import filter_argv
+from veles_tpu.config import root
+
+MNIST = os.path.join(os.path.dirname(__file__), "..",
+                     "veles_tpu", "samples", "mnist.py")
+MNIST_CFG = os.path.join(os.path.dirname(__file__), "..",
+                         "veles_tpu", "samples", "mnist_config.py")
+
+
+@pytest.fixture
+def small_cfg(tmp_path, monkeypatch):
+    monkeypatch.setitem(vars(root.common.dirs), "snapshots",
+                        str(tmp_path / "snapshots"))
+    return [
+        "-c", "root.mnist_tpu.synthetic_train = 512",
+        "-c", "root.mnist_tpu.synthetic_valid = 256",
+        "-c", "root.mnist_tpu.max_epochs = 2",
+        "-c", "root.mnist_tpu.minibatch_size = 64",
+        "-c", "root.mnist_tpu.layers = [32, 10]",
+        "-c", "root.mnist_tpu.snapshot_time_interval = 0.0",
+        "-a", "numpy",
+    ]
+
+
+class TestCLI:
+    def test_end_to_end_train(self, tmp_path, small_cfg):
+        results = tmp_path / "results.json"
+        m = Main([MNIST, MNIST_CFG, "--result-file", str(results)]
+                 + small_cfg)
+        assert m.run() == 0
+        data = json.loads(results.read_text())
+        assert data["Total epochs"] == 2
+        assert "validation_error_pct" in data
+        # the snapshotter produced a _current symlink
+        snapdir = root.common.dirs.get("snapshots")
+        assert os.path.exists(
+            os.path.join(snapdir, "mnist_current.pickle.gz"))
+
+    def test_resume_from_snapshot(self, tmp_path, small_cfg):
+        m = Main([MNIST, MNIST_CFG] + small_cfg)
+        assert m.run() == 0
+        snap = os.path.join(root.common.dirs.get("snapshots"),
+                            "mnist_current.pickle.gz")
+        results = tmp_path / "resumed.json"
+        m2 = Main([MNIST, MNIST_CFG, "-s", snap,
+                   "--result-file", str(results)] + small_cfg)
+        assert m2.run() == 0
+        assert m2.restored
+        data = json.loads(results.read_text())
+        assert data["Total epochs"] >= 1
+
+    def test_visualize(self, capsys, small_cfg):
+        m = Main([MNIST, MNIST_CFG, "--visualize"] + small_cfg)
+        assert m.run() == 0
+        out = capsys.readouterr().out
+        assert "digraph MnistWorkflow" in out
+        assert "MnistLoader" in out
+
+    def test_dump_config(self, capsys, small_cfg):
+        m = Main([MNIST, MNIST_CFG, "--dump-config"] + small_cfg)
+        assert m.run() == 0
+        assert "mnist_tpu" in capsys.readouterr().out
+
+    def test_missing_workflow_shows_help(self, capsys):
+        assert Main([]).run() == 1
+
+    def test_filter_argv(self):
+        out = filter_argv(
+            ["wf.py", "cfg.py", "-a", "numpy", "--result-file", "r.json",
+             "--listen", ":5050"], "-a", "--listen")
+        assert out == ["-a", "numpy", "--listen", ":5050"]

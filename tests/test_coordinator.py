@@ -1,0 +1,135 @@
+"""Elastic coordinator loopback tests — coordinator + worker in ONE
+process (models veles/tests/test_network.py:52-149)."""
+
+import asyncio
+
+import pytest
+
+from veles_tpu.parallel.coordinator import Coordinator, WorkerClient
+
+
+class FakeMasterWorkflow:
+    """Implements the IDistributable face the coordinator consumes
+    (ref: TestWorkflow in veles/tests/test_network.py)."""
+
+    def __init__(self, n_jobs=6):
+        self.n_jobs = n_jobs
+        self.served = 0
+        self.applied = []
+        self.dropped = []
+
+    def checksum(self):
+        return "abc123"
+
+    def generate_data_for_slave(self, slave_id):
+        self.served += 1
+        return {"job_no": self.served}
+
+    def apply_data_from_slave(self, data, slave_id):
+        self.applied.append((slave_id, data))
+
+    def drop_slave(self, slave_id):
+        self.dropped.append(slave_id)
+
+    def has_more_jobs(self):
+        return self.served < self.n_jobs
+
+    def all_jobs_done(self):
+        return len(self.applied) >= self.n_jobs
+
+
+class FakeWorkerWorkflow:
+    def __init__(self, checksum="abc123"):
+        self._checksum = checksum
+        self.jobs = []
+
+    def checksum(self):
+        return self._checksum
+
+    def do_job(self, data, update, callback):
+        self.jobs.append(data)
+        callback({"result": data["job_no"] * 10})
+
+
+def run_loop(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class TestCoordinator:
+    def test_job_flow_single_worker(self):
+        async def main():
+            master = FakeMasterWorkflow(n_jobs=5)
+            coord = Coordinator(master, port=0)
+            await coord.start()
+            worker_wf = FakeWorkerWorkflow()
+            client = WorkerClient(worker_wf,
+                                  "127.0.0.1:%d" % coord.port, power=2.0)
+            await asyncio.wait_for(client.run(), 10)
+            await coord.stop()
+            return master, worker_wf
+
+        master, worker_wf = run_loop(main())
+        assert len(worker_wf.jobs) == 5
+        assert len(master.applied) == 5
+        assert master.applied[0][1] == {"result": 10}
+
+    def test_two_workers_share_jobs(self):
+        async def main():
+            master = FakeMasterWorkflow(n_jobs=8)
+            coord = Coordinator(master, port=0)
+            await coord.start()
+            w1 = FakeWorkerWorkflow()
+            w2 = FakeWorkerWorkflow()
+            c1 = WorkerClient(w1, "127.0.0.1:%d" % coord.port)
+            c2 = WorkerClient(w2, "127.0.0.1:%d" % coord.port)
+            await asyncio.wait_for(
+                asyncio.gather(c1.run(), c2.run()), 10)
+            await coord.stop()
+            return master, w1, w2
+
+        master, w1, w2 = run_loop(main())
+        assert len(master.applied) >= 8
+        assert len(w1.jobs) + len(w2.jobs) >= 8
+
+    def test_checksum_mismatch_rejected(self):
+        async def main():
+            master = FakeMasterWorkflow()
+            coord = Coordinator(master, port=0)
+            await coord.start()
+            bad = WorkerClient(FakeWorkerWorkflow(checksum="WRONG"),
+                               "127.0.0.1:%d" % coord.port,
+                               max_reconnects=0, reconnect_delay=0.01)
+            with pytest.raises(ConnectionError):
+                await asyncio.wait_for(bad.run(), 10)
+            await coord.stop()
+
+        run_loop(main())
+
+    def test_dropped_worker_requeues(self):
+        async def main():
+            master = FakeMasterWorkflow(n_jobs=3)
+            coord = Coordinator(master, port=0)
+            await coord.start()
+
+            # a worker that takes a job then vanishes
+            from veles_tpu.parallel.coordinator import (
+                recv_frame, send_frame)
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", coord.port)
+            await send_frame(writer, {"checksum": "abc123", "power": 1.0})
+            reply = await recv_frame(reader)
+            await send_frame(writer, {"cmd": "job"})
+            await recv_frame(reader)  # got the job
+            writer.close()            # die without returning the update
+            await asyncio.sleep(0.2)
+            assert master.dropped == [reply["id"]]
+
+            # a healthy worker finishes everything
+            good = WorkerClient(FakeWorkerWorkflow(),
+                                "127.0.0.1:%d" % coord.port)
+            await asyncio.wait_for(good.run(), 10)
+            await coord.stop()
+            return master
+
+        master = run_loop(main())
+        assert len(master.applied) >= 3

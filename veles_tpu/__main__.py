@@ -1,0 +1,121 @@
+"""CLI entry point: ``python -m veles_tpu <workflow.py> [config.py]``.
+
+Rebuild of veles/__main__.py:136-867.  The user workflow file implements
+the ``run(load, main)`` contract (ref: __main__.py:799-818)::
+
+    def run(load, main):
+        load(MnistWorkflow, layers=[100, 10])   # construct or resume
+        main()                                   # initialize + run
+
+``load`` returns ``(workflow, restored_from_snapshot)``; ``main``
+initializes the launcher-owned workflow and runs it to completion.
+"""
+
+import json
+import logging
+import sys
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.cmdline import build_parser
+from veles_tpu.config import (
+    apply_config_file, apply_override, load_site_configs, root)
+from veles_tpu.import_file import import_file_as_module
+from veles_tpu.launcher import Launcher
+from veles_tpu.logger import setup_logging
+from veles_tpu.snapshotter import SnapshotterToFile
+
+
+class Main:
+    """ref: veles/__main__.py:136."""
+
+    def __init__(self, argv=None):
+        self.argv = list(sys.argv[1:] if argv is None else argv)
+        self.args = None
+        self.launcher = None
+        self.workflow = None
+        self.restored = False
+
+    # -- seeding (ref: __main__.py:483) ---------------------------------------
+
+    def _seed_random(self):
+        seed = self.args.seed
+        if seed is None:
+            prng.get().seed(42)
+            return
+        if seed.startswith("file:"):
+            spec = seed[5:]
+            path, _, nbytes = spec.partition(":")
+            with open(path, "rb") as f:
+                data = f.read(int(nbytes) if nbytes else 16)
+            prng.get().seed(numpy.frombuffer(data, numpy.uint8))
+        else:
+            prng.get().seed(int(seed))
+
+    # -- the load/main contract (ref: __main__.py:591-668) --------------------
+
+    def _load(self, workflow_class, **kwargs):
+        if self.args.snapshot:
+            self.workflow = SnapshotterToFile.import_file(
+                self.args.snapshot)
+            self.workflow.workflow = self.launcher
+            self.restored = True
+            logging.getLogger("Main").info(
+                "resumed %s from %s", type(self.workflow).__name__,
+                self.args.snapshot)
+        else:
+            self.workflow = workflow_class(self.launcher, **kwargs)
+        return self.workflow, self.restored
+
+    def _main(self, **kwargs):
+        self.launcher.boot(**kwargs)
+        if self.args.result_file:
+            self.launcher.write_results(self.args.result_file)
+
+    # -- run ------------------------------------------------------------------
+
+    def run(self):
+        parser = build_parser()
+        self.args = parser.parse_args(self.argv)
+        level = (logging.WARNING, logging.INFO,
+                 logging.DEBUG)[min(self.args.verbose + 1, 2)]
+        setup_logging(level)
+        load_site_configs()
+        if self.args.timings:
+            root.common.timings = True
+        if self.args.config:
+            apply_config_file(self.args.config)
+        for snippet in self.args.config_override:
+            apply_override(snippet)
+        if self.args.dump_config:
+            root.print_()
+            return 0
+        if not self.args.workflow:
+            parser.print_help()
+            return 1
+        self._seed_random()
+        self.launcher = Launcher(
+            backend=self.args.backend, device_index=self.args.device,
+            listen=self.args.listen,
+            master_address=self.args.master_address)
+        module = import_file_as_module(self.args.workflow)
+        if not hasattr(module, "run"):
+            print("workflow file must define run(load, main)",
+                  file=sys.stderr)
+            return 1
+        if self.args.visualize:
+            # construct only, print DOT
+            module.run(self._load, lambda **kw: None)
+            print(self.workflow.generate_graph())
+            return 0
+        module.run(self._load, self._main)
+        return 0
+
+
+def main(argv=None):
+    return Main(argv).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+"""Command line surface (rebuild of veles/cmdline.py:61-278).
+
+The reference aggregated every unit's ``init_parser`` via metaclass; here
+units registered in :data:`EXTRA_PARSERS` contribute argument groups to
+the single global parser (same capability, explicit registration).
+"""
+
+import argparse
+
+#: callables(parser) appended by modules that add CLI flags
+EXTRA_PARSERS = []
+
+
+def add_arguments(fn):
+    """Decorator registering an argument contributor."""
+    EXTRA_PARSERS.append(fn)
+    return fn
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="veles_tpu",
+        description="veles_tpu — TPU-native dataflow deep-learning "
+                    "framework: python -m veles_tpu <workflow.py> "
+                    "[config.py]")
+    p.add_argument("workflow", nargs="?",
+                   help="workflow python file (defines run(load, main))")
+    p.add_argument("config", nargs="?", default=None,
+                   help="config python file (mutates root.*)")
+    p.add_argument("-a", "--backend", default=None,
+                   help="device backend: tpu|gpu|numpy|auto "
+                        "(ref: veles -a flag)")
+    p.add_argument("-d", "--device", type=int, default=0,
+                   help="device index within the backend")
+    p.add_argument("-s", "--snapshot", default=None,
+                   help="resume from snapshot file")
+    p.add_argument("-c", "--config-override", action="append", default=[],
+                   metavar="SNIPPET",
+                   help='python snippet, e.g. "root.x.y = 1" '
+                        "(repeatable)")
+    p.add_argument("--seed", default=None,
+                   help="int, or file:N to read N bytes of entropy "
+                        "(ref: veles --random-seed)")
+    p.add_argument("--result-file", default=None,
+                   help="write gathered metrics JSON here")
+    p.add_argument("--dump-config", action="store_true",
+                   help="print the effective config and exit")
+    p.add_argument("--visualize", action="store_true",
+                   help="print the workflow graph DOT and exit")
+    p.add_argument("-l", "--listen", default=None, metavar="ADDR",
+                   help="run as coordinator, listen on host:port")
+    p.add_argument("-m", "--master-address", default=None, metavar="ADDR",
+                   help="run as worker of the given coordinator")
+    p.add_argument("-v", "--verbose", action="count", default=0,
+                   help="-v debug, -vv everything")
+    p.add_argument("--timings", action="store_true",
+                   help="per-unit run timing printout")
+    for fn in EXTRA_PARSERS:
+        fn(p)
+    return p
+
+
+def filter_argv(argv, *allowed):
+    """Keep only known flags — used when re-exec'ing workers
+    (ref: veles/launcher.py:75)."""
+    out = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        key = a.split("=")[0]
+        if key in allowed:
+            out.append(a)
+            if "=" not in a and i + 1 < len(argv) \
+                    and not argv[i + 1].startswith("-"):
+                out.append(argv[i + 1])
+                i += 1
+        i += 1
+    return out

@@ -1,0 +1,118 @@
+"""Launcher — the composition root (rebuild of veles/launcher.py:100-906).
+
+Owns runtime mode (standalone / coordinator / worker), the device, and
+the workflow lifecycle.  The reference parked the main thread in a
+Twisted reactor; here standalone runs are a plain synchronous
+``workflow.run()`` (the scheduler's worklist already expresses the
+graph's control flow) and distributed modes host the asyncio
+coordinator/worker services from :mod:`veles_tpu.parallel.coordinator`.
+"""
+
+import json
+import resource
+import time
+
+from veles_tpu.backends import Device
+from veles_tpu.logger import Logger
+from veles_tpu.memory import Watcher
+
+
+class Launcher(Logger):
+    """ref: veles/launcher.py:100.  Mode detection per launcher.py:333-356:
+    ``listen`` → coordinator ("master"), ``master_address`` → worker
+    ("slave"), else standalone."""
+
+    def __init__(self, backend=None, device_index=0, listen=None,
+                 master_address=None, **kwargs):
+        super(Launcher, self).__init__()
+        self._listen = listen
+        self._master_address = master_address
+        self._backend = backend
+        self._device_index = device_index
+        self.device = None
+        self.workflow = None
+        self.start_time = None
+        self.stopped = False
+
+    # -- mode (ref: launcher.py:333-356) --------------------------------------
+
+    @property
+    def mode(self):
+        if self._listen:
+            return "master"
+        if self._master_address:
+            return "slave"
+        return "standalone"
+
+    @property
+    def is_standalone(self):
+        return self.mode == "standalone"
+
+    @property
+    def is_master(self):
+        return self.mode == "master"
+
+    @property
+    def is_slave(self):
+        return self.mode == "slave"
+
+    # -- lifecycle (ref: launcher.py:431-579) ---------------------------------
+
+    def add_ref(self, workflow):
+        """Called by the top-level Workflow adopting this launcher as its
+        parent."""
+        self.workflow = workflow
+
+    def del_ref(self, workflow):
+        if self.workflow is workflow:
+            self.workflow = None
+
+    def initialize(self, **kwargs):
+        if self.device is None:
+            self.device = Device(backend=self._backend,
+                                 device_index=self._device_index)
+        self.info("mode: %s, device: %s", self.mode, self.device)
+        self.workflow.initialize(device=self.device, **kwargs)
+
+    def run(self):
+        """Run to completion (standalone) or serve (distributed)."""
+        self.start_time = time.time()
+        try:
+            if self.is_standalone:
+                self.workflow.run()
+            elif self.is_master:
+                from veles_tpu.parallel.coordinator import serve_master
+                serve_master(self)
+            else:
+                from veles_tpu.parallel.coordinator import serve_worker
+                serve_worker(self)
+        finally:
+            self.stop()
+
+    def boot(self, **kwargs):
+        self.initialize(**kwargs)
+        self.run()
+
+    def stop(self):
+        if self.stopped:
+            return
+        self.stopped = True
+        elapsed = time.time() - (self.start_time or time.time())
+        self.workflow.stop()
+        self.workflow.print_stats()
+        used, peak = Watcher.report()
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        self.info("total run time: %.2fs; peak RSS: %.1f MiB; "
+                  "peak device mem: %.1f MiB",
+                  elapsed, rss / 1024.0, peak / 2 ** 20)
+
+    # -- results (ref: workflow.py:827-849 + --result-file) -------------------
+
+    def write_results(self, path):
+        metrics = self.workflow.gather_results()
+        metrics["elapsed_sec"] = time.time() - (self.start_time
+                                                or time.time())
+        with open(path, "w") as f:
+            json.dump(metrics, f, indent=2, default=str)
+        self.info("results -> %s", path)
+        return metrics

@@ -53,19 +53,26 @@ class DecisionGD(Unit, IResultProvider):
                 max(self.min_validation_n_err_epoch, 0))
 
     def run(self):
+        """Per-minibatch accounting stays ON DEVICE (trainer.epoch_acc);
+        this unit syncs with the device only at epoch boundaries — the
+        per-step host read the reference did (znicz decision) would
+        serialize every dispatch."""
         l = self.loader
-        cls = l.minibatch_class
-        self.trainer.n_err.map_read()
-        self.trainer.loss.map_read()
-        self.epoch_n_err[cls] += int(self.trainer.n_err.mem)
-        self.epoch_samples[cls] += l.minibatch_size
-        self.epoch_loss_sum[cls] += float(self.trainer.loss.mem) \
-            * l.minibatch_size
         self.improved.set(False)
         if l.epoch_ended:
+            acc = self.trainer.read_epoch_acc(reset_classes=(TEST, VALID))
+            for cls in (TEST, VALID):
+                n_err, loss_sum, samples = acc[cls]
+                self.epoch_n_err[cls] = int(n_err)
+                self.epoch_samples[cls] = int(samples)
+                self.epoch_loss_sum[cls] = loss_sum
             self._on_epoch_ended()
         if l.train_ended:
-            # end of a full walk: reset train accounting
+            acc = self.trainer.read_epoch_acc(reset_classes=(TRAIN,))
+            n_err, loss_sum, samples = acc[TRAIN]
+            self.epoch_n_err[TRAIN] = int(n_err)
+            self.epoch_samples[TRAIN] = int(samples)
+            self.epoch_loss_sum[TRAIN] = loss_sum
             self._maybe_complete()
             self.epoch_n_err[TRAIN] = 0
             self.epoch_samples[TRAIN] = 0

@@ -78,6 +78,10 @@ class GradientDescent(AcceleratedUnit):
         self.opt_state = {}      # {layer_idx: {param: {slot: Array}}}
         self.loss = Array()
         self.n_err = Array()
+        #: device-side per-class epoch accumulator [class, (n_err,
+        #: loss_sum, samples)] — DecisionGD reads it once per epoch
+        #: instead of syncing on every minibatch
+        self.epoch_acc = Array()
         self.demand("forwards", "evaluator", "loader")
 
     def init_unpickled(self):
@@ -138,6 +142,7 @@ class GradientDescent(AcceleratedUnit):
                 self.opt_state[i] = per_param
         self.loss.reset(numpy.zeros((), numpy.float32))
         self.n_err.reset(numpy.zeros((), numpy.int32))
+        self.epoch_acc.reset(numpy.zeros((3, 3), numpy.float32))
         super(GradientDescent, self).initialize(device=device, **kwargs)
         for layer in self.opt_state.values():
             for slots in layer.values():
@@ -191,7 +196,7 @@ class GradientDescent(AcceleratedUnit):
                     mask, (pred != target).astype(jnp.int32), 0))
             return loss, n_err
 
-        def train_step(params, opt_state, x, target, size, class_id,
+        def train_step(params, opt_state, acc, x, target, size, class_id,
                        step_no, lr_mult, key):
             def do_train(args):
                 params, opt_state = args
@@ -220,11 +225,19 @@ class GradientDescent(AcceleratedUnit):
                     params, x, target, size, key, False)
                 return params, opt_state, loss, n_err
 
-            return jax.lax.cond(class_id == TRAIN, do_train, do_eval,
-                                (params, opt_state))
+            params, opt_state, loss, n_err = jax.lax.cond(
+                class_id == TRAIN, do_train, do_eval,
+                (params, opt_state))
+            # per-class epoch accounting stays on device: one row of
+            # [n_err, loss*size, size] added to the class's accumulator
+            row = jnp.stack([n_err.astype(jnp.float32),
+                             loss * size, size.astype(jnp.float32)])
+            onehot = (jnp.arange(3) == class_id).astype(jnp.float32)
+            acc = acc + onehot[:, None] * row[None, :]
+            return params, opt_state, acc, loss, n_err
 
         if self.mesh is None:
-            return jax.jit(train_step, donate_argnums=(0, 1))
+            return jax.jit(train_step, donate_argnums=(0, 1, 2))
         return self._shard_train_step(train_step)
 
     def _shard_train_step(self, train_step):
@@ -256,13 +269,13 @@ class GradientDescent(AcceleratedUnit):
             else len(self.loader.minibatch_labels.shape)
         tgt_sh = shlib.batch_sharding(mesh, tgt_ndim, dim0=mb)
         rep = shlib.replicated(mesh)
-        self._shardings_ = (params_sh, opt_sh, x_sh, tgt_sh)
+        self._shardings_ = (params_sh, opt_sh, x_sh, tgt_sh, rep)
         return jax.jit(
             train_step,
-            in_shardings=(params_sh, opt_sh, x_sh, tgt_sh,
+            in_shardings=(params_sh, opt_sh, rep, x_sh, tgt_sh,
                           rep, rep, rep, rep, rep),
-            out_shardings=(params_sh, opt_sh, rep, rep),
-            donate_argnums=(0, 1))
+            out_shardings=(params_sh, opt_sh, rep, rep, rep),
+            donate_argnums=(0, 1, 2))
 
     # -- execution -------------------------------------------------------------
 
@@ -286,9 +299,12 @@ class GradientDescent(AcceleratedUnit):
             # redistribute onto the mesh: batch tensors every step; the
             # state pytrees only once — afterwards they adopt the sharded
             # step outputs directly
-            params_sh, opt_sh, x_sh, tgt_sh = self._shardings_
+            params_sh, opt_sh, x_sh, tgt_sh, rep = self._shardings_
             x = jax.device_put(x, x_sh)
             target = jax.device_put(target, tgt_sh)
+            if self.epoch_acc.devmem.sharding != rep:
+                self.epoch_acc.devmem = jax.device_put(
+                    self.epoch_acc.devmem, rep)
             # state normally adopts the sharded step outputs; re-put only
             # when a host-side write (rollback, snapshot resume) reset a
             # leaf to single-device placement — one leaf check suffices
@@ -300,11 +316,12 @@ class GradientDescent(AcceleratedUnit):
                 opt_state = jax.tree.map(
                     jax.device_put, opt_state, opt_sh)
         key = self.prng.peek_key(self.global_step)
-        new_params, new_opt, loss, n_err = self._train_step_(
-            params, opt_state, x, target,
+        new_params, new_opt, acc, loss, n_err = self._train_step_(
+            params, opt_state, self.epoch_acc.devmem, x, target,
             jnp.int32(l.minibatch_size), jnp.int32(l.minibatch_class),
             jnp.float32(self.global_step),
             jnp.float32(self.lr_multiplier), key)
+        self.epoch_acc.devmem = acc
         for i, u in enumerate(self.forwards):
             for name, arr in u.param_arrays().items():
                 arr.devmem = new_params[i][name]
@@ -316,6 +333,19 @@ class GradientDescent(AcceleratedUnit):
         self.n_err.devmem = n_err
         if l.minibatch_class == TRAIN:
             self.global_step += 1
+
+    def read_epoch_acc(self, reset_classes=()):
+        """One host sync: {class: (n_err, loss_sum, samples)}; resets the
+        requested class rows for the next epoch."""
+        self.epoch_acc.map_read()
+        acc = numpy.array(self.epoch_acc.mem)
+        if len(reset_classes):
+            self.epoch_acc.map_write()
+            for c in reset_classes:
+                self.epoch_acc.mem[c] = 0
+            self.epoch_acc.unmap()
+        return {c: (float(acc[c, 0]), float(acc[c, 1]), float(acc[c, 2]))
+                for c in range(3)}
 
     def step(self, **tensors):
         raise RuntimeError("GradientDescent dispatches its own program")

@@ -1,0 +1,2 @@
+"""Sample workflows (the reference shipped these via the Forge hub:
+MnistSimple, CIFAR10, AlexNet — manualrst_veles_algorithms.rst)."""

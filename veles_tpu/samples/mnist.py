@@ -1,0 +1,161 @@
+"""MNIST fully-connected workflow — BASELINE.json config 1
+(znicz MnistWorkflow 784→100→10, SGD; ref surface:
+manualrst_veles_algorithms.rst "MnistSimple").
+
+Run: ``python -m veles_tpu veles_tpu/samples/mnist.py \
+veles_tpu/samples/mnist_config.py``
+
+Graph::
+
+    start → repeater → loader → trainer(gd) → decision ─┬→ repeater (loop)
+                                                        ├→ snapshotter
+                                                        └→ end  [gated on
+                                                            decision.complete]
+"""
+
+import gzip
+import os
+import struct
+
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedWorkflow
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models import DecisionGD, GradientDescent
+from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
+from veles_tpu.models.evaluator import EvaluatorSoftmax
+from veles_tpu.plumbing import Repeater
+from veles_tpu.snapshotter import Snapshotter
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">HBB", f.read(4))
+        dtype_code, ndim = magic[1], magic[2]
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        assert dtype_code == 0x08  # ubyte
+        return numpy.frombuffer(f.read(), numpy.uint8).reshape(dims)
+
+
+class MnistLoader(FullBatchLoader):
+    """Standard IDX files from ``root.common.dirs.datasets``/mnist; a
+    deterministic synthetic stand-in is generated when the files are
+    absent (this build environment has no egress — the reference's
+    Downloader unit would have fetched them, veles/downloader.py:56)."""
+
+    def _find(self, *names):
+        base = os.path.join(root.common.dirs.get("datasets", "data"),
+                            "mnist")
+        for n in names:
+            for suffix in ("", ".gz"):
+                p = os.path.join(base, n + suffix)
+                if os.path.isfile(p):
+                    return p
+        return None
+
+    def load_data(self):
+        ti = self._find("train-images-idx3-ubyte", "train-images.idx3-ubyte")
+        tl = self._find("train-labels-idx1-ubyte", "train-labels.idx1-ubyte")
+        vi = self._find("t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte")
+        vl = self._find("t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte")
+        if all((ti, tl, vi, vl)):
+            train = _read_idx(ti).reshape(-1, 784)
+            train_l = _read_idx(tl)
+            valid = _read_idx(vi).reshape(-1, 784)
+            valid_l = _read_idx(vl)
+            self.info("loaded real MNIST (%d train / %d validation)",
+                      len(train), len(valid))
+        else:
+            self.warning("MNIST files not found under %s — generating a "
+                         "deterministic synthetic stand-in",
+                         root.common.dirs.get("datasets", "data"))
+            rng = numpy.random.default_rng(1234)
+            n_train = int(root.mnist_tpu.get("synthetic_train", 8192))
+            n_valid = int(root.mnist_tpu.get("synthetic_valid", 1024))
+            centers = rng.normal(scale=2.0, size=(10, 784))
+            tl_all = rng.integers(0, 10, n_train + n_valid)
+            data = (centers[tl_all]
+                    + rng.normal(size=(n_train + n_valid, 784)))
+            data = numpy.clip((data - data.min()) /
+                              (data.max() - data.min()) * 255, 0, 255)
+            train, valid = data[:n_train], data[n_train:]
+            train_l, valid_l = tl_all[:n_train], tl_all[n_train:]
+        self.class_lengths[:] = [0, len(valid), len(train)]
+        self.original_data = numpy.concatenate(
+            [valid, train]).astype(numpy.float32) / 255.0
+        self.original_labels = numpy.concatenate(
+            [valid_l, train_l]).tolist()
+
+
+class MnistWorkflow(AcceleratedWorkflow):
+    """The classic Veles first workflow, TPU-native."""
+
+    def __init__(self, workflow, layers=(100, 10), **kwargs):
+        super(MnistWorkflow, self).__init__(workflow, name="MNIST",
+                                            **kwargs)
+        cfg = root.mnist_tpu
+        self.repeater = Repeater(self)
+        self.repeater.link_from(self.start_point)
+
+        self.loader = MnistLoader(
+            self, minibatch_size=int(cfg.get("minibatch_size", 128)),
+            normalization_type=cfg.get("normalization", "none"))
+        self.loader.link_from(self.repeater)
+
+        self.forwards = []
+        prev = self.loader.minibatch_data
+        for i, width in enumerate(layers[:-1]):
+            fc = All2AllTanh(
+                self, output_sample_shape=(int(width),),
+                name="fc%d" % i,
+                weights_stddev=cfg.get("weights_stddev"))
+            fc.input = prev
+            self.forwards.append(fc)
+            prev = fc.output
+        head = All2AllSoftmax(
+            self, output_sample_shape=(int(layers[-1]),), name="head")
+        head.input = prev
+        self.forwards.append(head)
+
+        self.evaluator = EvaluatorSoftmax(self)
+        self.evaluator.output = head.output
+        self.evaluator.labels = self.loader.minibatch_labels
+        self.evaluator.loader = self.loader
+
+        self.gd = GradientDescent(
+            self, forwards=self.forwards, evaluator=self.evaluator,
+            loader=self.loader,
+            solver=cfg.get("solver", "sgd"),
+            learning_rate=float(cfg.get("learning_rate", 0.1)),
+            gradient_moment=float(cfg.get("gradient_moment", 0.9)),
+            weights_decay=float(cfg.get("weights_decay", 0.0)))
+        self.gd.link_from(self.loader)
+
+        self.decision = DecisionGD(
+            self,
+            fail_iterations=int(cfg.get("fail_iterations", 25)),
+            max_epochs=cfg.get("max_epochs"))
+        self.decision.loader = self.loader
+        self.decision.trainer = self.gd
+        self.decision.link_from(self.gd)
+
+        self.snapshotter = Snapshotter(
+            self, prefix=cfg.get("snapshot_prefix", "mnist"),
+            compression=cfg.get("snapshot_compression", "gz"),
+            time_interval=float(cfg.get("snapshot_time_interval", 5.0)))
+        self.snapshotter.decision = self.decision
+        self.snapshotter.link_from(self.decision)
+
+        # the training loop: decision → repeater until complete
+        self.repeater.link_from(self.decision)
+        self.loader.gate_block = self.decision.complete
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+
+def run(load, main):
+    layers = root.mnist_tpu.get("layers", [100, 10])
+    load(MnistWorkflow, layers=layers)
+    main()
