@@ -17,19 +17,27 @@ class FakeMasterWorkflow:
         self.served = 0
         self.applied = []
         self.dropped = []
+        self.in_flight = {}
 
     def checksum(self):
         return "abc123"
 
     def generate_data_for_slave(self, slave_id):
         self.served += 1
+        self.in_flight.setdefault(slave_id, []).append(self.served)
         return {"job_no": self.served}
 
     def apply_data_from_slave(self, data, slave_id):
         self.applied.append((slave_id, data))
+        jobs = self.in_flight.get(slave_id)
+        if jobs:
+            jobs.pop()
 
     def drop_slave(self, slave_id):
+        # refile the dead worker's in-flight jobs, like the real loader's
+        # failed_minibatches (veles_tpu/loader/base.py drop_slave)
         self.dropped.append(slave_id)
+        self.served -= len(self.in_flight.pop(slave_id, []))
 
     def has_more_jobs(self):
         return self.served < self.n_jobs

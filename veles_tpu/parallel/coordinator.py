@@ -22,6 +22,7 @@ minibatches (``Workflow.drop_slave``) and blacklists repeat offenders.
 """
 
 import asyncio
+import contextlib
 import gzip
 import pickle
 import struct
@@ -99,14 +100,21 @@ class Coordinator(Logger):
 
     async def stop(self):
         self._watchdog_task.cancel()
+        with contextlib.suppress(asyncio.CancelledError):
+            await self._watchdog_task
         for w in list(self.workers.values()):
             try:
                 await send_frame(w.writer, {"cmd": "terminate"})
-                w.writer.close()
             except Exception:
                 pass
+            w.writer.close()
         self._server.close()
-        await self._server.wait_closed()
+        # py3.12 wait_closed() blocks until every connection handler AND
+        # transport is gone; handlers close their writers in _on_connect's
+        # finally, so this terminates — but cap it in case a worker holds
+        # its end open across a network partition.
+        with contextlib.suppress(asyncio.TimeoutError, TimeoutError):
+            await asyncio.wait_for(self._server.wait_closed(), 5.0)
 
     # -- protocol (ref: server.py:230-254 FSM) ---------------------------------
 
@@ -139,6 +147,9 @@ class Coordinator(Logger):
             pass
         finally:
             self._drop(worker, requeue=True)
+            writer.close()
+            with contextlib.suppress(Exception):
+                await writer.wait_closed()
 
     async def _serve_worker(self, worker, reader):
         while True:
@@ -149,13 +160,14 @@ class Coordinator(Logger):
                     await send_frame(worker.writer, {"cmd": "terminate"})
                     self._drop(worker, requeue=False)
                     return
-                if not self._has_more_jobs():
+                if self._has_more_jobs():
+                    job = self.workflow.generate_data_for_slave(worker.id)
+                else:
                     # out of fresh jobs but updates still in flight —
                     # the worker idles until drained (ref NEED_UPDATE
                     # postponement, server.py:369-399)
                     await send_frame(worker.writer, {"cmd": "wait"})
                     continue
-                job = self.workflow.generate_data_for_slave(worker.id)
                 worker.state = "WORK"
                 worker.job_started = time.time()
                 await send_frame(worker.writer, {"cmd": "job",
@@ -189,6 +201,8 @@ class Coordinator(Logger):
             return
         del self.workers[worker.id]
         if requeue:
+            # the workflow refiles the worker's in-flight minibatches
+            # (ref: loader/base.py:679-687 failed_minibatches)
             self.workflow.drop_slave(worker.id)
             self.info("worker %s dropped — work requeued", worker.id)
 
@@ -247,33 +261,38 @@ class WorkerClient(Logger):
 
     async def _session(self):
         reader, writer = await asyncio.open_connection(self.host, self.port)
-        await send_frame(writer, {
-            "checksum": self.workflow.checksum(),
-            "power": self.power if self.power is not None else 1.0,
-            "id": self.worker_id,
-        })
-        reply = await recv_frame(reader)
-        if "error" in reply:
-            raise ConnectionError(reply["error"])
-        self.worker_id = reply["id"]
-        self.info("joined as worker %s", self.worker_id)
-        while True:
-            await send_frame(writer, {"cmd": "job"})
-            msg = await recv_frame(reader)
-            cmd = msg.get("cmd")
-            if cmd == "terminate":
-                return
-            if cmd == "wait":
-                await asyncio.sleep(0.2)
-                continue
-            update = {}
+        try:
+            await send_frame(writer, {
+                "checksum": self.workflow.checksum(),
+                "power": self.power if self.power is not None else 1.0,
+                "id": self.worker_id,
+            })
+            reply = await recv_frame(reader)
+            if "error" in reply:
+                raise ConnectionError(reply["error"])
+            self.worker_id = reply["id"]
+            self.info("joined as worker %s", self.worker_id)
+            while True:
+                await send_frame(writer, {"cmd": "job"})
+                msg = await recv_frame(reader)
+                cmd = msg.get("cmd")
+                if cmd == "terminate":
+                    return
+                if cmd == "wait":
+                    await asyncio.sleep(0.2)
+                    continue
+                update = {}
 
-            def on_done(data):
-                update["data"] = data
+                def on_done(data):
+                    update["data"] = data
 
-            self.workflow.do_job(msg["data"], None, on_done)
-            await send_frame(writer, {"cmd": "update",
-                                      "data": update.get("data")})
+                self.workflow.do_job(msg["data"], None, on_done)
+                await send_frame(writer, {"cmd": "update",
+                                          "data": update.get("data")})
+        finally:
+            writer.close()
+            with contextlib.suppress(Exception):
+                await writer.wait_closed()
 
 
 def serve_master(launcher):
