@@ -63,6 +63,33 @@ def run_loop(coro):
     return asyncio.new_event_loop().run_until_complete(coro)
 
 
+class _FakeLauncher:
+    def __init__(self, mode):
+        self.mode = mode
+
+    def add_ref(self, unit):
+        pass
+
+    def del_ref(self, unit):
+        pass
+
+
+def _make_real_workflow(mode):
+    """A tiny real MnistWorkflow in the given mode (master graphs never
+    run; workers execute one minibatch per job)."""
+    from veles_tpu.backends import Device
+    from veles_tpu.config import root
+    from veles_tpu.samples.mnist import MnistWorkflow
+    root.mnist_tpu.synthetic_train = 256
+    root.mnist_tpu.synthetic_valid = 64
+    root.mnist_tpu.minibatch_size = 32
+    root.mnist_tpu.max_epochs = 2
+    root.mnist_tpu.snapshot_time_interval = 1e9
+    wf = MnistWorkflow(_FakeLauncher(mode))
+    wf.initialize(device=Device(backend="numpy"))
+    return wf
+
+
 class TestCoordinator:
     def test_job_flow_single_worker(self):
         async def main():
@@ -112,6 +139,30 @@ class TestCoordinator:
             await coord.stop()
 
         run_loop(main())
+
+    def test_two_workers_real_workflow_completes(self):
+        """Full product path: a real workflow trains across TWO async
+        workers and the master's sample-count epoch tracking terminates
+        the run (serve-time loader flags are NOT observable with >1
+        worker in flight — this is the regression shape)."""
+        async def main():
+            master = _make_real_workflow("master")
+            coord = Coordinator(master, port=0)
+            await coord.start()
+            addr = "127.0.0.1:%d" % coord.port
+            w1 = _make_real_workflow("slave")
+            w2 = _make_real_workflow("slave")
+            c1 = WorkerClient(w1, addr)
+            c2 = WorkerClient(w2, addr)
+            await asyncio.wait_for(asyncio.gather(c1.run(), c2.run()), 120)
+            await coord.stop()
+            return master
+
+        master = run_loop(main())
+        assert master.all_jobs_done()
+        assert master.decision._master_epoch >= 2
+        assert master.decision.epoch_metrics.get(
+            "validation_error_pct") is not None
 
     def test_dropped_worker_requeues(self):
         async def main():

@@ -246,16 +246,17 @@ class TestTrainer:
               "adam": 0.01}[solver]
         wf, loader, layers, ev, gd = build_mlp_workflow(
             device, solver=solver, lr=lr)
-        first_losses, last_losses = [], []
+        losses = []
 
         def collect():
+            # span serving: one train wave per epoch; loss is the last
+            # minibatch's — compare the first epoch's vs the last's
             if loader.minibatch_class == TRAIN:
                 gd.loss.map_read()
-                (first_losses if loader.epoch_number < 1
-                 else last_losses).append(float(gd.loss.mem))
+                losses.append(float(gd.loss.mem))
 
         run_epochs(loader, gd, n_epochs=4, extra=collect)
-        assert numpy.mean(last_losses[-5:]) < numpy.mean(first_losses[:5])
+        assert losses[-1] < losses[0]
 
     def test_dropout_training(self, device):
         wf, loader, layers, ev, gd = build_mlp_workflow(

@@ -100,7 +100,8 @@ class TestShardedTraining:
                 losses.append(float(gd.loss.mem))
             if loader.train_ended:
                 walks += 1
-        assert numpy.mean(losses[-5:]) < numpy.mean(losses[:5])
+        # span serving: one train wave per epoch — compare first vs last
+        assert losses[-1] < losses[0]
 
     def test_sharded_matches_single_device(self, device):
         # same seed, same data: the dp-sharded step must produce the

@@ -98,6 +98,12 @@ class Loader(Unit, ILoader, IDistributable, IResultProvider):
         #: worker-id -> list of in-flight (offset, size) jobs — volatile,
         #: a restart abandons in-flight bookkeeping (ref: base.py:205)
         self.pending_minibatches_ = {}
+        #: span-serving handoff (see :meth:`_serve_span`): index schedule
+        #: of the last served class span + freshness flag for the trainer
+        self.span_indices_ = None
+        self.span_sizes_ = None
+        self.span_class_ = None
+        self.span_fresh_ = False
 
     # -- derived quantities ---------------------------------------------------
 
@@ -202,10 +208,68 @@ class Loader(Unit, ILoader, IDistributable, IResultProvider):
 
     # -- serving (ref: base.py:726-910) ---------------------------------------
 
+    #: subclasses that can hand a whole class span to the trainer in one
+    #: device dispatch set this True (see FullBatchLoader)
+    supports_span = False
+    #: None = auto (the trainer turns it on when it can consume spans);
+    #: builders wiring per-minibatch consumers of minibatch_data/labels
+    #: into the wave graph must set it to False explicitly
+    span_serving = None
+
+    @property
+    def span_capable(self):
+        """Span serving is a standalone-mode fast path: distributed jobs
+        and failed-minibatch refiles stay per-minibatch."""
+        return (self.supports_span and bool(self.span_serving)
+                and not self.is_master and not self.is_slave
+                and not self.failed_minibatches)
+
     def run(self):
         self.pending_minibatches_.pop(None, None)
-        self.serve_next_minibatch(None)
-        self._on_successful_serve()
+        if self.span_capable:
+            self._serve_span()
+        else:
+            self.serve_next_minibatch(None)
+            self._on_successful_serve()
+
+    def _serve_span(self):
+        """Serve ALL remaining minibatches of the current class span at
+        once: publish the index schedule (``span_indices_`` [K, mb] +
+        ``span_sizes_`` [K]) for the trainer to scan over in one jitted
+        dispatch, and advance the host bookkeeping to the span end.  The
+        flag sequence the Decision unit observes is identical to the
+        per-minibatch path's boundary waves (one wave per class span
+        instead of one per minibatch)."""
+        if self.global_offset >= self.effective_total_samples:
+            self.global_offset = 0
+            self.shuffle()
+        ci, _ = self._class_by_offset(self.global_offset)
+        span_end = self._effective_end_offsets()[ci]
+        start = self.global_offset
+        span = span_end - start
+        mb = self.max_minibatch_size
+        k = -(-span // mb)
+        self.shuffled_indices.map_read()
+        idx = numpy.full((k * mb,), -1, INDEX_DTYPE)
+        idx[:span] = self.shuffled_indices.mem[start:span_end]
+        self.span_indices_ = idx.reshape(k, mb)
+        sizes = numpy.full((k,), mb, INDEX_DTYPE)
+        sizes[-1] = span - (k - 1) * mb
+        self.span_sizes_ = sizes
+        self.span_class_ = ci
+        self.span_fresh_ = True
+
+        self.minibatch_class = ci
+        self.minibatch_offset = span_end
+        self.minibatch_size = int(sizes[-1])
+        self.global_offset = span_end
+        self.train_ended.set(
+            self.global_offset >= self.effective_total_samples)
+        self.samples_served += span
+        if self.effective_total_samples:
+            self.epoch_number = \
+                self.samples_served // self.effective_total_samples
+        self._update_flags()
 
     def serve_next_minibatch(self, slave_id):
         try:

@@ -40,10 +40,38 @@ class FullBatchLoader(Loader):
         self.force_numpy = force_numpy
         self.device = None
 
+    supports_span = True
+
     def init_unpickled(self):
         super(FullBatchLoader, self).init_unpickled()
         self._dataset_dev_ = None
+        self._labels_dev_ = None
         self._gather_jit_ = None
+
+    @property
+    def span_capable(self):
+        # the trainer gathers targets in-graph, so a device-resident
+        # label (or MSE target) array is required
+        return super(FullBatchLoader, self).span_capable \
+            and self._dataset_dev_ is not None \
+            and (self._labels_dev_ is not None
+                 or getattr(self, "_targets_dev_", None) is not None)
+
+    @property
+    def dataset_dev(self):
+        """The HBM-resident dataset (trainer scans gather from it)."""
+        return self._dataset_dev_
+
+    @property
+    def labels_dev(self):
+        return self._labels_dev_
+
+    def rehome_dataset(self, sharding):
+        """Re-place the resident dataset (e.g. replicate over a mesh);
+        the previous placement is released."""
+        self._dataset_dev_ = jax.device_put(self._dataset_dev_, sharding)
+        if self._labels_dev_ is not None:
+            self._labels_dev_ = jax.device_put(self._labels_dev_, sharding)
 
     # -- ILoader ---------------------------------------------------------------
 
@@ -102,6 +130,9 @@ class FullBatchLoader(Loader):
             return
         self._dataset_dev_ = jax.device_put(
             self.original_data, self.device.jax_device)
+        if self._numeric_labels is not None:
+            self._labels_dev_ = jax.device_put(
+                self._numeric_labels, self.device.jax_device)
 
         # computation follows the dataset's committed placement; padded
         # tail rows are zeroed in-kernel (size is traced, shapes static)
@@ -168,6 +199,16 @@ class FullBatchLoaderMSE(FullBatchLoader):
     def init_unpickled(self):
         super(FullBatchLoaderMSE, self).init_unpickled()
         self._targets_dev_ = None
+
+    @property
+    def targets_dev(self):
+        return self._targets_dev_
+
+    def rehome_dataset(self, sharding):
+        super(FullBatchLoaderMSE, self).rehome_dataset(sharding)
+        if self._targets_dev_ is not None:
+            self._targets_dev_ = jax.device_put(self._targets_dev_,
+                                                sharding)
 
     def create_minibatch_data(self):
         super(FullBatchLoaderMSE, self).create_minibatch_data()

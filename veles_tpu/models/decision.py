@@ -40,7 +40,16 @@ class DecisionGD(Unit, IResultProvider):
         self.min_validation_n_err = None
         self.min_validation_n_err_epoch = -1
         self.best_train_n_err = None
+        #: master-side epoch counter — with several async workers the
+        #: loader's serve-time flags are not observable at update-apply
+        #: time, so the master counts epochs by applied sample totals
+        self._master_epoch = 0
         self.demand("loader", "trainer")
+
+    @property
+    def effective_epoch(self):
+        return self._master_epoch if self.is_master \
+            else self.loader.epoch_number
 
     def _loss_driven(self):
         from veles_tpu.models.evaluator import EvaluatorMSE
@@ -49,7 +58,7 @@ class DecisionGD(Unit, IResultProvider):
 
     @property
     def fail_count(self):
-        return (self.loader.epoch_number -
+        return (self.effective_epoch -
                 max(self.min_validation_n_err_epoch, 0))
 
     def run(self):
@@ -57,6 +66,18 @@ class DecisionGD(Unit, IResultProvider):
         this unit syncs with the device only at epoch boundaries — the
         per-step host read the reference did (znicz decision) would
         serialize every dispatch."""
+        if self.is_slave:
+            # one job = one minibatch wave: close the loop gate so
+            # do_job's run() returns; epoch accounting happens on the
+            # master from the acc deltas workers send (znicz decision
+            # behaved the same way on slaves)
+            self.complete.set(True)
+            if self._workflow is not None:
+                self._workflow.on_workflow_finished()
+            return
+        self._evaluate_epoch()
+
+    def _evaluate_epoch(self):
         l = self.loader
         self.improved.set(False)
         if l.epoch_ended:
@@ -100,12 +121,12 @@ class DecisionGD(Unit, IResultProvider):
         if self.min_validation_n_err is None \
                 or metric < self.min_validation_n_err:
             self.min_validation_n_err = metric
-            self.min_validation_n_err_epoch = l.epoch_number
+            self.min_validation_n_err_epoch = self.effective_epoch
             self.improved.set(True)
         self.info(
             "epoch %d: validation err %.2f%% (best %s @ epoch %d), "
             "val loss %.4f",
-            l.epoch_number, self._error_pct(VALID),
+            self.effective_epoch, self._error_pct(VALID),
             self.min_validation_n_err, self.min_validation_n_err_epoch,
             self.epoch_metrics.get("validation_loss", float("nan")))
         self._maybe_complete()
@@ -115,9 +136,8 @@ class DecisionGD(Unit, IResultProvider):
             self.epoch_loss_sum[cls] = 0.0
 
     def _maybe_complete(self):
-        l = self.loader
         if self.max_epochs is not None \
-                and l.epoch_number >= self.max_epochs:
+                and self.effective_epoch >= self.max_epochs:
             self.complete.set(True)
         if self.min_validation_n_err is not None \
                 and self.fail_count > self.fail_iterations:
@@ -126,6 +146,55 @@ class DecisionGD(Unit, IResultProvider):
             self.complete.set(True)
         if self.complete and self._workflow is not None:
             self._workflow.on_workflow_finished()
+
+    # -- elastic DCN sync: the master evaluates epochs as worker updates
+    #    land (its graph never runs); workers just reset their loop gate --
+
+    negotiates_on_connect = True
+
+    def generate_data_for_slave(self, slave=None):
+        return True  # presence alone triggers the worker-side reset
+
+    def apply_data_from_master(self, data):
+        self.complete.set(False)
+
+    def generate_data_for_master(self):
+        return True
+
+    def apply_data_from_slave(self, data, slave=None):
+        """Master: with several async workers the loader's serve-time
+        flags aren't observable here (another worker may already hold
+        next-epoch jobs), so epochs complete when the *applied* sample
+        totals in the trainer's accumulator reach the class lengths
+        (the reference master was equally asynchronous about it)."""
+        l = self.loader
+        acc = self.trainer.read_epoch_acc()
+        self.improved.set(False)
+        eval_cls = VALID if l.class_lengths[VALID] else TEST
+        needed = l.class_lengths[eval_cls]
+        if needed and acc[eval_cls][2] >= needed:
+            a = self.trainer.read_epoch_acc(reset_classes=(TEST, VALID))
+            for cls in (TEST, VALID):
+                n_err, loss_sum, samples = a[cls]
+                self.epoch_n_err[cls] = int(n_err)
+                self.epoch_samples[cls] = int(samples)
+                self.epoch_loss_sum[cls] = loss_sum
+            self._on_epoch_ended()
+        train_needed = l.effective_total_samples - l.class_end_offsets[VALID]
+        if train_needed and acc[TRAIN][2] >= train_needed:
+            a = self.trainer.read_epoch_acc(reset_classes=(TRAIN,))
+            n_err, loss_sum, samples = a[TRAIN]
+            self.epoch_n_err[TRAIN] = int(n_err)
+            self.epoch_samples[TRAIN] = int(samples)
+            self.epoch_loss_sum[TRAIN] = loss_sum
+            self._master_epoch += 1
+            self._maybe_complete()
+            self.epoch_n_err[TRAIN] = 0
+            self.epoch_samples[TRAIN] = 0
+            self.epoch_loss_sum[TRAIN] = 0.0
+
+    def drop_slave(self, slave=None):
+        pass
 
     def get_metric_values(self):
         out = dict(self.epoch_metrics)

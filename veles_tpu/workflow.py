@@ -193,6 +193,15 @@ class Workflow(Unit):
         self.run()
         callback(self.generate_data_for_master())
 
+    def has_more_jobs(self):
+        """Coordinator-side: keep serving until a unit (the Decision)
+        declares the workflow finished (ref NoMoreJobs flow:
+        veles/workflow.py:500-502)."""
+        return not bool(self.stopped)
+
+    def all_jobs_done(self):
+        return bool(self.stopped)
+
     # -- results (ref: workflow.py:827-849) ---------------------------------
 
     def gather_results(self):
