@@ -1,0 +1,187 @@
+"""Image loader stack + LRN + CIFAR conv workflow
+(VERDICT round-1 item 2; ref surfaces: veles/loader/image.py:106,
+loader/file_image.py:53, loader/fullbatch_image.py:56,
+manualrst_veles_algorithms.rst LRN item)."""
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.config import root
+
+
+# -- ImagePipeline ------------------------------------------------------------
+
+def test_pipeline_scale_crop_mirror():
+    from veles_tpu.loader.image import ImagePipeline
+    arr = numpy.zeros((40, 60, 3), numpy.uint8)
+    arr[:, :30] = 200  # left half bright
+    p = ImagePipeline(scale=(30, 20), crop=(16, 10), mirror=True)
+    out = p(arr)
+    assert out.shape == (10, 16, 3)
+    assert out.dtype == numpy.float32
+    # mirrored: bright half is now on the right
+    assert out[:, -1].mean() > out[:, 0].mean()
+
+
+def test_pipeline_aspect_ratio_pad():
+    from veles_tpu.loader.image import ImagePipeline
+    arr = numpy.full((10, 40, 1), 255, numpy.uint8)
+    p = ImagePipeline(scale=(20, 20), scale_maintain_aspect_ratio=True,
+                      color_space="GRAY")
+    out = p(arr)
+    assert out.shape == (20, 20, 1)
+    # wide image letterboxed: top/bottom padded with zeros
+    assert out[0].max() == 0 and out[-1].max() == 0
+    assert out[10].max() == 1.0
+
+
+def test_pipeline_sobel_channel():
+    from veles_tpu.loader.image import ImagePipeline
+    arr = numpy.zeros((16, 16, 3), numpy.uint8)
+    arr[:, 8:] = 255  # vertical edge
+    out = ImagePipeline(add_sobel=True)(arr)
+    assert out.shape == (16, 16, 4)
+    assert out[8, 8, 3] > 0.5  # edge response at the boundary
+
+
+# -- file image loaders -------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def image_tree(tmp_path_factory):
+    """Directory tree <root>/<class>/<n>.png with 2 classes, distinct
+    brightness per class."""
+    from PIL import Image
+    base = tmp_path_factory.mktemp("imgs")
+    for label, level in (("dark", 40), ("light", 220)):
+        d = base / "train" / label
+        d.mkdir(parents=True)
+        rng = numpy.random.default_rng(hash(label) % 2**32)
+        for i in range(12):
+            arr = numpy.clip(rng.normal(
+                level, 10, (8, 8, 3)), 0, 255).astype(numpy.uint8)
+            Image.fromarray(arr).save(d / ("%02d.png" % i))
+    v = base / "valid"
+    for label, level in (("dark", 40), ("light", 220)):
+        d = v / label
+        d.mkdir(parents=True)
+        for i in range(4):
+            arr = numpy.full((8, 8, 3), level, numpy.uint8)
+            Image.fromarray(arr).save(d / ("%02d.png" % i))
+    return base
+
+
+def test_fullbatch_file_image_loader(image_tree):
+    from veles_tpu.loader.image import FullBatchFileImageLoader
+    dev = Device(backend="numpy")
+    loader = FullBatchFileImageLoader(
+        None, validation_paths=[str(image_tree / "valid")],
+        train_paths=[str(image_tree / "train")],
+        minibatch_size=8)
+    loader.initialize(device=dev)
+    assert loader.class_lengths == [0, 8, 24]
+    assert loader.original_data.shape == (32, 8, 8, 3)
+    # labels mapped from directory names, deterministically sorted
+    assert loader.labels_mapping == {"dark": 0, "light": 1}
+    loader.run()
+    assert loader.minibatch_data.mem.shape == (8, 8, 8, 3)
+
+
+def test_streaming_file_image_loader(image_tree):
+    from veles_tpu.loader.image import FileImageLoader
+    dev = Device(backend="numpy")
+    loader = FileImageLoader(
+        None, validation_paths=[str(image_tree / "valid")],
+        train_paths=[str(image_tree / "train")],
+        minibatch_size=8, crop=(6, 6), mirror="random")
+    loader.initialize(device=dev)
+    assert loader.total_samples == 32
+    loader.run()
+    assert loader.minibatch_data.mem.shape == (8, 6, 6, 3)
+    # labels resolved through labels_mapping
+    assert set(loader.minibatch_labels.mem[:loader.minibatch_size]) \
+        <= {0, 1}
+
+
+def test_filename_regex_labels(tmp_path):
+    from PIL import Image
+    from veles_tpu.loader.image import FullBatchFileImageLoader
+    d = tmp_path / "t"
+    d.mkdir()
+    for i, cls in enumerate(["catA", "dogB", "catC"]):
+        Image.fromarray(numpy.zeros((4, 4, 3), numpy.uint8)).save(
+            d / ("%s_%d.png" % (cls, i)))
+    loader = FullBatchFileImageLoader(
+        None, train_paths=[str(d)], filename_re=r"^(cat|dog)",
+        minibatch_size=3)
+    loader.initialize(device=Device(backend="numpy"))
+    assert loader.labels_mapping == {"cat": 0, "dog": 1}
+
+
+# -- LRN ----------------------------------------------------------------------
+
+def test_lrn_formula():
+    from veles_tpu.models.lrn import LRNormalizerForward
+    u = LRNormalizerForward(None, alpha=0.001, beta=0.75, n=3, k=2.0)
+    x = numpy.random.default_rng(0).normal(
+        size=(2, 4, 4, 5)).astype(numpy.float32)
+    y = numpy.asarray(u.apply({}, x))
+    # manual reference for an interior channel
+    c = 2
+    ssum = (x[..., c - 1] ** 2 + x[..., c] ** 2 + x[..., c + 1] ** 2)
+    expect = x[..., c] / (2.0 + 0.001 * ssum) ** 0.75
+    numpy.testing.assert_allclose(y[..., c], expect, rtol=1e-5)
+    # edge channel: window truncated to available neighbours
+    ssum0 = x[..., 0] ** 2 + x[..., 1] ** 2
+    expect0 = x[..., 0] / (2.0 + 0.001 * ssum0) ** 0.75
+    numpy.testing.assert_allclose(y[..., 0], expect0, rtol=1e-5)
+
+
+def test_lrn_in_chain_differentiable():
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu.models.lrn import LRNormalizerForward
+    u = LRNormalizerForward(None)
+    g = jax.grad(lambda x: jnp.sum(u.apply({}, x)))(
+        jnp.ones((1, 2, 2, 8), jnp.float32))
+    assert numpy.all(numpy.isfinite(numpy.asarray(g)))
+
+
+# -- CIFAR workflow -----------------------------------------------------------
+
+def test_cifar_workflow_end_to_end():
+    """Fast mechanics check: the conv workflow builds, trains an epoch
+    through the standard graph, and reports metrics."""
+    from veles_tpu.samples.cifar import CifarWorkflow
+    root.cifar_tpu.update({
+        "synthetic_train": 256, "synthetic_valid": 64,
+        "minibatch_size": 64, "max_epochs": 1,
+        "solver": "adam", "learning_rate": 0.002,
+    })
+    wf = CifarWorkflow(None)
+    wf.snapshotter.interval = 10**9  # don't write snapshots in tests
+    wf.snapshotter.time_interval = 10**9
+    wf.initialize(device=Device(backend="numpy"))
+    wf.run()
+    err = wf.decision.epoch_metrics.get("validation_error_pct")
+    assert err is not None and numpy.isfinite(
+        wf.decision.epoch_metrics["validation_loss"])
+
+
+@pytest.mark.slow
+def test_cifar_workflow_learns():
+    """BASELINE config 2 proof: the conv workflow's validation error
+    falls well below chance on the synthetic color-blob task."""
+    from veles_tpu.samples.cifar import CifarWorkflow
+    root.cifar_tpu.update({
+        "synthetic_train": 512, "synthetic_valid": 128,
+        "minibatch_size": 64, "max_epochs": 6,
+        "solver": "adam", "learning_rate": 0.002,
+    })
+    wf = CifarWorkflow(None)
+    wf.snapshotter.interval = 10**9
+    wf.snapshotter.time_interval = 10**9
+    wf.initialize(device=Device(backend="numpy"))
+    wf.run()
+    err = wf.decision.epoch_metrics.get("validation_error_pct")
+    assert err is not None and err < 30.0, err

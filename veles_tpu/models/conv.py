@@ -72,15 +72,18 @@ class Conv(ForwardBase):
                 self.n_kernels)
 
     def _conv(self, x, kernel):
-        cd = dtypes.compute_dtype()
+        # operands stay in the accumulation dtype (f32): on TPU the
+        # precision enum alone selects bf16 MXU passes (DEFAULT) vs f32
+        # emulation (HIGHEST), and the VJP needs matching operand dtypes
+        # (mixed bf16/f32 cotangents are rejected by lax.conv)
+        ad = dtypes.accum_dtype()
         return jax.lax.conv_general_dilated(
-            x.astype(cd), kernel.astype(cd),
+            x.astype(ad), kernel.astype(ad),
             window_strides=self._hw_strides,
             padding=self._lax_padding(),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=self.n_groups,
-            precision=dtypes.matmul_precision(),
-            preferred_element_type=dtypes.accum_dtype())
+            precision=dtypes.matmul_precision())
 
     def fill_params(self):
         in_ch = self.input.shape[-1]
@@ -135,12 +138,12 @@ class Deconv(ForwardBase):
         return (self.ky, self.kx, self.n_kernels, in_channels)
 
     def _deconv(self, x, kernel):
-        cd = dtypes.compute_dtype()
+        ad = dtypes.accum_dtype()  # see Conv._conv dtype note
         pad = self.padding.upper() if isinstance(self.padding, str) \
             else self.padding
         sx, sy = self.sliding
         return jax.lax.conv_transpose(
-            x.astype(cd), kernel.astype(cd),
+            x.astype(ad), kernel.astype(ad),
             strides=(sy, sx), padding=pad,
             dimension_numbers=("NHWC", "HWOI", "NHWC"),
             precision=dtypes.matmul_precision())

@@ -81,23 +81,33 @@ class DecisionGD(Unit, IResultProvider):
         l = self.loader
         self.improved.set(False)
         if l.epoch_ended:
-            acc = self.trainer.read_epoch_acc(reset_classes=(TEST, VALID))
-            for cls in (TEST, VALID):
-                n_err, loss_sum, samples = acc[cls]
-                self.epoch_n_err[cls] = int(n_err)
-                self.epoch_samples[cls] = int(samples)
-                self.epoch_loss_sum[cls] = loss_sum
-            self._on_epoch_ended()
+            self._close_eval_epoch()
         if l.train_ended:
-            acc = self.trainer.read_epoch_acc(reset_classes=(TRAIN,))
-            n_err, loss_sum, samples = acc[TRAIN]
-            self.epoch_n_err[TRAIN] = int(n_err)
-            self.epoch_samples[TRAIN] = int(samples)
-            self.epoch_loss_sum[TRAIN] = loss_sum
-            self._maybe_complete()
-            self.epoch_n_err[TRAIN] = 0
-            self.epoch_samples[TRAIN] = 0
-            self.epoch_loss_sum[TRAIN] = 0.0
+            self._close_train_epoch()
+
+    def _close_eval_epoch(self):
+        """Read + reset the TEST/VALID accumulator rows and evaluate the
+        epoch (shared by the standalone and master paths)."""
+        acc = self.trainer.read_epoch_acc(reset_classes=(TEST, VALID))
+        for cls in (TEST, VALID):
+            n_err, loss_sum, samples = acc[cls]
+            self.epoch_n_err[cls] = int(n_err)
+            self.epoch_samples[cls] = int(samples)
+            self.epoch_loss_sum[cls] = loss_sum
+        self._on_epoch_ended()
+
+    def _close_train_epoch(self):
+        acc = self.trainer.read_epoch_acc(reset_classes=(TRAIN,))
+        n_err, loss_sum, samples = acc[TRAIN]
+        self.epoch_n_err[TRAIN] = int(n_err)
+        self.epoch_samples[TRAIN] = int(samples)
+        self.epoch_loss_sum[TRAIN] = loss_sum
+        if self.is_master:
+            self._master_epoch += 1
+        self._maybe_complete()
+        self.epoch_n_err[TRAIN] = 0
+        self.epoch_samples[TRAIN] = 0
+        self.epoch_loss_sum[TRAIN] = 0.0
 
     def _error_pct(self, cls):
         n = self.epoch_samples[cls]
@@ -170,28 +180,16 @@ class DecisionGD(Unit, IResultProvider):
         l = self.loader
         acc = self.trainer.read_epoch_acc()
         self.improved.set(False)
-        eval_cls = VALID if l.class_lengths[VALID] else TEST
-        needed = l.class_lengths[eval_cls]
-        if needed and acc[eval_cls][2] >= needed:
-            a = self.trainer.read_epoch_acc(reset_classes=(TEST, VALID))
-            for cls in (TEST, VALID):
-                n_err, loss_sum, samples = a[cls]
-                self.epoch_n_err[cls] = int(n_err)
-                self.epoch_samples[cls] = int(samples)
-                self.epoch_loss_sum[cls] = loss_sum
-            self._on_epoch_ended()
+        # every eval class present in the dataset must be fully applied
+        # before the epoch closes — gating on VALID alone would let a
+        # slow worker's in-flight TEST minibatch leak into the next epoch
+        eval_classes = [c for c in (TEST, VALID) if l.class_lengths[c]]
+        if eval_classes and all(
+                acc[c][2] >= l.class_lengths[c] for c in eval_classes):
+            self._close_eval_epoch()
         train_needed = l.effective_total_samples - l.class_end_offsets[VALID]
         if train_needed and acc[TRAIN][2] >= train_needed:
-            a = self.trainer.read_epoch_acc(reset_classes=(TRAIN,))
-            n_err, loss_sum, samples = a[TRAIN]
-            self.epoch_n_err[TRAIN] = int(n_err)
-            self.epoch_samples[TRAIN] = int(samples)
-            self.epoch_loss_sum[TRAIN] = loss_sum
-            self._master_epoch += 1
-            self._maybe_complete()
-            self.epoch_n_err[TRAIN] = 0
-            self.epoch_samples[TRAIN] = 0
-            self.epoch_loss_sum[TRAIN] = 0.0
+            self._close_train_epoch()
 
     def drop_slave(self, slave=None):
         pass

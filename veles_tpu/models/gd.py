@@ -89,6 +89,13 @@ class GradientDescent(AcceleratedUnit):
         self._train_step_ = None
         self._span_step_ = None
         self._shardings_ = None
+        #: master-side epoch accumulator in float64: the master's device
+        #: program never runs, and f32 accumulation of worker sample
+        #: counts stops being exact past ~2^24 samples/epoch — the
+        #: epoch-completion threshold would never fire (a hang).
+        #: Volatile: resume abandons in-flight accounting, like
+        #: pending_minibatches_ (ref: base.py:205).
+        self._master_acc_ = numpy.zeros((3, 3), numpy.float64)
 
     # -- hyper-parameter resolution (extras item 13) ---------------------------
 
@@ -476,15 +483,14 @@ class GradientDescent(AcceleratedUnit):
 
     def apply_data_from_slave(self, data, slave=None):
         """Master: merge the worker's delta into the live parameters and
-        fold its epoch accounting into the master accumulator."""
+        fold its epoch accounting into the (float64) master
+        accumulator."""
         for i, u in enumerate(self.forwards):
             for name, arr in u.param_arrays().items():
                 arr.map_write()
                 arr.mem[...] += data["delta"][i][name]
                 arr.unmap()
-        self.epoch_acc.map_write()
-        self.epoch_acc.mem[...] += data["acc"]
-        self.epoch_acc.unmap()
+        self._master_acc_ += numpy.asarray(data["acc"], numpy.float64)
 
     def drop_slave(self, slave=None):
         pass  # in-flight deltas from a dead worker are simply lost
@@ -492,13 +498,20 @@ class GradientDescent(AcceleratedUnit):
     def read_epoch_acc(self, reset_classes=(), as_array=False):
         """One host sync: {class: (n_err, loss_sum, samples)}; resets the
         requested class rows for the next epoch."""
-        self.epoch_acc.map_read()
-        acc = numpy.array(self.epoch_acc.mem)
-        if len(reset_classes):
-            self.epoch_acc.map_write()
+        if self.is_master:
+            # the master's graph never runs; its accounting lives in the
+            # float64 host accumulator fed by apply_data_from_slave
+            acc = numpy.array(self._master_acc_)
             for c in reset_classes:
-                self.epoch_acc.mem[c] = 0
-            self.epoch_acc.unmap()
+                self._master_acc_[c] = 0
+        else:
+            self.epoch_acc.map_read()
+            acc = numpy.array(self.epoch_acc.mem)
+            if len(reset_classes):
+                self.epoch_acc.map_write()
+                for c in reset_classes:
+                    self.epoch_acc.mem[c] = 0
+                self.epoch_acc.unmap()
         if as_array:
             return acc
         return {c: (float(acc[c, 0]), float(acc[c, 1]), float(acc[c, 2]))

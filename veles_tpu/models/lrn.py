@@ -1,0 +1,47 @@
+"""Local response normalization (reconstruction of the znicz
+``normalization.LRNormalizerForward`` unit; surface per
+manualrst_veles_algorithms.rst:150-164 item 6 — AlexNet needs it across
+channels).
+
+    y = x / (k + alpha * sum_{j in window(c)} x_j^2) ** beta
+
+The channel-window sum is one ``lax.reduce_window`` over the C axis of
+NHWC — XLA fuses the whole expression into the surrounding program, so
+there is no standalone kernel to write.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.models.nn_units import ForwardBase
+
+
+class LRNormalizerForward(ForwardBase):
+    """Cross-channel LRN (znicz LRNormalizerForward surface: ``alpha``,
+    ``beta``, ``n`` window size, ``k`` bias; AlexNet-paper defaults)."""
+
+    PARAMS = ()
+
+    def __init__(self, workflow, alpha=1e-4, beta=0.75, n=5, k=2.0,
+                 **kwargs):
+        super(LRNormalizerForward, self).__init__(workflow, **kwargs)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.n = int(n)
+        self.k = float(k)
+
+    def fill_params(self):
+        pass
+
+    def output_shape_for(self, input_shape):
+        return input_shape
+
+    def apply(self, params, x):
+        sq = x * x
+        half = self.n // 2
+        # window over the trailing (channel) axis, SAME-style padding
+        window = (1,) * (x.ndim - 1) + (self.n,)
+        pad = [(0, 0)] * (x.ndim - 1) + [(half, self.n - 1 - half)]
+        ssum = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add, window, (1,) * x.ndim, pad)
+        return x * jax.lax.pow(self.k + self.alpha * ssum, -self.beta)

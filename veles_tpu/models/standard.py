@@ -1,14 +1,71 @@
-"""Standard workflow builders (seed of the znicz StandardWorkflow
-surface): one call wires loader → forward layers → evaluator → trainer.
+"""Standard workflow builders (reconstruction of the znicz
+StandardWorkflow surface, manualrst_veles_algorithms.rst: models are
+described by a ``layers`` list of type+kwargs dicts).
 
-Used by samples, bench, and the driver entry points so the unit
-handshake lives in exactly one place.
+Two entry points:
+
+- :func:`build_mlp_classifier` — imperative wiring for simple MLPs
+  (bench / driver entry points);
+- :class:`StandardWorkflow` — the config-driven graph the samples use:
+  ``layers=[{"type": "conv_relu", "n_kernels": 32, ...}, ...]`` builds
+  the full train graph (repeater → loader → trainer → decision →
+  snapshotter, loop + end gates) in one unit.
+
+Layer spec keys: ``type`` (see :data:`LAYER_TYPES`); ``"->"`` merges
+extra forward kwargs; ``"<-"`` merges per-layer trainer hyper-parameter
+overrides (extras item 13) — both znicz conventions.
 """
 
 from veles_tpu.accelerated_units import AcceleratedWorkflow
-from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
-from veles_tpu.models.evaluator import EvaluatorSoftmax
+from veles_tpu.models.all2all import (
+    All2All, All2AllRELU, All2AllSigmoid, All2AllSoftmax,
+    All2AllStrictRELU, All2AllTanh)
+from veles_tpu.models.conv import (
+    Conv, ConvRELU, ConvStrictRELU, ConvTanh, Deconv)
+from veles_tpu.models.dropout import DropoutForward
+from veles_tpu.models.evaluator import EvaluatorMSE, EvaluatorSoftmax
 from veles_tpu.models.gd import GradientDescent
+from veles_tpu.models.lrn import LRNormalizerForward
+from veles_tpu.models.pooling import AvgPooling, Depooling, MaxPooling
+
+#: znicz layer-type names → forward unit classes
+LAYER_TYPES = {
+    "all2all": All2All,
+    "all2all_tanh": All2AllTanh,
+    "all2all_relu": All2AllRELU,
+    "all2all_str": All2AllStrictRELU,
+    "all2all_sigmoid": All2AllSigmoid,
+    "softmax": All2AllSoftmax,
+    "conv": Conv,
+    "conv_tanh": ConvTanh,
+    "conv_relu": ConvRELU,
+    "conv_str": ConvStrictRELU,
+    "deconv": Deconv,
+    "max_pooling": MaxPooling,
+    "avg_pooling": AvgPooling,
+    "depooling": Depooling,
+    "dropout": DropoutForward,
+    "norm": LRNormalizerForward,
+}
+
+
+def make_forwards(workflow, input_array, layers):
+    """Instantiate the forward chain from a znicz-style ``layers`` spec;
+    returns the unit list (uninitialized — the workflow's dependency-
+    ordered initialize fills parameters)."""
+    units = []
+    prev = input_array
+    for i, spec in enumerate(dict(s) for s in layers):
+        ltype = spec.pop("type")
+        kwargs = dict(spec.pop("->", {}))
+        kwargs.update(spec.pop("<-", {}))
+        kwargs.update(spec)
+        cls = LAYER_TYPES[ltype]
+        u = cls(workflow, name="%s%d" % (ltype, i), **kwargs)
+        u.input = prev
+        prev = u.output
+        units.append(u)
+    return units
 
 
 def build_mlp_classifier(device, loader, hidden=(100,), classes=10,
@@ -44,3 +101,68 @@ def build_mlp_classifier(device, loader, hidden=(100,), classes=10,
                          loader=loader, mesh=mesh, name="gd", **gd_kwargs)
     gd.initialize(device=device)
     return wf, layers, ev, gd
+
+
+class StandardWorkflow(AcceleratedWorkflow):
+    """The config-driven training graph (znicz StandardWorkflow role).
+
+    Parameters mirror the znicz config surface:
+
+    - ``loader_factory(workflow, **loader_config)`` builds the loader
+      (or pass a ready ``loader`` instance);
+    - ``layers`` — the forward-chain spec (see :func:`make_forwards`);
+    - ``loss`` — "softmax" | "mse" selects the evaluator;
+    - ``decision_config`` / ``snapshotter_config`` / trainer kwargs.
+    """
+
+    def __init__(self, workflow, loader_factory=None, loader=None,
+                 loader_config=None, layers=(), loss="softmax",
+                 decision_config=None, snapshotter_config=None,
+                 mesh=None, name="StandardWorkflow", **trainer_kwargs):
+        from veles_tpu.models.decision import DecisionGD
+        from veles_tpu.plumbing import Repeater
+        from veles_tpu.snapshotter import Snapshotter
+
+        super(StandardWorkflow, self).__init__(workflow, name=name)
+        self.repeater = Repeater(self)
+        self.repeater.link_from(self.start_point)
+
+        if loader is None:
+            loader = loader_factory(self, **(loader_config or {}))
+        self.loader = loader
+        self.loader.link_from(self.repeater)
+
+        self.forwards = make_forwards(
+            self, self.loader.minibatch_data, layers)
+
+        if loss == "mse":
+            self.evaluator = EvaluatorMSE(self)
+            self.evaluator.target = self.loader.minibatch_targets
+        else:
+            self.evaluator = EvaluatorSoftmax(self)
+            self.evaluator.labels = self.loader.minibatch_labels
+        self.evaluator.output = self.forwards[-1].output
+        self.evaluator.loader = self.loader
+
+        self.gd = GradientDescent(
+            self, forwards=self.forwards, evaluator=self.evaluator,
+            loader=self.loader, mesh=mesh, **trainer_kwargs)
+        self.gd.link_from(self.loader)
+
+        self.decision = DecisionGD(self, **(decision_config or {}))
+        self.decision.loader = self.loader
+        self.decision.trainer = self.gd
+        self.decision.link_from(self.gd)
+
+        snapshotter_config = dict(snapshotter_config or {})
+        if snapshotter_config.pop("enabled", True):
+            self.snapshotter = Snapshotter(self, **snapshotter_config)
+            self.snapshotter.decision = self.decision
+            self.snapshotter.link_from(self.decision)
+        else:
+            self.snapshotter = None
+
+        self.repeater.link_from(self.decision)
+        self.loader.gate_block = self.decision.complete
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete

@@ -1,0 +1,118 @@
+"""CIFAR-10 convolutional workflow — BASELINE.json config 2
+(the caffe-style conv net of manualrst_veles_algorithms.rst:51,
+17.21% published validation error).
+
+Run: ``python -m veles_tpu veles_tpu/samples/cifar.py \
+veles_tpu/samples/cifar_config.py``
+
+Net (caffe cifar10_quick shape): conv5x5x32 → maxpool3/2 → conv5x5x32 →
+avgpool3/2 → conv5x5x64 → avgpool3/2 → fc64 → softmax10, NHWC
+throughout (the layout XLA:TPU tiles onto the MXU without transposes).
+"""
+
+import os
+import pickle
+
+import numpy
+
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard import StandardWorkflow
+
+
+class CifarLoader(FullBatchLoader):
+    """CIFAR-10 python-pickle batches from
+    ``root.common.dirs.datasets``/cifar10 (data_batch_1..5 +
+    test_batch); a deterministic synthetic stand-in is generated when
+    absent (zero-egress build environment)."""
+
+    def _load_batch(self, path):
+        with open(path, "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        data = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return data, list(d[b"labels"])
+
+    def load_data(self):
+        base = os.path.join(root.common.dirs.get("datasets", "data"),
+                            "cifar10")
+        batches = [os.path.join(base, "data_batch_%d" % i)
+                   for i in range(1, 6)]
+        test = os.path.join(base, "test_batch")
+        if all(os.path.isfile(p) for p in batches + [test]):
+            parts = [self._load_batch(p) for p in batches]
+            train = numpy.concatenate([p[0] for p in parts])
+            train_l = sum((p[1] for p in parts), [])
+            valid, valid_l = self._load_batch(test)
+            self.info("loaded real CIFAR-10 (%d train / %d validation)",
+                      len(train), len(valid))
+        else:
+            self.warning("CIFAR-10 not found under %s — generating a "
+                         "deterministic synthetic stand-in", base)
+            rng = numpy.random.default_rng(1234)
+            n_train = int(root.cifar_tpu.get("synthetic_train", 4096))
+            n_valid = int(root.cifar_tpu.get("synthetic_valid", 512))
+            tot = n_train + n_valid
+            labels = rng.integers(0, 10, tot)
+            # class-dependent colour blobs so the task is learnable
+            centers = rng.normal(scale=0.6, size=(10, 1, 1, 3))
+            data = numpy.clip(
+                centers[labels]
+                + rng.normal(scale=0.25, size=(tot, 32, 32, 3)) + 0.5,
+                0, 1) * 255
+            valid, train = data[:n_valid], data[n_valid:]
+            valid_l, train_l = (labels[:n_valid].tolist(),
+                                labels[n_valid:].tolist())
+        self.class_lengths[:] = [0, len(valid), len(train)]
+        self.original_data = numpy.concatenate(
+            [valid, train]).astype(numpy.float32) / 255.0
+        self.original_labels = list(valid_l) + list(train_l)
+
+
+class CifarWorkflow(StandardWorkflow):
+    """The caffe-style CIFAR conv net as a StandardWorkflow layers spec."""
+
+    def __init__(self, workflow, layers=None, **kwargs):
+        cfg = root.cifar_tpu
+        # caffe cifar10_quick shapes; Glorot-scaled uniform init (the
+        # framework default) instead of caffe's fixed tiny gaussians —
+        # those need thousands of epochs to escape the dead zone
+        layers = layers or [
+            {"type": "conv_relu", "n_kernels": 32, "kx": 5, "ky": 5,
+             "padding": 2},
+            {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+            {"type": "conv_relu", "n_kernels": 32, "kx": 5, "ky": 5,
+             "padding": 2},
+            {"type": "avg_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+            {"type": "conv_relu", "n_kernels": 64, "kx": 5, "ky": 5,
+             "padding": 2},
+            {"type": "avg_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+            {"type": "all2all_relu", "output_sample_shape": (64,)},
+            {"type": "softmax", "output_sample_shape": (10,)},
+        ]
+        super(CifarWorkflow, self).__init__(
+            workflow, name="CIFAR-10",
+            loader_factory=CifarLoader,
+            loader_config={
+                "minibatch_size": int(cfg.get("minibatch_size", 128)),
+            },
+            layers=layers,
+            solver=cfg.get("solver", "adam"),
+            learning_rate=float(cfg.get("learning_rate", 0.002)),
+            gradient_moment=float(cfg.get("gradient_moment", 0.9)),
+            weights_decay=float(cfg.get("weights_decay", 0.0005)),
+            decision_config={
+                "fail_iterations": int(cfg.get("fail_iterations", 20)),
+                "max_epochs": cfg.get("max_epochs"),
+            },
+            snapshotter_config={
+                "prefix": cfg.get("snapshot_prefix", "cifar"),
+                "compression": cfg.get("snapshot_compression", "gz"),
+                "time_interval":
+                    float(cfg.get("snapshot_time_interval", 10.0)),
+            },
+            **kwargs)
+
+
+def run(load, main):
+    load(CifarWorkflow)
+    main()

@@ -19,14 +19,9 @@ import struct
 
 import numpy
 
-from veles_tpu.accelerated_units import AcceleratedWorkflow
 from veles_tpu.config import root
 from veles_tpu.loader.fullbatch import FullBatchLoader
-from veles_tpu.models import DecisionGD, GradientDescent
-from veles_tpu.models.all2all import All2AllSoftmax, All2AllTanh
-from veles_tpu.models.evaluator import EvaluatorSoftmax
-from veles_tpu.plumbing import Repeater
-from veles_tpu.snapshotter import Snapshotter
+from veles_tpu.models.standard import StandardWorkflow
 
 
 def _read_idx(path):
@@ -89,70 +84,41 @@ class MnistLoader(FullBatchLoader):
             [valid_l, train_l]).tolist()
 
 
-class MnistWorkflow(AcceleratedWorkflow):
-    """The classic Veles first workflow, TPU-native."""
+class MnistWorkflow(StandardWorkflow):
+    """The classic Veles first workflow, TPU-native — an MLP ``layers``
+    widths list lowered onto the StandardWorkflow graph."""
 
     def __init__(self, workflow, layers=(100, 10), **kwargs):
-        super(MnistWorkflow, self).__init__(workflow, name="MNIST",
-                                            **kwargs)
         cfg = root.mnist_tpu
-        self.repeater = Repeater(self)
-        self.repeater.link_from(self.start_point)
-
-        self.loader = MnistLoader(
-            self, minibatch_size=int(cfg.get("minibatch_size", 128)),
-            normalization_type=cfg.get("normalization", "none"))
-        self.loader.link_from(self.repeater)
-
-        self.forwards = []
-        prev = self.loader.minibatch_data
-        for i, width in enumerate(layers[:-1]):
-            fc = All2AllTanh(
-                self, output_sample_shape=(int(width),),
-                name="fc%d" % i,
-                weights_stddev=cfg.get("weights_stddev"))
-            fc.input = prev
-            self.forwards.append(fc)
-            prev = fc.output
-        head = All2AllSoftmax(
-            self, output_sample_shape=(int(layers[-1]),), name="head")
-        head.input = prev
-        self.forwards.append(head)
-
-        self.evaluator = EvaluatorSoftmax(self)
-        self.evaluator.output = head.output
-        self.evaluator.labels = self.loader.minibatch_labels
-        self.evaluator.loader = self.loader
-
-        self.gd = GradientDescent(
-            self, forwards=self.forwards, evaluator=self.evaluator,
-            loader=self.loader,
+        spec = [{"type": "all2all_tanh",
+                 "output_sample_shape": (int(w),),
+                 "weights_stddev": cfg.get("weights_stddev")}
+                for w in layers[:-1]]
+        spec.append({"type": "softmax",
+                     "output_sample_shape": (int(layers[-1]),)})
+        super(MnistWorkflow, self).__init__(
+            workflow, name="MNIST",
+            loader_factory=MnistLoader,
+            loader_config={
+                "minibatch_size": int(cfg.get("minibatch_size", 128)),
+                "normalization_type": cfg.get("normalization", "none"),
+            },
+            layers=spec,
             solver=cfg.get("solver", "sgd"),
             learning_rate=float(cfg.get("learning_rate", 0.1)),
             gradient_moment=float(cfg.get("gradient_moment", 0.9)),
-            weights_decay=float(cfg.get("weights_decay", 0.0)))
-        self.gd.link_from(self.loader)
-
-        self.decision = DecisionGD(
-            self,
-            fail_iterations=int(cfg.get("fail_iterations", 25)),
-            max_epochs=cfg.get("max_epochs"))
-        self.decision.loader = self.loader
-        self.decision.trainer = self.gd
-        self.decision.link_from(self.gd)
-
-        self.snapshotter = Snapshotter(
-            self, prefix=cfg.get("snapshot_prefix", "mnist"),
-            compression=cfg.get("snapshot_compression", "gz"),
-            time_interval=float(cfg.get("snapshot_time_interval", 5.0)))
-        self.snapshotter.decision = self.decision
-        self.snapshotter.link_from(self.decision)
-
-        # the training loop: decision → repeater until complete
-        self.repeater.link_from(self.decision)
-        self.loader.gate_block = self.decision.complete
-        self.end_point.link_from(self.decision)
-        self.end_point.gate_block = ~self.decision.complete
+            weights_decay=float(cfg.get("weights_decay", 0.0)),
+            decision_config={
+                "fail_iterations": int(cfg.get("fail_iterations", 25)),
+                "max_epochs": cfg.get("max_epochs"),
+            },
+            snapshotter_config={
+                "prefix": cfg.get("snapshot_prefix", "mnist"),
+                "compression": cfg.get("snapshot_compression", "gz"),
+                "time_interval":
+                    float(cfg.get("snapshot_time_interval", 5.0)),
+            },
+            **kwargs)
 
 
 def run(load, main):
