@@ -1,14 +1,15 @@
 """Benchmark entry point — prints ONE JSON line.
 
-Measures end-to-end training throughput (samples/sec/chip) of the
-flagship workflow: the BASELINE.json config-1 MNIST-shaped MLP
-(784→100→10, SGD+momentum) trained through the full framework stack —
-FullBatchLoader device gather → fused autodiff train step — on whatever
-chip JAX provides (the real TPU under the driver).
+Primary metric (BASELINE.json config 3, the driver's target): AlexNet
+training throughput in samples/sec/chip on synthetic ImageNet-shaped
+data, trained through the full framework stack (HBM-resident dataset →
+span-serving ``lax.scan`` train step), with an **MFU estimate**
+(analytic model FLOPs / chip peak).  The MLP number (config 1, round-1's
+metric) rides along as extra keys so the series stays comparable.
 
 The reference publishes no throughput numbers (BASELINE.md), so the
-first recorded measurement IS the baseline; vs_baseline reports against
-the constant below once set.
+first recorded measurement IS the baseline; ``vs_baseline`` reports
+against the pinned constants below.
 """
 
 import json
@@ -17,21 +18,68 @@ import time
 
 import numpy
 
-#: samples/sec recorded on the first driver run (BASELINE.md: the rebuild
-#: establishes the baseline).  Round 1's number (BENCH_r01.json).
-BASELINE_SAMPLES_PER_SEC = 48931.4
+#: round-1 driver measurement of the config-1 MLP (BENCH_r01.json).
+MLP_BASELINE_SAMPLES_PER_SEC = 48931.4
+#: first AlexNet measurement on the TPU v5e chip (round 2, this file).
+ALEXNET_BASELINE_SAMPLES_PER_SEC = 15403.7
+
+#: published bf16 peak FLOP/s per chip by device kind; the measured GEMM
+#: roofline probe (backends.compute_power) is the fallback
+PEAK_FLOPS = {
+    "TPU v2": 46e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
 
 
-def build():
-    from veles_tpu.backends import Device
+def training_flops_per_sample(forwards):
+    """Analytic FLOPs of one training sample: 2·MACs forward, x3 for
+    forward + both backward passes (the standard MFU accounting)."""
+    from veles_tpu.models.all2all import All2All
+    from veles_tpu.models.conv import Conv
+    total = 0.0
+    for u in forwards:
+        if isinstance(u, Conv):
+            _, h, w, k = u.output.shape
+            cin = u.input.shape[-1]
+            total += 2.0 * h * w * k * (u.kx * u.ky * cin / u.n_groups)
+        elif isinstance(u, All2All):
+            fan_in = int(numpy.prod(u.input.shape[1:]))
+            total += 2.0 * fan_in * u.neurons_number
+    return 3.0 * total
+
+
+def _drain_spans(loader, gd, train_only_steps):
+    """Run loader+trainer pairs until `train_only_steps` train spans have
+    been consumed; returns samples served in those train spans."""
+    served = 0
+    steps = 0
+    while steps < train_only_steps:
+        loader.run()
+        if not loader.span_fresh_:
+            raise RuntimeError(
+                "span serving did not engage (dataset fell back to host "
+                "gather?) — bench numbers would be meaningless")
+        is_train = loader.span_class_ == 2
+        gd.run()
+        if is_train:
+            served += int(loader.span_sizes_.sum())
+            steps += 1
+    return served
+
+
+def bench_mlp(dev):
     from veles_tpu.accelerated_units import AcceleratedWorkflow
     from veles_tpu.loader.fullbatch import FullBatchLoader
     from veles_tpu.models.standard import build_mlp_classifier
 
     class SyntheticMnist(FullBatchLoader):
-        """MNIST-shaped synthetic set (zero-egress environment: no real
-        download; shapes/dtypes match config 1)."""
-
         def load_data(self):
             rng = numpy.random.default_rng(0)
             n_train, n_valid = 60000, 10000
@@ -44,37 +92,87 @@ def build():
             ).astype(numpy.float32)
             self.original_labels = labels.tolist()
 
-    dev = Device()
     wf = AcceleratedWorkflow(None, name="bench-mnist")
     loader = SyntheticMnist(wf, minibatch_size=512)
     _, layers, ev, gd = build_mlp_classifier(
         dev, loader, hidden=(100,), classes=10, workflow=wf,
         gradient_moment=0.9)
-    return loader, gd
+    for _ in range(3):  # warm up both loader spans and the train step
+        loader.run()
+        gd.run()
+    gd.loss.map_read()
+    t0 = time.perf_counter()
+    served0 = loader.samples_served
+    for _ in range(100):
+        loader.run()
+        gd.run()
+    gd.loss.map_read()
+    dt = time.perf_counter() - t0
+    return (loader.samples_served - served0) / dt
+
+
+def bench_alexnet(dev):
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.config import root
+    from veles_tpu.models.evaluator import EvaluatorSoftmax
+    from veles_tpu.models.gd import GradientDescent
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.samples.alexnet import ImagenetLoader, alexnet_layers
+
+    root.alexnet_tpu.update({
+        "synthetic_train": 4096, "synthetic_valid": 0,
+        "side": 227, "classes": 1000,
+    })
+    wf = AcceleratedWorkflow(None, name="bench-alexnet")
+    loader = ImagenetLoader(wf, minibatch_size=1024)
+    loader.initialize(device=dev)
+    forwards = make_forwards(wf, loader.minibatch_data, alexnet_layers())
+    for u in forwards:
+        u.initialize(device=dev)
+    ev = EvaluatorSoftmax(wf, compute_confusion_matrix=False)
+    ev.output = forwards[-1].output
+    ev.labels = loader.minibatch_labels
+    ev.loader = loader
+    ev.initialize(device=dev)
+    gd = GradientDescent(wf, forwards=forwards, evaluator=ev,
+                         loader=loader, solver="sgd", learning_rate=0.01,
+                         gradient_moment=0.9, weights_decay=0.0005)
+    gd.initialize(device=dev)
+
+    # compile + settle: the first post-compile span re-stages donated
+    # buffers and runs seconds slower than steady state
+    _drain_spans(loader, gd, 3)
+    gd.loss.map_read()
+    t0 = time.perf_counter()
+    served = _drain_spans(loader, gd, 8)
+    gd.loss.map_read()
+    dt = time.perf_counter() - t0
+    sps = served / dt
+
+    flops = training_flops_per_sample(forwards)
+    kind = dev.jax_device.device_kind
+    peak = PEAK_FLOPS.get(kind) or dev.compute_power()
+    mfu = sps * flops / peak
+    return sps, mfu, flops, kind
 
 
 def main():
-    loader, gd = build()
-    # warm up: compile both the gather and the train step
-    for _ in range(3):
-        loader.run()
-        gd.run()
-    gd.loss.map_read()  # sync
-    t0 = time.perf_counter()
-    served0 = loader.samples_served
-    steps = 100
-    for _ in range(steps):
-        loader.run()
-        gd.run()
-    gd.loss.map_read()  # sync
-    dt = time.perf_counter() - t0
-    sps = (loader.samples_served - served0) / dt
-    vs = sps / BASELINE_SAMPLES_PER_SEC if BASELINE_SAMPLES_PER_SEC else 1.0
+    from veles_tpu.backends import Device
+    dev = Device()
+    alex_sps, mfu, flops, kind = bench_alexnet(dev)
+    mlp_sps = bench_mlp(dev)
+    vs = (alex_sps / ALEXNET_BASELINE_SAMPLES_PER_SEC
+          if ALEXNET_BASELINE_SAMPLES_PER_SEC else 1.0)
     print(json.dumps({
-        "metric": "mnist_mlp_train_throughput",
-        "value": round(sps, 1),
+        "metric": "alexnet_imagenet_train_throughput",
+        "value": round(alex_sps, 1),
         "unit": "samples/sec/chip",
         "vs_baseline": round(vs, 3),
+        "mfu": round(mfu, 4),
+        "train_flops_per_sample": flops,
+        "device_kind": kind,
+        "mlp_samples_per_sec": round(mlp_sps, 1),
+        "mlp_vs_baseline": round(mlp_sps / MLP_BASELINE_SAMPLES_PER_SEC, 3),
     }))
     return 0
 

@@ -142,6 +142,9 @@ class TestArray:
         Watcher.reset()
         a = Array(numpy.zeros(1024, numpy.float32))
         a.initialize(device)
+        # initialize is lazy — accounting starts at first devmem touch
+        assert Watcher.total() == 0
+        a.devmem
         assert Watcher.total() == 4096
         a.reset()
         assert Watcher.total() == 0

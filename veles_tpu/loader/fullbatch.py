@@ -100,10 +100,19 @@ class FullBatchLoader(Loader):
         self._post_load()
 
     def _post_load(self):
+        from veles_tpu.normalization import NoneNormalizer
         # normalize the whole dataset once (device path applies it here
         # rather than per minibatch); an inference-only loader whose
         # normalizer state was transferred from training still normalizes
-        if self.normalizer.is_initialized:
+        if isinstance(self.original_data, jax.Array):
+            # device-synthesized dataset (e.g. the bench loaders): keep
+            # it in HBM — normalizers are host-side, so only the
+            # identity normalizer avoids a device→host→device round-trip
+            if not isinstance(self.normalizer, NoneNormalizer):
+                self.original_data = numpy.ascontiguousarray(
+                    self.normalizer.normalize(
+                        numpy.asarray(self.original_data)))
+        elif self.normalizer.is_initialized:
             self.original_data = numpy.ascontiguousarray(
                 self.normalizer.normalize(self.original_data))
         self._numeric_labels = None

@@ -190,6 +190,7 @@ class FileImageLoaderBase(object):
         self.class_keys = [[], [], []]
 
     def scan_files(self):
+        warn = getattr(self, "warning", None)
         for ci, paths in enumerate(self.class_paths):
             keys = []
             for p in paths:
@@ -200,6 +201,15 @@ class FileImageLoaderBase(object):
                                 keys.append(os.path.join(dirpath, fn))
                 elif os.path.isfile(p):
                     keys.append(p)
+            if self.filename_re is not None:
+                # drop files the label regex can't classify — a single
+                # stray file would otherwise crash label mapping later
+                matched = [k for k in keys
+                           if self.get_image_label(k) is not None]
+                if len(matched) != len(keys) and warn is not None:
+                    warn("%d file(s) did not match filename_re and were "
+                         "skipped", len(keys) - len(matched))
+                keys = matched
             self.class_keys[ci] = keys
 
     def get_image_label(self, path):
@@ -234,6 +244,14 @@ class FileImageLoader(FileImageLoaderBase, Loader):
         self._all_keys = sum(self.class_keys, [])
         if not self._all_keys:
             raise ValueError("%s: no image files found" % self)
+        # labels come from paths alone — build the mapping here so the
+        # analysis pass never decodes pixels just to collect labels
+        labels = {self.get_image_label(k) for k in self._all_keys}
+        labels.discard(None)
+        if labels and not all(
+                isinstance(l, (int, numpy.integer)) for l in labels):
+            self.labels_mapping = {
+                l: i for i, l in enumerate(sorted(labels))}
         # probe one image for the sample shape
         self._sample_shape = self.pipeline(
             self.pipeline.decode(self._all_keys[0])).shape

@@ -169,12 +169,25 @@ class Array(Pickleable):
         self._state = COHERENT
 
     def initialize(self, device=None):
-        """Bind to a Device and materialise the device buffer
-        (ref: veles/memory.py:347)."""
+        """Bind to a Device (ref: veles/memory.py:347).  The device
+        buffer materialises lazily on first :attr:`devmem` access — an
+        eager upload here would push every freshly-reset zero buffer
+        (layer outputs, minibatch staging) over the host↔HBM link even
+        when the fused/span programs never read them."""
         if device is not None:
+            if self._devmem_ is not None and self._state != HOST_DIRTY:
+                # migrate only if the live buffer is on a DIFFERENT jax
+                # device — adopted program outputs (e.g. solver slots
+                # born on-device) must not round-trip through the host
+                # just because their Array wasn't device-bound yet
+                try:
+                    cur = next(iter(self._devmem_.devices()))
+                except Exception:
+                    cur = None
+                if cur is not None and cur != device.jax_device:
+                    self.map_read()
+                    self._release_devmem()
             self._device_ = device
-        if self._mem is not None:
-            self._upload()
         return self
 
     # -- coherence protocol (ref: veles/memory.py:371-384) -------------------

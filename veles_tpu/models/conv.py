@@ -72,13 +72,15 @@ class Conv(ForwardBase):
                 self.n_kernels)
 
     def _conv(self, x, kernel):
-        # operands stay in the accumulation dtype (f32): on TPU the
-        # precision enum alone selects bf16 MXU passes (DEFAULT) vs f32
-        # emulation (HIGHEST), and the VJP needs matching operand dtypes
-        # (mixed bf16/f32 cotangents are rejected by lax.conv)
-        ad = dtypes.accum_dtype()
+        # BOTH operands cast to the compute dtype and the output kept in
+        # it: the conv trunk's activations are the HBM-bandwidth hot
+        # spot (bf16 halves the traffic), and the conv VJP needs
+        # matching operand/cotangent dtypes — a bf16-in/f32-out mix is
+        # rejected by lax.conv.  The MXU accumulates in f32 internally
+        # regardless; the loss is computed in f32 at the evaluator.
+        cd = dtypes.compute_dtype()
         return jax.lax.conv_general_dilated(
-            x.astype(ad), kernel.astype(ad),
+            x.astype(cd), kernel.astype(cd),
             window_strides=self._hw_strides,
             padding=self._lax_padding(),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
@@ -101,7 +103,7 @@ class Conv(ForwardBase):
     def apply(self, params, x):
         y = self._conv(x, params["weights"])
         if self.include_bias:
-            y = y + params["bias"]
+            y = y + params["bias"].astype(y.dtype)
         return get_activation(self.activation)(y)
 
 
@@ -138,12 +140,12 @@ class Deconv(ForwardBase):
         return (self.ky, self.kx, self.n_kernels, in_channels)
 
     def _deconv(self, x, kernel):
-        ad = dtypes.accum_dtype()  # see Conv._conv dtype note
+        cd = dtypes.compute_dtype()  # see Conv._conv dtype note
         pad = self.padding.upper() if isinstance(self.padding, str) \
             else self.padding
         sx, sy = self.sliding
         return jax.lax.conv_transpose(
-            x.astype(ad), kernel.astype(ad),
+            x.astype(cd), kernel.astype(cd),
             strides=(sy, sx), padding=pad,
             dimension_numbers=("NHWC", "HWOI", "NHWC"),
             precision=dtypes.matmul_precision())

@@ -87,7 +87,9 @@ class EvaluatorSoftmax(EvaluatorBase):
 
     @staticmethod
     def loss_from_logits(logits, labels, size):
-        """Masked mean softmax cross-entropy over valid rows."""
+        """Masked mean softmax cross-entropy over valid rows (always in
+        f32 — the forward chain may run bf16 activations)."""
+        logits = logits.astype(jnp.float32)
         z = logits - jnp.max(logits, axis=-1, keepdims=True)
         logp = z - jnp.log(jnp.sum(jnp.exp(z), axis=-1, keepdims=True))
         picked = jnp.take_along_axis(
@@ -144,7 +146,8 @@ class EvaluatorMSE(EvaluatorBase):
         self.loss_out.reset(numpy.zeros((), numpy.float32))
 
     def loss(self, y, target, size):
-        diff = (y - target).reshape(y.shape[0], -1)
+        diff = (y.astype(jnp.float32)
+                - target.astype(jnp.float32)).reshape(y.shape[0], -1)
         mask = (jnp.arange(y.shape[0]) < size)[:, None]
         return jnp.sum(jnp.where(mask, diff * diff, 0.0)) \
             / jnp.maximum(size, 1) / diff.shape[1]

@@ -143,10 +143,16 @@ class GradientDescent(AcceleratedUnit):
             for i, u in enumerate(self.forwards):
                 per_param = {}
                 for name, arr in u.param_arrays().items():
-                    slots = solver.init(jnp.asarray(arr.mem))
-                    per_param[name] = {
-                        s: Array(numpy.asarray(v))
-                        for s, v in slots.items()}
+                    # init on device from the already-uploaded param —
+                    # no host round-trip (solver slots are zeros_like;
+                    # pulling them to host and re-uploading costs 2×
+                    # model size over the host↔HBM link)
+                    slots = solver.init(arr.devmem)
+                    per_param[name] = {}
+                    for s, v in slots.items():
+                        a = Array()
+                        a.devmem = v
+                        per_param[name][s] = a
                 self.opt_state[i] = per_param
         self.loss.reset(numpy.zeros((), numpy.float32))
         self.n_err.reset(numpy.zeros((), numpy.int32))
@@ -326,7 +332,7 @@ class GradientDescent(AcceleratedUnit):
         for i, layer in self.opt_state.items():
             for name, slots in layer.items():
                 for s, arr in slots.items():
-                    if arr.mem.ndim == 0:
+                    if len(arr.shape) == 0:  # dev-born slots have no mem
                         opt_sh[i][name][s] = shlib.replicated(mesh)
         mb = self.loader.max_minibatch_size
         x_sh = shlib.batch_sharding(

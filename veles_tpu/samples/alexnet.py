@@ -1,0 +1,122 @@
+"""AlexNet / ImageNet workflow — BASELINE.json config 3, the driver's
+target metric (samples/sec/chip).
+
+Surface per manualrst_veles_algorithms.rst:150-164 item 6: grouped
+convolution, LRN, dropout — the original 2-GPU AlexNet topology.  Run:
+
+    python -m veles_tpu veles_tpu/samples/alexnet.py \
+        veles_tpu/samples/alexnet_config.py
+
+Real ImageNet is consumed through the directory image loader
+(``root.alexnet_tpu.train_dir`` etc.); without it a synthetic
+ImageNet-shaped dataset is generated (zero-egress build environment).
+All convs are NHWC on the MXU; the grouped convs use XLA's native
+``feature_group_count`` instead of the reference's per-group kernel
+launches.
+"""
+
+import numpy
+
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard import StandardWorkflow
+
+
+def alexnet_layers(classes=1000, dropout=0.5):
+    """The canonical AlexNet layer spec (Krizhevsky et al. 2012)."""
+    return [
+        {"type": "conv_relu", "n_kernels": 96, "kx": 11, "ky": 11,
+         "sliding": (4, 4), "padding": "valid"},
+        {"type": "norm", "n": 5, "alpha": 1e-4, "beta": 0.75, "k": 2.0},
+        {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+        {"type": "conv_relu", "n_kernels": 256, "kx": 5, "ky": 5,
+         "padding": 2, "n_groups": 2},
+        {"type": "norm", "n": 5, "alpha": 1e-4, "beta": 0.75, "k": 2.0},
+        {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+        {"type": "conv_relu", "n_kernels": 384, "kx": 3, "ky": 3,
+         "padding": 1},
+        {"type": "conv_relu", "n_kernels": 384, "kx": 3, "ky": 3,
+         "padding": 1, "n_groups": 2},
+        {"type": "conv_relu", "n_kernels": 256, "kx": 3, "ky": 3,
+         "padding": 1, "n_groups": 2},
+        {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+        {"type": "all2all_relu", "output_sample_shape": (4096,)},
+        {"type": "dropout", "dropout_ratio": dropout},
+        {"type": "all2all_relu", "output_sample_shape": (4096,)},
+        {"type": "dropout", "dropout_ratio": dropout},
+        {"type": "softmax", "output_sample_shape": (classes,)},
+    ]
+
+
+class ImagenetLoader(FullBatchLoader):
+    """ImageNet-shaped loader: synthetic [N, 227, 227, 3] samples unless
+    ``root.alexnet_tpu.train_dir`` points at a real image tree (then the
+    directory image loader should be used instead — see
+    veles_tpu.loader.image.FullBatchFileImageLoader).
+
+    The synthetic dataset is drawn **on the device** (``jax.random``):
+    host-side synthesis would push gigabytes through the host↔HBM link
+    for data whose only purpose is to live in HBM (and the driver's TPU
+    tunnel makes that link expensive)."""
+
+    def load_data(self):
+        import jax
+        import jax.numpy as jnp
+        cfg = root.alexnet_tpu
+        side = int(cfg.get("side", 227))
+        classes = int(cfg.get("classes", 1000))
+        n_train = int(cfg.get("synthetic_train", 2048))
+        n_valid = int(cfg.get("synthetic_valid", 256))
+        rng = numpy.random.default_rng(42)
+        tot = n_train + n_valid
+        labels = rng.integers(0, classes, tot)
+        self.class_lengths[:] = [0, n_valid, n_train]
+        self.original_labels = labels.tolist()
+        dev = self.device.jax_device if self.device is not None else None
+
+        @jax.jit
+        def synth(key, lab):
+            data = jax.random.uniform(key, (tot, side, side, 3),
+                                      jnp.float32)
+            return data + (lab.astype(jnp.float32) / classes)[
+                :, None, None, None]
+
+        with jax.default_device(dev):
+            self.original_data = synth(
+                jax.random.key(42), jnp.asarray(labels))
+
+
+class AlexNetWorkflow(StandardWorkflow):
+    """BASELINE config 3."""
+
+    def __init__(self, workflow, **kwargs):
+        cfg = root.alexnet_tpu
+        super(AlexNetWorkflow, self).__init__(
+            workflow, name="AlexNet",
+            loader_factory=ImagenetLoader,
+            loader_config={
+                "minibatch_size": int(cfg.get("minibatch_size", 256)),
+            },
+            layers=alexnet_layers(
+                classes=int(cfg.get("classes", 1000)),
+                dropout=float(cfg.get("dropout", 0.5))),
+            solver=cfg.get("solver", "sgd"),
+            learning_rate=float(cfg.get("learning_rate", 0.01)),
+            gradient_moment=float(cfg.get("gradient_moment", 0.9)),
+            weights_decay=float(cfg.get("weights_decay", 0.0005)),
+            decision_config={
+                "fail_iterations": int(cfg.get("fail_iterations", 10)),
+                "max_epochs": cfg.get("max_epochs"),
+            },
+            snapshotter_config={
+                "prefix": cfg.get("snapshot_prefix", "alexnet"),
+                "compression": cfg.get("snapshot_compression", "gz"),
+                "time_interval":
+                    float(cfg.get("snapshot_time_interval", 60.0)),
+            },
+            **kwargs)
+
+
+def run(load, main):
+    load(AlexNetWorkflow)
+    main()
