@@ -1,0 +1,53 @@
+// Package-archive reader: gzip (zlib) + ustar.
+// The reference linked libarchive for zip/tar.gz packages
+// (libVeles/src/workflow_archive.cc); the runner needs exactly one
+// combination — the tar.gz the exporter writes — so a gzFile stream +
+// 512-byte ustar walk suffices.
+#pragma once
+
+#include <zlib.h>
+
+#include <cstring>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace veles_rt {
+
+inline std::map<std::string, std::vector<uint8_t>> ReadTarGz(
+    const std::string& path) {
+  gzFile f = gzopen(path.c_str(), "rb");
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::vector<uint8_t> raw;
+  uint8_t buf[1 << 16];
+  int n;
+  while ((n = gzread(f, buf, sizeof(buf))) > 0)
+    raw.insert(raw.end(), buf, buf + n);
+  gzclose(f);
+
+  std::map<std::string, std::vector<uint8_t>> files;
+  size_t pos = 0;
+  while (pos + 512 <= raw.size()) {
+    const uint8_t* h = raw.data() + pos;
+    if (h[0] == 0) break;  // two zero blocks terminate the archive
+    char name[101] = {0};
+    std::memcpy(name, h, 100);
+    char size_s[13] = {0};
+    std::memcpy(size_s, h + 124, 12);
+    size_t size = std::strtoul(size_s, nullptr, 8);
+    char type = static_cast<char>(h[156]);
+    pos += 512;
+    if (type == '0' || type == 0) {
+      if (pos + size > raw.size())
+        throw std::runtime_error("truncated tar member: " +
+                                 std::string(name));
+      files[name] = std::vector<uint8_t>(raw.begin() + pos,
+                                         raw.begin() + pos + size);
+    }
+    pos += (size + 511) / 512 * 512;
+  }
+  return files;
+}
+
+}  // namespace veles_rt

@@ -1,0 +1,52 @@
+// ThreadPool engine — data-parallel execution of unit kernels.
+// Counterpart of libVeles's ThreadPoolEngine (libVeles/src/engine.cc:58-77);
+// here the pool slices the batch dimension across workers instead of
+// scheduling whole units (the runner's graphs are linear chains, so
+// intra-op parallelism is where the cores are).
+#pragma once
+
+#include <condition_variable>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace veles_rt {
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(size_t workers = 0) {
+    if (workers == 0) {
+      workers = std::thread::hardware_concurrency();
+      if (workers == 0) workers = 2;
+    }
+    workers_ = workers;
+  }
+
+  size_t workers() const { return workers_; }
+
+  // Run fn(begin, end) over [0, n) split into one contiguous slice per
+  // worker.  Spawning per call keeps the pool stateless; kernel bodies
+  // dominate wall time at inference batch sizes.
+  void ParallelFor(size_t n, const std::function<void(size_t, size_t)>& fn) {
+    size_t w = std::min(workers_, n);
+    if (w <= 1) {
+      if (n) fn(0, n);
+      return;
+    }
+    std::vector<std::thread> threads;
+    threads.reserve(w);
+    size_t chunk = (n + w - 1) / w;
+    for (size_t i = 0; i < w; ++i) {
+      size_t b = i * chunk, e = std::min(n, b + chunk);
+      if (b >= e) break;
+      threads.emplace_back([&fn, b, e] { fn(b, e); });
+    }
+    for (auto& t : threads) t.join();
+  }
+
+ private:
+  size_t workers_;
+};
+
+}  // namespace veles_rt

@@ -1,0 +1,186 @@
+// Minimal recursive-descent JSON parser for contents.json manifests.
+// The reference consumed rapidjson (a vendored submodule,
+// libVeles/src/main_file_loader.cc); the runner needs only the subset a
+// manifest uses: objects, arrays, strings, numbers, bools, null.
+#pragma once
+
+#include <cmath>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace veles_rt {
+
+class Json {
+ public:
+  enum Type { kNull, kBool, kNumber, kString, kArray, kObject };
+
+  Type type = kNull;
+  bool boolean = false;
+  double number = 0;
+  std::string str;
+  std::vector<Json> array;
+  std::map<std::string, Json> object;
+
+  static Json Parse(const std::string& text) {
+    size_t pos = 0;
+    Json v = ParseValue(text, pos);
+    SkipWs(text, pos);
+    if (pos != text.size()) throw std::runtime_error("trailing JSON data");
+    return v;
+  }
+
+  const Json& at(const std::string& key) const {
+    auto it = object.find(key);
+    if (it == object.end())
+      throw std::runtime_error("missing JSON key: " + key);
+    return it->second;
+  }
+  bool has(const std::string& key) const {
+    return object.count(key) != 0;
+  }
+  int as_int() const { return static_cast<int>(std::lround(number)); }
+
+ private:
+  static void SkipWs(const std::string& t, size_t& p) {
+    while (p < t.size() && (t[p] == ' ' || t[p] == '\t' || t[p] == '\n' ||
+                            t[p] == '\r'))
+      ++p;
+  }
+
+  static Json ParseValue(const std::string& t, size_t& p) {
+    SkipWs(t, p);
+    if (p >= t.size()) throw std::runtime_error("unexpected JSON end");
+    char c = t[p];
+    if (c == '{') return ParseObject(t, p);
+    if (c == '[') return ParseArray(t, p);
+    if (c == '"') return ParseString(t, p);
+    if (c == 't' || c == 'f') return ParseBool(t, p);
+    if (c == 'n') {
+      Expect(t, p, "null");
+      return Json();
+    }
+    return ParseNumber(t, p);
+  }
+
+  static void Expect(const std::string& t, size_t& p, const char* word) {
+    for (const char* w = word; *w; ++w, ++p)
+      if (p >= t.size() || t[p] != *w)
+        throw std::runtime_error("bad JSON literal");
+  }
+
+  static Json ParseBool(const std::string& t, size_t& p) {
+    Json v;
+    v.type = kBool;
+    if (t[p] == 't') {
+      Expect(t, p, "true");
+      v.boolean = true;
+    } else {
+      Expect(t, p, "false");
+      v.boolean = false;
+    }
+    return v;
+  }
+
+  static Json ParseNumber(const std::string& t, size_t& p) {
+    size_t start = p;
+    while (p < t.size() &&
+           (isdigit(static_cast<unsigned char>(t[p])) || t[p] == '-' ||
+            t[p] == '+' || t[p] == '.' || t[p] == 'e' || t[p] == 'E'))
+      ++p;
+    Json v;
+    v.type = kNumber;
+    v.number = std::stod(t.substr(start, p - start));
+    return v;
+  }
+
+  static Json ParseString(const std::string& t, size_t& p) {
+    Json v;
+    v.type = kString;
+    ++p;  // opening quote
+    while (p < t.size() && t[p] != '"') {
+      char c = t[p++];
+      if (c == '\\') {
+        if (p >= t.size()) throw std::runtime_error("bad escape");
+        char e = t[p++];
+        switch (e) {
+          case 'n': c = '\n'; break;
+          case 't': c = '\t'; break;
+          case 'r': c = '\r'; break;
+          case 'b': c = '\b'; break;
+          case 'f': c = '\f'; break;
+          case 'u': {  // keep BMP escapes as '?' — manifests are ASCII
+            p += 4;
+            c = '?';
+            break;
+          }
+          default: c = e;
+        }
+      }
+      v.str.push_back(c);
+    }
+    if (p >= t.size()) throw std::runtime_error("unterminated string");
+    ++p;  // closing quote
+    return v;
+  }
+
+  static Json ParseArray(const std::string& t, size_t& p) {
+    Json v;
+    v.type = kArray;
+    ++p;
+    SkipWs(t, p);
+    if (p < t.size() && t[p] == ']') {
+      ++p;
+      return v;
+    }
+    while (true) {
+      v.array.push_back(ParseValue(t, p));
+      SkipWs(t, p);
+      if (p >= t.size()) throw std::runtime_error("unterminated array");
+      if (t[p] == ',') {
+        ++p;
+        continue;
+      }
+      if (t[p] == ']') {
+        ++p;
+        return v;
+      }
+      throw std::runtime_error("bad array separator");
+    }
+  }
+
+  static Json ParseObject(const std::string& t, size_t& p) {
+    Json v;
+    v.type = kObject;
+    ++p;
+    SkipWs(t, p);
+    if (p < t.size() && t[p] == '}') {
+      ++p;
+      return v;
+    }
+    while (true) {
+      SkipWs(t, p);
+      Json key = ParseString(t, p);
+      SkipWs(t, p);
+      if (p >= t.size() || t[p] != ':')
+        throw std::runtime_error("missing ':'");
+      ++p;
+      v.object[key.str] = ParseValue(t, p);
+      SkipWs(t, p);
+      if (p >= t.size()) throw std::runtime_error("unterminated object");
+      if (t[p] == ',') {
+        ++p;
+        continue;
+      }
+      if (t[p] == '}') {
+        ++p;
+        return v;
+      }
+      throw std::runtime_error("bad object separator");
+    }
+  }
+};
+
+}  // namespace veles_rt

@@ -1,0 +1,52 @@
+// veles_runner — native inference CLI.
+// Counterpart of the libVeles embedded entry path (WorkflowLoader::Load
+// → Workflow::Initialize → Engine run, libVeles/src/engine.cc:30-77):
+//
+//   veles_runner <package.tar.gz> <input.npy> <output.npy> [--repeat N]
+//
+// Loads the package, runs the forward pass on the input batch, writes
+// the result as npy, and prints one JSON status line with timing.
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "engine.h"
+#include "npy.h"
+#include "workflow.h"
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s <package.tar.gz> <input.npy> <output.npy> "
+                 "[--repeat N]\n",
+                 argv[0]);
+    return 2;
+  }
+  int repeat = 1;
+  for (int i = 4; i + 1 < argc + 1; ++i)
+    if (i + 1 < argc && std::strcmp(argv[i], "--repeat") == 0)
+      repeat = std::atoi(argv[i + 1]);
+  try {
+    auto wf = veles_rt::PackagedWorkflow::Load(argv[1]);
+    veles_rt::Tensor input = veles_rt::npy::LoadFile(argv[2]);
+    veles_rt::ThreadPool pool;
+    veles_rt::Tensor out = wf.Run(input, &pool);  // warm (touch pages)
+    auto t0 = std::chrono::steady_clock::now();
+    for (int i = 0; i < repeat; ++i) out = wf.Run(input, &pool);
+    double dt = std::chrono::duration<double>(
+                    std::chrono::steady_clock::now() - t0)
+                    .count() /
+                repeat;
+    veles_rt::npy::SaveFile(argv[3], out);
+    std::printf(
+        "{\"workflow\": \"%s\", \"units\": %zu, \"batch\": %zu, "
+        "\"sec_per_run\": %.6f, \"samples_per_sec\": %.1f}\n",
+        wf.name().c_str(), wf.unit_count(), input.dim(0), dt,
+        input.dim(0) / dt);
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

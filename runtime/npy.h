@@ -1,0 +1,145 @@
+// Minimal .npy reader/writer (format spec v1.0/2.0).
+// Counterpart of libVeles/src/numpy_array_loader.cc — own
+// implementation from the public npy format description.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "tensor.h"
+
+namespace veles_rt {
+namespace npy {
+
+inline std::string ReadHeader(const uint8_t* buf, size_t len,
+                              size_t* data_off) {
+  if (len < 10 || std::memcmp(buf, "\x93NUMPY", 6) != 0)
+    throw std::runtime_error("not an npy file");
+  uint8_t major = buf[6];
+  size_t hlen, hstart;
+  if (major == 1) {
+    hlen = buf[8] | (buf[9] << 8);
+    hstart = 10;
+  } else {
+    if (len < 12) throw std::runtime_error("truncated npy");
+    hlen = static_cast<size_t>(buf[8]) | (buf[9] << 8) |
+           (static_cast<size_t>(buf[10]) << 16) |
+           (static_cast<size_t>(buf[11]) << 24);
+    hstart = 12;
+  }
+  if (hstart + hlen > len) throw std::runtime_error("truncated npy header");
+  *data_off = hstart + hlen;
+  return std::string(reinterpret_cast<const char*>(buf + hstart), hlen);
+}
+
+// pull "'key': value" fields out of the header's python-dict literal
+inline std::string DictField(const std::string& h, const std::string& key) {
+  size_t p = h.find("'" + key + "'");
+  if (p == std::string::npos)
+    throw std::runtime_error("npy header missing " + key);
+  p = h.find(':', p);
+  ++p;
+  while (p < h.size() && (h[p] == ' ')) ++p;
+  size_t end = p;
+  if (h[p] == '\'') {
+    end = h.find('\'', p + 1) + 1;
+  } else if (h[p] == '(') {
+    end = h.find(')', p) + 1;
+  } else {
+    while (end < h.size() && h[end] != ',' && h[end] != '}') ++end;
+  }
+  return h.substr(p, end - p);
+}
+
+inline Tensor Load(const std::vector<uint8_t>& bytes) {
+  size_t off = 0;
+  std::string header = ReadHeader(bytes.data(), bytes.size(), &off);
+  std::string descr = DictField(header, "descr");
+  std::string order = DictField(header, "fortran_order");
+  std::string shape_s = DictField(header, "shape");
+  if (order.find("True") != std::string::npos)
+    throw std::runtime_error("fortran order unsupported");
+
+  Tensor t;
+  for (size_t p = 0; p < shape_s.size();) {
+    if (isdigit(static_cast<unsigned char>(shape_s[p]))) {
+      size_t end = p;
+      while (end < shape_s.size() &&
+             isdigit(static_cast<unsigned char>(shape_s[end])))
+        ++end;
+      t.shape.push_back(std::stoul(shape_s.substr(p, end - p)));
+      p = end;
+    } else {
+      ++p;
+    }
+  }
+  size_t n = t.count();
+  t.data.resize(n);
+  const uint8_t* d = bytes.data() + off;
+  size_t avail = bytes.size() - off;
+  auto need = [&](size_t want) {
+    if (avail < want) throw std::runtime_error("npy data truncated");
+  };
+  if (descr.find("f4") != std::string::npos) {
+    need(n * 4);
+    std::memcpy(t.data.data(), d, n * 4);
+  } else if (descr.find("f8") != std::string::npos) {
+    need(n * 8);
+    const double* src = reinterpret_cast<const double*>(d);
+    for (size_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(src[i]);
+  } else if (descr.find("i4") != std::string::npos) {
+    need(n * 4);
+    const int32_t* src = reinterpret_cast<const int32_t*>(d);
+    for (size_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(src[i]);
+  } else if (descr.find("i8") != std::string::npos) {
+    need(n * 8);
+    const int64_t* src = reinterpret_cast<const int64_t*>(d);
+    for (size_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(src[i]);
+  } else if (descr.find("u1") != std::string::npos ||
+             descr.find("|b1") != std::string::npos) {
+    need(n);
+    for (size_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(d[i]);
+  } else {
+    throw std::runtime_error("unsupported npy dtype: " + descr);
+  }
+  return t;
+}
+
+inline Tensor LoadFile(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::vector<uint8_t> bytes((std::istreambuf_iterator<char>(f)),
+                             std::istreambuf_iterator<char>());
+  return Load(bytes);
+}
+
+inline void SaveFile(const std::string& path, const Tensor& t) {
+  std::string shape = "(";
+  for (size_t i = 0; i < t.shape.size(); ++i) {
+    shape += std::to_string(t.shape[i]);
+    if (i + 1 < t.shape.size() || t.shape.size() == 1) shape += ",";
+  }
+  shape += ")";
+  std::string header = "{'descr': '<f4', 'fortran_order': False, "
+                       "'shape': " + shape + ", }";
+  size_t total = 10 + header.size() + 1;
+  size_t pad = (64 - total % 64) % 64;
+  header += std::string(pad, ' ');
+  header += '\n';
+  uint16_t hlen = static_cast<uint16_t>(header.size());
+  std::ofstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot write " + path);
+  f.write("\x93NUMPY\x01\x00", 8);
+  f.put(static_cast<char>(hlen & 0xff));
+  f.put(static_cast<char>(hlen >> 8));
+  f.write(header.data(), header.size());
+  f.write(reinterpret_cast<const char*>(t.data.data()),
+          t.data.size() * sizeof(float));
+}
+
+}  // namespace npy
+}  // namespace veles_rt

@@ -1,0 +1,32 @@
+// Tensor — dense row-major float storage for the inference runtime.
+// Counterpart of the reference's packaged-array handling
+// (libVeles/src/numpy_array_loader.cc role); everything the runner
+// computes in is float32 NHWC.
+#pragma once
+
+#include <cstddef>
+#include <numeric>
+#include <vector>
+
+namespace veles_rt {
+
+struct Tensor {
+  std::vector<size_t> shape;
+  std::vector<float> data;
+
+  Tensor() = default;
+  explicit Tensor(std::vector<size_t> s) : shape(std::move(s)) {
+    data.assign(count(), 0.0f);
+  }
+
+  size_t count() const {
+    size_t n = 1;
+    for (size_t d : shape) n *= d;
+    return n;
+  }
+  size_t dim(size_t i) const { return shape.at(i); }
+  float* ptr() { return data.data(); }
+  const float* ptr() const { return data.data(); }
+};
+
+}  // namespace veles_rt

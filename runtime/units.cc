@@ -1,0 +1,460 @@
+#include "units.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <limits>
+#include <stdexcept>
+
+namespace veles_rt {
+
+Activation ActivationFromName(const std::string& name) {
+  if (name == "linear") return Activation::kLinear;
+  if (name == "tanh") return Activation::kTanh;
+  if (name == "relu") return Activation::kRelu;
+  if (name == "strict_relu") return Activation::kStrictRelu;
+  if (name == "sigmoid") return Activation::kSigmoid;
+  throw std::runtime_error("unknown activation: " + name);
+}
+
+void ApplyActivation(Activation act, float* d, size_t n) {
+  switch (act) {
+    case Activation::kLinear:
+      return;
+    case Activation::kTanh:  // LeCun-scaled tanh (models/activations.py)
+      for (size_t i = 0; i < n; ++i)
+        d[i] = 1.7159f * std::tanh(0.6666f * d[i]);
+      return;
+    case Activation::kRelu:  // softplus, overflow-safe logaddexp(x, 0)
+      for (size_t i = 0; i < n; ++i) {
+        float x = d[i];
+        d[i] = std::fmax(x, 0.0f) + std::log1p(std::exp(-std::fabs(x)));
+      }
+      return;
+    case Activation::kStrictRelu:
+      for (size_t i = 0; i < n; ++i) d[i] = std::fmax(d[i], 0.0f);
+      return;
+    case Activation::kSigmoid:
+      for (size_t i = 0; i < n; ++i) d[i] = 1.0f / (1.0f + std::exp(-d[i]));
+      return;
+  }
+}
+
+// -- Dense --------------------------------------------------------------------
+
+Dense::Dense(const Json& config, Activation act, bool softmax)
+    : act_(act), softmax_(softmax) {
+  for (const Json& d : config.at("output_sample_shape").array)
+    out_sample_.push_back(static_cast<size_t>(d.number));
+  include_bias_ = !config.has("include_bias") ||
+                  config.at("include_bias").boolean;
+  if (config.has("activation"))
+    act_ = ActivationFromName(config.at("activation").str);
+}
+
+void Dense::SetParam(const std::string& name, Tensor t) {
+  if (name == "weights")
+    weights_ = std::move(t);
+  else if (name == "bias")
+    bias_ = std::move(t);
+}
+
+std::vector<size_t> Dense::OutShape(const std::vector<size_t>& in) const {
+  std::vector<size_t> out{in[0]};
+  out.insert(out.end(), out_sample_.begin(), out_sample_.end());
+  return out;
+}
+
+void Dense::Execute(const Tensor& in, Tensor* out, ThreadPool* pool) const {
+  size_t batch = in.dim(0);
+  size_t k = in.count() / batch;
+  size_t m = weights_.dim(1);
+  if (weights_.dim(0) != k)
+    throw std::runtime_error("Dense weight shape mismatch");
+  *out = Tensor(OutShape(in.shape));
+  const float* w = weights_.ptr();
+  const float* b = include_bias_ ? bias_.ptr() : nullptr;
+  pool->ParallelFor(batch, [&](size_t r0, size_t r1) {
+    // row-major GEMM with k-blocked inner loop: y[r,:] += x[r,kk]*W[kk,:]
+    for (size_t r = r0; r < r1; ++r) {
+      const float* x = in.ptr() + r * k;
+      float* y = out->ptr() + r * m;
+      if (b)
+        std::memcpy(y, b, m * sizeof(float));
+      else
+        std::memset(y, 0, m * sizeof(float));
+      for (size_t kk = 0; kk < k; ++kk) {
+        float xv = x[kk];
+        if (xv == 0.0f) continue;
+        const float* wr = w + kk * m;
+        for (size_t j = 0; j < m; ++j) y[j] += xv * wr[j];
+      }
+      ApplyActivation(act_, y, m);
+      if (softmax_) {
+        float mx = -std::numeric_limits<float>::infinity();
+        for (size_t j = 0; j < m; ++j) mx = std::fmax(mx, y[j]);
+        float sum = 0;
+        for (size_t j = 0; j < m; ++j) {
+          y[j] = std::exp(y[j] - mx);
+          sum += y[j];
+        }
+        for (size_t j = 0; j < m; ++j) y[j] /= sum;
+      }
+    }
+  });
+}
+
+// -- Conv2D -------------------------------------------------------------------
+
+Conv2D::Conv2D(const Json& config, Activation act) : act_(act) {
+  n_kernels_ = config.at("n_kernels").as_int();
+  kx_ = config.at("kx").as_int();
+  ky_ = config.at("ky").as_int();
+  sx_ = sy_ = 1;
+  if (config.has("sliding")) {
+    sx_ = config.at("sliding").array[0].as_int();
+    sy_ = config.at("sliding").array[1].as_int();
+  }
+  groups_ = config.has("n_groups") ? config.at("n_groups").as_int() : 1;
+  include_bias_ = !config.has("include_bias") ||
+                  config.at("include_bias").boolean;
+  if (config.has("activation"))
+    act_ = ActivationFromName(config.at("activation").str);
+  const Json& pad = config.at("padding");
+  if (pad.type == Json::kString) {
+    pad_mode_ = pad.str;  // "same" / "valid"
+    for (auto& c : pad_mode_) c = static_cast<char>(tolower(c));
+  } else if (pad.type == Json::kNumber) {
+    pad_mode_ = "int";
+    pad_int_ = pad.as_int();
+  } else {
+    pad_mode_ = "pairs";  // [[top,bottom],[left,right]]
+    pad_pairs_[0] = pad.array[0].array[0].as_int();
+    pad_pairs_[1] = pad.array[0].array[1].as_int();
+    pad_pairs_[2] = pad.array[1].array[0].as_int();
+    pad_pairs_[3] = pad.array[1].array[1].as_int();
+  }
+}
+
+void Conv2D::SetParam(const std::string& name, Tensor t) {
+  if (name == "weights")
+    weights_ = std::move(t);  // HWIO
+  else if (name == "bias")
+    bias_ = std::move(t);
+}
+
+void Conv2D::Padding(size_t in_h, size_t in_w, size_t* pt, size_t* pb,
+                     size_t* pl, size_t* pr) const {
+  if (pad_mode_ == "valid") {
+    *pt = *pb = *pl = *pr = 0;
+  } else if (pad_mode_ == "same") {
+    // XLA SAME: out = ceil(in / stride)
+    size_t out_h = (in_h + sy_ - 1) / sy_;
+    size_t out_w = (in_w + sx_ - 1) / sx_;
+    size_t th = std::max<long>(0, (long)((out_h - 1) * sy_ + ky_) - (long)in_h);
+    size_t tw = std::max<long>(0, (long)((out_w - 1) * sx_ + kx_) - (long)in_w);
+    *pt = th / 2;
+    *pb = th - *pt;
+    *pl = tw / 2;
+    *pr = tw - *pl;
+  } else if (pad_mode_ == "int") {
+    *pt = *pb = *pl = *pr = static_cast<size_t>(pad_int_);
+  } else {
+    *pt = pad_pairs_[0];
+    *pb = pad_pairs_[1];
+    *pl = pad_pairs_[2];
+    *pr = pad_pairs_[3];
+  }
+}
+
+std::vector<size_t> Conv2D::OutShape(const std::vector<size_t>& in) const {
+  size_t pt, pb, pl, pr;
+  Padding(in[1], in[2], &pt, &pb, &pl, &pr);
+  size_t out_h = (in[1] + pt + pb - ky_) / sy_ + 1;
+  size_t out_w = (in[2] + pl + pr - kx_) / sx_ + 1;
+  return {in[0], out_h, out_w, static_cast<size_t>(n_kernels_)};
+}
+
+void Conv2D::Execute(const Tensor& in, Tensor* out, ThreadPool* pool) const {
+  size_t batch = in.dim(0), in_h = in.dim(1), in_w = in.dim(2),
+         in_c = in.dim(3);
+  size_t pt, pb, pl, pr;
+  Padding(in_h, in_w, &pt, &pb, &pl, &pr);
+  (void)pb;
+  (void)pr;
+  auto oshape = OutShape(in.shape);
+  size_t out_h = oshape[1], out_w = oshape[2], out_c = oshape[3];
+  *out = Tensor(oshape);
+  size_t cin_g = in_c / groups_;       // input channels per group
+  size_t cout_g = out_c / groups_;     // kernels per group
+  const float* w = weights_.ptr();     // [ky, kx, cin_g, out_c]
+  const float* b = include_bias_ ? bias_.ptr() : nullptr;
+
+  pool->ParallelFor(batch, [&](size_t n0, size_t n1) {
+    // im2col per output row, then dot with the kernel slab: the patch
+    // loop is the hot path, kept cache-friendly via NHWC contiguity
+    for (size_t n = n0; n < n1; ++n) {
+      const float* img = in.ptr() + n * in_h * in_w * in_c;
+      float* dst = out->ptr() + n * out_h * out_w * out_c;
+      for (size_t oy = 0; oy < out_h; ++oy) {
+        for (size_t ox = 0; ox < out_w; ++ox) {
+          float* y = dst + (oy * out_w + ox) * out_c;
+          if (b)
+            std::memcpy(y, b, out_c * sizeof(float));
+          else
+            std::memset(y, 0, out_c * sizeof(float));
+          long iy0 = static_cast<long>(oy * sy_) - static_cast<long>(pt);
+          long ix0 = static_cast<long>(ox * sx_) - static_cast<long>(pl);
+          for (int dy = 0; dy < ky_; ++dy) {
+            long iy = iy0 + dy;
+            if (iy < 0 || iy >= static_cast<long>(in_h)) continue;
+            for (int dx = 0; dx < kx_; ++dx) {
+              long ix = ix0 + dx;
+              if (ix < 0 || ix >= static_cast<long>(in_w)) continue;
+              const float* px = img + (iy * in_w + ix) * in_c;
+              const float* wk = w + (dy * kx_ + dx) * cin_g * out_c;
+              for (int g = 0; g < groups_; ++g) {
+                const float* pxg = px + g * cin_g;
+                float* yg = y + g * cout_g;
+                for (size_t c = 0; c < cin_g; ++c) {
+                  float xv = pxg[c];
+                  if (xv == 0.0f) continue;
+                  // kernel column block of group g
+                  const float* wc = wk + c * out_c + g * cout_g;
+                  for (size_t j = 0; j < cout_g; ++j) yg[j] += xv * wc[j];
+                }
+              }
+            }
+          }
+          ApplyActivation(act_, y, out_c);
+        }
+      }
+    }
+  });
+}
+
+// -- Deconv2D -----------------------------------------------------------------
+
+Deconv2D::Deconv2D(const Json& config, Activation act) : act_(act) {
+  n_kernels_ = config.at("n_kernels").as_int();
+  kx_ = config.at("kx").as_int();
+  ky_ = config.at("ky").as_int();
+  sx_ = sy_ = 1;
+  if (config.has("sliding")) {
+    sx_ = config.at("sliding").array[0].as_int();
+    sy_ = config.at("sliding").array[1].as_int();
+  }
+  include_bias_ = !config.has("include_bias") ||
+                  config.at("include_bias").boolean;
+  if (config.has("activation"))
+    act_ = ActivationFromName(config.at("activation").str);
+  const Json& pad = config.at("padding");
+  if (pad.type != Json::kString)
+    throw std::runtime_error("Deconv supports same/valid padding only");
+  std::string p = pad.str;
+  for (auto& c : p) c = static_cast<char>(tolower(c));
+  same_ = (p == "same");
+}
+
+void Deconv2D::SetParam(const std::string& name, Tensor t) {
+  if (name == "weights")
+    weights_ = std::move(t);  // HWOI: [ky, kx, out, in]
+  else
+    bias_ = std::move(t);
+}
+
+// pad_a of jax's _conv_transpose_padding: the low padding of the
+// stride-1 conv over the stride-dilated input
+void Deconv2D::Padding(size_t* pa_y, size_t* pa_x) const {
+  auto pad_a = [&](int k, int s) -> size_t {
+    if (!same_) return static_cast<size_t>(k - 1);
+    if (s > k - 1) return static_cast<size_t>(k - 1);
+    return static_cast<size_t>((k + s - 2 + 1) / 2);  // ceil(pad_len/2)
+  };
+  *pa_y = pad_a(ky_, sy_);
+  *pa_x = pad_a(kx_, sx_);
+}
+
+std::vector<size_t> Deconv2D::OutShape(const std::vector<size_t>& in) const {
+  size_t out_h = same_ ? in[1] * sy_
+                       : in[1] * sy_ + std::max(ky_ - sy_, 0);
+  size_t out_w = same_ ? in[2] * sx_
+                       : in[2] * sx_ + std::max(kx_ - sx_, 0);
+  return {in[0], out_h, out_w, static_cast<size_t>(n_kernels_)};
+}
+
+void Deconv2D::Execute(const Tensor& in, Tensor* out,
+                       ThreadPool* pool) const {
+  size_t batch = in.dim(0), in_h = in.dim(1), in_w = in.dim(2),
+         in_c = in.dim(3);
+  auto oshape = OutShape(in.shape);
+  size_t out_h = oshape[1], out_w = oshape[2], out_c = oshape[3];
+  if (weights_.dim(3) != in_c || weights_.dim(2) != out_c)
+    throw std::runtime_error("Deconv weight shape mismatch");
+  *out = Tensor(oshape);
+  size_t pa_y, pa_x;
+  Padding(&pa_y, &pa_x);
+  const float* w = weights_.ptr();
+  const float* b = include_bias_ ? bias_.ptr() : nullptr;
+  pool->ParallelFor(batch, [&](size_t n0, size_t n1) {
+    // gather over the stride-dilated input: output (oy,ox) reads input
+    // positions whose dilated coordinate oy-pa+dy lands on a stride grid
+    for (size_t n = n0; n < n1; ++n) {
+      const float* img = in.ptr() + n * in_h * in_w * in_c;
+      float* dst = out->ptr() + n * out_h * out_w * out_c;
+      for (size_t oy = 0; oy < out_h; ++oy)
+        for (size_t ox = 0; ox < out_w; ++ox) {
+          float* y = dst + (oy * out_w + ox) * out_c;
+          if (b)
+            std::memcpy(y, b, out_c * sizeof(float));
+          else
+            std::memset(y, 0, out_c * sizeof(float));
+          for (int dy = 0; dy < ky_; ++dy) {
+            // dilated coord of this tap: oy - pa_y + dy
+            long yd = static_cast<long>(oy) - static_cast<long>(pa_y) + dy;
+            if (yd < 0 || yd % sy_ != 0) continue;
+            long iy = yd / sy_;
+            if (iy >= static_cast<long>(in_h)) continue;
+            for (int dx = 0; dx < kx_; ++dx) {
+              long xd = static_cast<long>(ox) - static_cast<long>(pa_x) +
+                        dx;
+              if (xd < 0 || xd % sx_ != 0) continue;
+              long ix = xd / sx_;
+              if (ix >= static_cast<long>(in_w)) continue;
+              const float* px = img + (iy * in_w + ix) * in_c;
+              const float* wk = w + (dy * kx_ + dx) * out_c * in_c;
+              for (size_t o = 0; o < out_c; ++o) {
+                const float* wo = wk + o * in_c;
+                float acc = 0;
+                for (size_t i = 0; i < in_c; ++i) acc += px[i] * wo[i];
+                y[o] += acc;
+              }
+            }
+          }
+          ApplyActivation(act_, y, out_c);
+        }
+    }
+  });
+}
+
+// -- Pooling ------------------------------------------------------------------
+
+Pooling::Pooling(const Json& config, bool is_max) : is_max_(is_max) {
+  kx_ = config.at("kx").as_int();
+  ky_ = config.at("ky").as_int();
+  sx_ = kx_;
+  sy_ = ky_;
+  if (config.has("sliding")) {
+    sx_ = config.at("sliding").array[0].as_int();
+    sy_ = config.at("sliding").array[1].as_int();
+  }
+}
+
+std::vector<size_t> Pooling::OutShape(const std::vector<size_t>& in) const {
+  // VALID padding, matching models/pooling.py reduce_window
+  size_t out_h = (in[1] - ky_) / sy_ + 1;
+  size_t out_w = (in[2] - kx_) / sx_ + 1;
+  return {in[0], out_h, out_w, in[3]};
+}
+
+void Pooling::Execute(const Tensor& in, Tensor* out, ThreadPool* pool) const {
+  size_t batch = in.dim(0), in_h = in.dim(1), in_w = in.dim(2),
+         c = in.dim(3);
+  auto oshape = OutShape(in.shape);
+  size_t out_h = oshape[1], out_w = oshape[2];
+  *out = Tensor(oshape);
+  float inv = 1.0f / (kx_ * ky_);
+  pool->ParallelFor(batch, [&](size_t n0, size_t n1) {
+    for (size_t n = n0; n < n1; ++n) {
+      const float* img = in.ptr() + n * in_h * in_w * c;
+      float* dst = out->ptr() + n * out_h * out_w * c;
+      for (size_t oy = 0; oy < out_h; ++oy)
+        for (size_t ox = 0; ox < out_w; ++ox) {
+          float* y = dst + (oy * out_w + ox) * c;
+          for (size_t ch = 0; ch < c; ++ch)
+            y[ch] = is_max_ ? -std::numeric_limits<float>::infinity() : 0.0f;
+          for (int dy = 0; dy < ky_; ++dy)
+            for (int dx = 0; dx < kx_; ++dx) {
+              const float* px =
+                  img + ((oy * sy_ + dy) * in_w + (ox * sx_ + dx)) * c;
+              if (is_max_)
+                for (size_t ch = 0; ch < c; ++ch)
+                  y[ch] = std::fmax(y[ch], px[ch]);
+              else
+                for (size_t ch = 0; ch < c; ++ch) y[ch] += px[ch];
+            }
+          if (!is_max_)
+            for (size_t ch = 0; ch < c; ++ch) y[ch] *= inv;
+        }
+    }
+  });
+}
+
+// -- LRN ----------------------------------------------------------------------
+
+LRN::LRN(const Json& config) {
+  alpha_ = config.at("alpha").number;
+  beta_ = config.at("beta").number;
+  k_ = config.at("k").number;
+  n_ = config.at("n").as_int();
+}
+
+std::vector<size_t> LRN::OutShape(const std::vector<size_t>& in) const {
+  return in;
+}
+
+void LRN::Execute(const Tensor& in, Tensor* out, ThreadPool* pool) const {
+  *out = Tensor(in.shape);
+  size_t c = in.shape.back();
+  size_t rows = in.count() / c;
+  int half = n_ / 2, hi = n_ - 1 - half;
+  pool->ParallelFor(rows, [&](size_t r0, size_t r1) {
+    for (size_t r = r0; r < r1; ++r) {
+      const float* x = in.ptr() + r * c;
+      float* y = out->ptr() + r * c;
+      for (size_t ch = 0; ch < c; ++ch) {
+        double s = 0;
+        long lo = std::max<long>(0, static_cast<long>(ch) - half);
+        long hi_c = std::min<long>(c - 1, static_cast<long>(ch) + hi);
+        for (long j = lo; j <= hi_c; ++j) s += double(x[j]) * x[j];
+        y[ch] = static_cast<float>(x[ch] *
+                                   std::pow(k_ + alpha_ * s, -beta_));
+      }
+    }
+  });
+}
+
+// -- factory ------------------------------------------------------------------
+
+std::unique_ptr<Unit> CreateUnit(const std::string& cls, const Json& config) {
+  auto dense = [&](Activation a, bool sm) {
+    return std::unique_ptr<Unit>(new Dense(config, a, sm));
+  };
+  auto conv = [&](Activation a) {
+    return std::unique_ptr<Unit>(new Conv2D(config, a));
+  };
+  if (cls == "All2All") return dense(Activation::kLinear, false);
+  if (cls == "All2AllTanh") return dense(Activation::kTanh, false);
+  if (cls == "All2AllRELU") return dense(Activation::kRelu, false);
+  if (cls == "All2AllStrictRELU")
+    return dense(Activation::kStrictRelu, false);
+  if (cls == "All2AllSigmoid") return dense(Activation::kSigmoid, false);
+  if (cls == "All2AllSoftmax") return dense(Activation::kLinear, true);
+  if (cls == "Conv") return conv(Activation::kLinear);
+  if (cls == "ConvTanh") return conv(Activation::kTanh);
+  if (cls == "ConvRELU") return conv(Activation::kRelu);
+  if (cls == "ConvStrictRELU") return conv(Activation::kStrictRelu);
+  if (cls == "Deconv")
+    return std::unique_ptr<Unit>(new Deconv2D(config, Activation::kLinear));
+  if (cls == "MaxPooling")
+    return std::unique_ptr<Unit>(new Pooling(config, true));
+  if (cls == "AvgPooling")
+    return std::unique_ptr<Unit>(new Pooling(config, false));
+  if (cls == "LRNormalizerForward")
+    return std::unique_ptr<Unit>(new LRN(config));
+  if (cls == "DropoutForward")
+    return std::unique_ptr<Unit>(new Identity());
+  throw std::runtime_error("unit factory: unknown class " + cls);
+}
+
+}  // namespace veles_rt

@@ -1,0 +1,136 @@
+// Inference units — native forward implementations of the exported
+// layer classes.  Counterpart of the libVeles Unit ABC + factory
+// (libVeles/inc/veles/unit.h:105, src/unit_factory.cc:1-65): units are
+// instantiated by class name / stable UUID from contents.json and
+// execute float32 NHWC forward passes on the CPU.
+#pragma once
+
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "engine.h"
+#include "json.h"
+#include "tensor.h"
+
+namespace veles_rt {
+
+enum class Activation { kLinear, kTanh, kRelu, kStrictRelu, kSigmoid };
+
+Activation ActivationFromName(const std::string& name);
+void ApplyActivation(Activation act, float* data, size_t n);
+
+class Unit {
+ public:
+  virtual ~Unit() = default;
+  virtual std::vector<size_t> OutShape(
+      const std::vector<size_t>& in) const = 0;
+  virtual void Execute(const Tensor& in, Tensor* out,
+                       ThreadPool* pool) const = 0;
+  // adopt parameters loaded from the archive's npy files
+  virtual void SetParam(const std::string& /*name*/, Tensor /*t*/) {}
+  std::string name;
+};
+
+// y = act(x @ W + b); W is [in, out] like the exporter's All2All.
+// All2AllSoftmax applies softmax over the last axis.
+class Dense : public Unit {
+ public:
+  Dense(const Json& config, Activation act, bool softmax);
+  std::vector<size_t> OutShape(const std::vector<size_t>& in) const override;
+  void Execute(const Tensor& in, Tensor* out,
+               ThreadPool* pool) const override;
+  void SetParam(const std::string& name, Tensor t) override;
+
+ private:
+  std::vector<size_t> out_sample_;
+  Activation act_;
+  bool softmax_;
+  bool include_bias_;
+  Tensor weights_, bias_;
+};
+
+// NHWC conv with HWIO weights, strides, groups and XLA-compatible
+// padding ("same" | "valid" | int | [[t,b],[l,r]]), matching
+// veles_tpu.models.conv.Conv semantics.
+class Conv2D : public Unit {
+ public:
+  Conv2D(const Json& config, Activation act);
+  std::vector<size_t> OutShape(const std::vector<size_t>& in) const override;
+  void Execute(const Tensor& in, Tensor* out,
+               ThreadPool* pool) const override;
+  void SetParam(const std::string& name, Tensor t) override;
+
+ private:
+  void Padding(size_t in_h, size_t in_w, size_t* pt, size_t* pb, size_t* pl,
+               size_t* pr) const;
+  int kx_, ky_, sx_, sy_, groups_, n_kernels_;
+  std::string pad_mode_;  // "same", "valid", "int", "pairs"
+  int pad_int_ = 0;
+  int pad_pairs_[4] = {0, 0, 0, 0};
+  Activation act_;
+  bool include_bias_;
+  Tensor weights_, bias_;
+};
+
+// transposed convolution, matching jax.lax.conv_transpose with HWOI
+// kernels ([ky, kx, out, in]) and "same"/"valid" padding
+class Deconv2D : public Unit {
+ public:
+  Deconv2D(const Json& config, Activation act);
+  std::vector<size_t> OutShape(const std::vector<size_t>& in) const override;
+  void Execute(const Tensor& in, Tensor* out,
+               ThreadPool* pool) const override;
+  void SetParam(const std::string& name, Tensor t) override;
+
+ private:
+  void Padding(size_t* pa_y, size_t* pa_x) const;
+  int kx_, ky_, sx_, sy_, n_kernels_;
+  bool same_;
+  Activation act_;
+  bool include_bias_;
+  Tensor weights_, bias_;
+};
+
+class Pooling : public Unit {
+ public:
+  Pooling(const Json& config, bool is_max);
+  std::vector<size_t> OutShape(const std::vector<size_t>& in) const override;
+  void Execute(const Tensor& in, Tensor* out,
+               ThreadPool* pool) const override;
+
+ private:
+  int kx_, ky_, sx_, sy_;
+  bool is_max_;
+};
+
+// cross-channel LRN, same banded-window semantics as models/lrn.py
+class LRN : public Unit {
+ public:
+  explicit LRN(const Json& config);
+  std::vector<size_t> OutShape(const std::vector<size_t>& in) const override;
+  void Execute(const Tensor& in, Tensor* out,
+               ThreadPool* pool) const override;
+
+ private:
+  double alpha_, beta_, k_;
+  int n_;
+};
+
+class Identity : public Unit {  // dropout at inference
+ public:
+  std::vector<size_t> OutShape(const std::vector<size_t>& in) const override {
+    return in;
+  }
+  void Execute(const Tensor& in, Tensor* out,
+               ThreadPool* /*pool*/) const override {
+    out->shape = in.shape;
+    out->data = in.data;
+  }
+};
+
+// factory keyed by exporter class name (unit_factory.cc role)
+std::unique_ptr<Unit> CreateUnit(const std::string& cls, const Json& config);
+
+}  // namespace veles_rt

@@ -1,0 +1,59 @@
+#include "workflow.h"
+
+#include <stdexcept>
+
+#include "archive.h"
+#include "json.h"
+#include "npy.h"
+
+namespace veles_rt {
+
+PackagedWorkflow PackagedWorkflow::Load(const std::string& path) {
+  auto files = ReadTarGz(path);
+  auto it = files.find("contents.json");
+  if (it == files.end())
+    throw std::runtime_error("package has no contents.json");
+  Json manifest = Json::Parse(
+      std::string(it->second.begin(), it->second.end()));
+  if (manifest.at("format_version").as_int() > 1)
+    throw std::runtime_error("package format too new for this runtime");
+
+  PackagedWorkflow wf;
+  wf.name_ = manifest.at("workflow").str;
+  for (const Json& d : manifest.at("input").at("shape").array)
+    wf.input_shape_.push_back(static_cast<size_t>(d.number));
+
+  for (const Json& entry : manifest.at("units").array) {
+    auto unit = CreateUnit(entry.at("class").str, entry.at("config"));
+    unit->name = entry.at("name").str;
+    for (const auto& kv : entry.at("params").object) {
+      auto fit = files.find(kv.second.str);
+      if (fit == files.end())
+        throw std::runtime_error("package missing " + kv.second.str);
+      unit->SetParam(kv.first, npy::Load(fit->second));
+    }
+    wf.units_.push_back(std::move(unit));
+  }
+  return wf;
+}
+
+Tensor PackagedWorkflow::Run(const Tensor& input, ThreadPool* pool) {
+  bool ok = input.shape.size() == input_shape_.size() &&
+            input.shape[0] <= input_shape_[0];
+  for (size_t i = 1; ok && i < input_shape_.size(); ++i)
+    ok = input.shape[i] == input_shape_[i];
+  if (!ok)
+    throw std::runtime_error(
+        "input shape incompatible with packaged input spec");
+  // ping-pong execution: each unit reads one arena and writes the other
+  Tensor a = input, b;
+  Tensor* src = &a;
+  Tensor* dst = &b;
+  for (const auto& u : units_) {
+    u->Execute(*src, dst, pool);
+    std::swap(src, dst);
+  }
+  return *src;
+}
+
+}  // namespace veles_rt

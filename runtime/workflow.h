@@ -1,0 +1,38 @@
+// Packaged-workflow loader + executor.
+// Counterpart of libVeles WorkflowLoader::Load + Workflow::Initialize
+// (libVeles/src/workflow_loader.cc:41-131): reads the tar.gz package,
+// instantiates units via the class factory, assigns npy parameters, and
+// executes the chain with ping-pong buffer reuse (the reference packed
+// unit scratch buffers with a greedy rectangle MemoryOptimizer,
+// libVeles/src/memory_optimizer.cc:38-110; a linear chain needs exactly
+// two arenas, which is the same minimum its packer would reach).
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "engine.h"
+#include "tensor.h"
+#include "units.h"
+
+namespace veles_rt {
+
+class PackagedWorkflow {
+ public:
+  static PackagedWorkflow Load(const std::string& path);
+
+  // forward pass; input batch must not exceed the packaged batch
+  Tensor Run(const Tensor& input, ThreadPool* pool);
+
+  const std::vector<size_t>& input_shape() const { return input_shape_; }
+  const std::string& name() const { return name_; }
+  size_t unit_count() const { return units_.size(); }
+
+ private:
+  std::string name_;
+  std::vector<size_t> input_shape_;
+  std::vector<std::unique_ptr<Unit>> units_;
+};
+
+}  // namespace veles_rt

@@ -1,0 +1,233 @@
+"""package_export + inference runners (L10).
+
+Golden-package round-trips (ref test shape: libVeles/tests with canned
+mnist.zip packages): export a trained workflow, reload in a fresh
+context, identical logits; and the native C++ runner must agree with
+the JAX forward within bf16-trunk tolerance."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.config import root
+
+RUNTIME_DIR = os.path.join(os.path.dirname(__file__), "..", "runtime")
+
+
+@pytest.fixture(scope="module")
+def mlp_package(tmp_path_factory):
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.standard import build_mlp_classifier
+    from veles_tpu.package_export import export_package
+
+    class TinyLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            self.class_lengths[:] = [0, 32, 96]
+            self.original_data = rng.normal(
+                size=(128, 20)).astype(numpy.float32)
+            self.original_labels = rng.integers(0, 4, 128).tolist()
+
+    dev = Device(backend="numpy")
+    wf = AcceleratedWorkflow(None, name="pkg-mlp")
+    loader = TinyLoader(wf, minibatch_size=16)
+    _, layers, ev, gd = build_mlp_classifier(
+        dev, loader, hidden=(8,), classes=4, workflow=wf)
+    for _ in range(6):
+        loader.run()
+        gd.run()
+    path = str(tmp_path_factory.mktemp("pkg") / "mlp.tar.gz")
+    export_package(layers, path, (16, 20), name="pkg-mlp")
+    x = numpy.asarray(loader.original_data[:16])
+    import jax.numpy as jnp
+    h = jnp.asarray(x)
+    for u in layers:
+        p = {k: jnp.asarray(a.map_read().mem)
+             for k, a in u.param_arrays().items()}
+        h = u.apply(p, h)
+    return path, x, numpy.asarray(h)
+
+
+@pytest.fixture(scope="module")
+def conv_package(tmp_path_factory):
+    from veles_tpu.samples.cifar import CifarWorkflow
+    root.cifar_tpu.update({
+        "synthetic_train": 128, "synthetic_valid": 32,
+        "minibatch_size": 16, "max_epochs": 1,
+    })
+    wf = CifarWorkflow(None)
+    wf.snapshotter.interval = 10**9
+    wf.snapshotter.time_interval = 10**9
+    wf.initialize(device=Device(backend="numpy"))
+    wf.run()
+    path = str(tmp_path_factory.mktemp("pkg") / "cifar.tar.gz")
+    wf.package_export(path, batch=8)
+    x = numpy.asarray(wf.loader.original_data[:8])
+    from veles_tpu.package_export import load_package
+    y_ref = load_package(path).run(x, mode="python")
+    return path, x, y_ref
+
+
+@pytest.fixture(scope="session")
+def runner_binary():
+    binary = os.path.join(RUNTIME_DIR, "veles_runner")
+    r = subprocess.run(["make", "-C", RUNTIME_DIR],
+                       capture_output=True, text=True)
+    if r.returncode != 0 or not os.path.exists(binary):
+        pytest.skip("C++ runner build failed: %s" % r.stderr[-400:])
+    return binary
+
+
+def test_python_roundtrip_exact(mlp_package):
+    from veles_tpu.package_export import load_package
+    path, x, y_ref = mlp_package
+    pkg = load_package(path)
+    y = pkg.run(x, mode="python")
+    numpy.testing.assert_array_equal(y, y_ref)
+
+
+def test_stablehlo_roundtrip(mlp_package):
+    from veles_tpu.package_export import load_package
+    path, x, y_ref = mlp_package
+    pkg = load_package(path)
+    if pkg._exported is None:
+        pytest.skip("no StableHLO in package")
+    y = pkg.run(x, mode="stablehlo")
+    numpy.testing.assert_allclose(y, y_ref, atol=5e-3)
+
+
+def test_partial_batch_padding(mlp_package):
+    from veles_tpu.package_export import load_package
+    path, x, y_ref = mlp_package
+    pkg = load_package(path)
+    y = pkg.run(x[:3], mode="python")
+    numpy.testing.assert_array_equal(y, y_ref[:3])
+    single = pkg.run(x[0], mode="python")
+    numpy.testing.assert_array_equal(single, y_ref[0])
+
+
+def test_fresh_process_golden(mlp_package, tmp_path):
+    """The libVeles golden-package scenario: a process that never saw
+    the workflow module reproduces identical logits."""
+    path, x, y_ref = mlp_package
+    numpy.save(tmp_path / "x.npy", x)
+    numpy.save(tmp_path / "y_ref.npy", y_ref)
+    code = (
+        "import numpy, sys\n"
+        "from veles_tpu.package_export import load_package\n"
+        "pkg = load_package(sys.argv[1])\n"
+        "y = pkg.run(numpy.load(sys.argv[2]), mode='python')\n"
+        "numpy.testing.assert_array_equal(y, numpy.load(sys.argv[3]))\n"
+        "print('GOLDEN-OK')\n")
+    env = dict(os.environ,
+               PYTHONPATH=os.path.dirname(RUNTIME_DIR) + os.pathsep +
+               os.environ.get("PYTHONPATH", ""),
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-c", code, path, str(tmp_path / "x.npy"),
+         str(tmp_path / "y_ref.npy")],
+        capture_output=True, text=True, env=env)
+    assert "GOLDEN-OK" in r.stdout, r.stderr[-800:]
+
+
+def test_cpp_runner_mlp(mlp_package, runner_binary, tmp_path):
+    path, x, y_ref = mlp_package
+    numpy.save(tmp_path / "in.npy", x)
+    r = subprocess.run(
+        [runner_binary, path, str(tmp_path / "in.npy"),
+         str(tmp_path / "out.npy")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    status = json.loads(r.stdout)
+    assert status["units"] == 2
+    y = numpy.load(tmp_path / "out.npy")
+    numpy.testing.assert_allclose(y, y_ref, atol=5e-3)
+
+
+def test_cpp_runner_conv(conv_package, runner_binary, tmp_path):
+    path, x, y_ref = conv_package
+    numpy.save(tmp_path / "in.npy", x)
+    r = subprocess.run(
+        [runner_binary, path, str(tmp_path / "in.npy"),
+         str(tmp_path / "out.npy")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    y = numpy.load(tmp_path / "out.npy")
+    # softmax outputs; bf16 conv trunk in jax vs f32 native
+    numpy.testing.assert_allclose(y, y_ref, atol=2e-2)
+    assert numpy.all(abs(y.sum(axis=1) - 1.0) < 1e-3)
+
+
+@pytest.mark.parametrize("padding,sliding", [
+    ("same", (2, 2)), ("valid", (2, 2)), ("same", (1, 1))])
+def test_cpp_runner_deconv(runner_binary, tmp_path, padding, sliding):
+    """Native transposed conv agrees with jax.lax.conv_transpose."""
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.memory import Array
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.package_export import export_package, load_package
+
+    wf = AcceleratedWorkflow(None, name="d")
+    rng = numpy.random.default_rng(5)
+    x = rng.normal(size=(2, 5, 6, 3)).astype(numpy.float32)
+    units = make_forwards(wf, Array(x), [
+        {"type": "deconv", "n_kernels": 4, "kx": 3, "ky": 3,
+         "sliding": sliding, "padding": padding}])
+    dev = Device(backend="numpy")
+    for u in units:
+        u.initialize(device=dev)
+    path = str(tmp_path / "d.tar.gz")
+    export_package(units, path, (2, 5, 6, 3), name="d")
+    y_ref = load_package(path).run(x, mode="python")
+    numpy.save(tmp_path / "in.npy", x)
+    r = subprocess.run(
+        [runner_binary, path, str(tmp_path / "in.npy"),
+         str(tmp_path / "out.npy")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    y = numpy.load(tmp_path / "out.npy")
+    assert y.shape == y_ref.shape
+    numpy.testing.assert_allclose(y, y_ref, atol=2e-2)
+
+
+def test_cpp_runner_grouped_conv_lrn(runner_binary, tmp_path):
+    """Grouped conv + LRN + pooling against the JAX units directly (the
+    AlexNet building blocks)."""
+    import jax.numpy as jnp
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.package_export import export_package, load_package
+
+    spec = [
+        {"type": "conv_relu", "n_kernels": 8, "kx": 3, "ky": 3,
+         "sliding": (2, 2), "padding": "same", "n_groups": 2},
+        {"type": "norm", "n": 5, "alpha": 1e-4, "beta": 0.75, "k": 2.0},
+        {"type": "max_pooling", "kx": 2, "ky": 2},
+        {"type": "softmax", "output_sample_shape": (5,)},
+    ]
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.memory import Array
+    wf = AcceleratedWorkflow(None, name="g")
+    rng = numpy.random.default_rng(3)
+    x = rng.normal(size=(4, 9, 9, 4)).astype(numpy.float32)
+    inp = Array(x)
+    units = make_forwards(wf, inp, spec)
+    dev = Device(backend="numpy")
+    for u in units:
+        u.initialize(device=dev)
+    path = str(tmp_path / "g.tar.gz")
+    export_package(units, path, (4, 9, 9, 4), name="g")
+    y_ref = load_package(path).run(x, mode="python")
+    numpy.save(tmp_path / "in.npy", x)
+    r = subprocess.run(
+        [runner_binary, path, str(tmp_path / "in.npy"),
+         str(tmp_path / "out.npy")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    y = numpy.load(tmp_path / "out.npy")
+    numpy.testing.assert_allclose(y, y_ref, atol=2e-2)

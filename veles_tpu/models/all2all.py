@@ -53,6 +53,11 @@ class All2All(ForwardBase):
         y = get_activation(self.activation)(y)
         return y.reshape((x.shape[0],) + self.output_sample_shape)
 
+    def export_config(self):
+        return {"output_sample_shape": list(self.output_sample_shape),
+                "activation": self._export_activation(),
+                "include_bias": self.include_bias}
+
 
 class All2AllTanh(All2All):
     ACTIVATION = "tanh"

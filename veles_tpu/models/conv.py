@@ -106,6 +106,12 @@ class Conv(ForwardBase):
             y = y + params["bias"].astype(y.dtype)
         return get_activation(self.activation)(y)
 
+    def export_config(self):
+        return {"n_kernels": self.n_kernels, "kx": self.kx, "ky": self.ky,
+                "sliding": list(self.sliding), "padding": self.padding,
+                "n_groups": self.n_groups, "activation": self._export_activation(),
+                "include_bias": self.include_bias}
+
 
 class ConvTanh(Conv):
     ACTIVATION = "tanh"
@@ -176,3 +182,9 @@ class Deconv(ForwardBase):
         if self.include_bias:
             y = y + params["bias"]
         return get_activation(self.activation)(y.astype(jnp.float32))
+
+    def export_config(self):
+        return {"n_kernels": self.n_kernels, "kx": self.kx, "ky": self.ky,
+                "sliding": list(self.sliding), "padding": self.padding,
+                "activation": self._export_activation(),
+                "include_bias": self.include_bias}

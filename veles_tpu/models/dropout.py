@@ -36,6 +36,9 @@ class DropoutForward(ForwardBase):
         # inference path: identity (inverted dropout)
         return x
 
+    def export_config(self):
+        return {"dropout_ratio": self.dropout_ratio}
+
     def apply_train(self, params, x, key):
         keep = 1.0 - self.dropout_ratio
         mask = jax.random.bernoulli(key, keep, x.shape)

@@ -33,6 +33,10 @@ class LRNormalizerForward(ForwardBase):
     def fill_params(self):
         pass
 
+    def export_config(self):
+        return {"alpha": self.alpha, "beta": self.beta,
+                "n": self.n, "k": self.k}
+
     def output_shape_for(self, input_shape):
         return input_shape
 

@@ -92,6 +92,15 @@ class ForwardBase(AcceleratedUnit):
         return {name: getattr(self, name) for name in self.PARAMS
                 if bool(getattr(self, name))}
 
+    def _export_activation(self):
+        """Activation name for export_config — callables can't ride a
+        JSON manifest."""
+        if callable(self.activation):
+            raise ValueError(
+                "%s: callable activations cannot be exported — register "
+                "a named activation instead" % self)
+        return self.activation
+
     def hyperparams(self):
         """Per-layer overrides, Nones meaning 'inherit'."""
         return {h: getattr(self, h) for h in HYPERPARAMS}

@@ -27,6 +27,10 @@ class PoolingBase(ForwardBase):
     def fill_params(self):
         pass
 
+    def export_config(self):
+        return {"kx": self.kx, "ky": self.ky,
+                "sliding": list(self.sliding)}
+
     def _window(self):
         return (1, self.ky, self.kx, 1)
 

@@ -213,6 +213,29 @@ class Workflow(Unit):
 
     # -- introspection ------------------------------------------------------
 
+    def package_export(self, path, batch=None):
+        """Export the forward chain as an inference package
+        (ref: veles/workflow.py:868-975; consumed by
+        veles_tpu.package_export.load_package and the C++ runner in
+        runtime/).  Requires ``self.forwards`` + ``self.loader`` (the
+        StandardWorkflow shape)."""
+        from veles_tpu.package_export import export_package
+        forwards = getattr(self, "forwards", None)
+        if not forwards:
+            raise ValueError("%s has no forward chain to export" % self)
+        loader = getattr(self, "loader", None)
+        if loader is None or not bool(loader.minibatch_data):
+            raise ValueError(
+                "%s has no initialized loader — package_export needs its "
+                "minibatch shape/dtype" % self)
+        in_shape = list(loader.minibatch_data.shape)
+        if batch is not None:
+            in_shape[0] = int(batch)
+        return export_package(
+            forwards, path, in_shape,
+            input_dtype=loader.minibatch_data.mem.dtype,
+            name=type(self).__name__, checksum=self.checksum())
+
     def checksum(self):
         """Stable digest of the workflow's defining source — coordinator /
         worker handshakes compare it (ref: workflow.py:852)."""
