@@ -6,6 +6,7 @@
 //
 // Loads the package, runs the forward pass on the input batch, writes
 // the result as npy, and prints one JSON status line with timing.
+#include <algorithm>
 #include <chrono>
 #include <cstdio>
 #include <cstring>
@@ -24,9 +25,9 @@ int main(int argc, char** argv) {
     return 2;
   }
   int repeat = 1;
-  for (int i = 4; i + 1 < argc + 1; ++i)
-    if (i + 1 < argc && std::strcmp(argv[i], "--repeat") == 0)
-      repeat = std::atoi(argv[i + 1]);
+  for (int i = 4; i + 1 < argc; ++i)
+    if (std::strcmp(argv[i], "--repeat") == 0)
+      repeat = std::max(1, std::atoi(argv[i + 1]));
   try {
     auto wf = veles_rt::PackagedWorkflow::Load(argv[1]);
     veles_rt::Tensor input = veles_rt::npy::LoadFile(argv[2]);

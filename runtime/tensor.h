@@ -24,6 +24,12 @@ struct Tensor {
     for (size_t d : shape) n *= d;
     return n;
   }
+  // adopt a new shape, reusing storage (resize does not re-zero
+  // existing elements — kernels fully overwrite their outputs)
+  void reshape(std::vector<size_t> s) {
+    shape = std::move(s);
+    data.resize(count());
+  }
   size_t dim(size_t i) const { return shape.at(i); }
   float* ptr() { return data.data(); }
   const float* ptr() const { return data.data(); }

@@ -71,7 +71,7 @@ void Dense::Execute(const Tensor& in, Tensor* out, ThreadPool* pool) const {
   size_t m = weights_.dim(1);
   if (weights_.dim(0) != k)
     throw std::runtime_error("Dense weight shape mismatch");
-  *out = Tensor(OutShape(in.shape));
+  out->reshape(OutShape(in.shape));
   const float* w = weights_.ptr();
   const float* b = include_bias_ ? bias_.ptr() : nullptr;
   pool->ParallelFor(batch, [&](size_t r0, size_t r1) {
@@ -184,9 +184,12 @@ void Conv2D::Execute(const Tensor& in, Tensor* out, ThreadPool* pool) const {
   (void)pr;
   auto oshape = OutShape(in.shape);
   size_t out_h = oshape[1], out_w = oshape[2], out_c = oshape[3];
-  *out = Tensor(oshape);
-  size_t cin_g = in_c / groups_;       // input channels per group
-  size_t cout_g = out_c / groups_;     // kernels per group
+  size_t cin_g = in_c / groups_;   // input channels per group
+  size_t cout_g = out_c / groups_;  // kernels per group
+  if (weights_.count() !=
+      static_cast<size_t>(ky_) * kx_ * cin_g * out_c)
+    throw std::runtime_error("Conv2D weight shape mismatch");
+  out->reshape(oshape);
   const float* w = weights_.ptr();     // [ky, kx, cin_g, out_c]
   const float* b = include_bias_ ? bias_.ptr() : nullptr;
 
@@ -289,9 +292,12 @@ void Deconv2D::Execute(const Tensor& in, Tensor* out,
          in_c = in.dim(3);
   auto oshape = OutShape(in.shape);
   size_t out_h = oshape[1], out_w = oshape[2], out_c = oshape[3];
-  if (weights_.dim(3) != in_c || weights_.dim(2) != out_c)
+  if (weights_.shape.size() != 4 || weights_.dim(3) != in_c ||
+      weights_.dim(2) != out_c ||
+      weights_.dim(0) != static_cast<size_t>(ky_) ||
+      weights_.dim(1) != static_cast<size_t>(kx_))
     throw std::runtime_error("Deconv weight shape mismatch");
-  *out = Tensor(oshape);
+  out->reshape(oshape);
   size_t pa_y, pa_x;
   Padding(&pa_y, &pa_x);
   const float* w = weights_.ptr();
@@ -362,7 +368,7 @@ void Pooling::Execute(const Tensor& in, Tensor* out, ThreadPool* pool) const {
          c = in.dim(3);
   auto oshape = OutShape(in.shape);
   size_t out_h = oshape[1], out_w = oshape[2];
-  *out = Tensor(oshape);
+  out->reshape(oshape);
   float inv = 1.0f / (kx_ * ky_);
   pool->ParallelFor(batch, [&](size_t n0, size_t n1) {
     for (size_t n = n0; n < n1; ++n) {
@@ -404,7 +410,7 @@ std::vector<size_t> LRN::OutShape(const std::vector<size_t>& in) const {
 }
 
 void LRN::Execute(const Tensor& in, Tensor* out, ThreadPool* pool) const {
-  *out = Tensor(in.shape);
+  out->reshape(in.shape);
   size_t c = in.shape.back();
   size_t rows = in.count() / c;
   int half = n_ / 2, hi = n_ - 1 - half;

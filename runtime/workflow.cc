@@ -45,13 +45,14 @@ Tensor PackagedWorkflow::Run(const Tensor& input, ThreadPool* pool) {
   if (!ok)
     throw std::runtime_error(
         "input shape incompatible with packaged input spec");
-  // ping-pong execution: each unit reads one arena and writes the other
-  Tensor a = input, b;
-  Tensor* src = &a;
-  Tensor* dst = &b;
+  // ping-pong execution: each unit reads one arena and writes the
+  // other; the first unit reads the caller's input directly
+  const Tensor* src = &input;
+  Tensor* dst = &buf_a_;
   for (const auto& u : units_) {
     u->Execute(*src, dst, pool);
-    std::swap(src, dst);
+    src = dst;
+    dst = (dst == &buf_a_) ? &buf_b_ : &buf_a_;
   }
   return *src;
 }

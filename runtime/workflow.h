@@ -33,6 +33,9 @@ class PackagedWorkflow {
   std::string name_;
   std::vector<size_t> input_shape_;
   std::vector<std::unique_ptr<Unit>> units_;
+  // the two ping-pong arenas, reused across Run calls (reshape keeps
+  // storage, so --repeat loops do no per-layer allocation)
+  Tensor buf_a_, buf_b_;
 };
 
 }  // namespace veles_rt

@@ -1,0 +1,168 @@
+"""Genetics (GA hyper-parameter search) + ensemble (L9).
+
+Fast tests drive the GA core with injected evaluators; the CLI
+subprocess contract is covered by one small optimize run and one
+2-instance ensemble round-trip (ref shapes:
+veles/genetics/optimization_workflow.py, ensemble/base_workflow.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy
+import pytest
+
+from veles_tpu.config import Config
+from veles_tpu.genetics import (
+    Choice, GeneticsOptimizer, Population, Range, collect_tuneables,
+    fitness_from_results, fix_config)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MNIST = os.path.join(REPO, "veles_tpu", "samples", "mnist.py")
+MNIST_CFG = os.path.join(REPO, "veles_tpu", "samples", "mnist_config.py")
+
+
+def _env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    return env
+
+
+# -- core ---------------------------------------------------------------------
+
+def test_range_clip_and_int():
+    r = Range(10, 2, 20)
+    assert r.clip(25) == 20 and r.clip(-1) == 2
+    assert isinstance(r.clip(7.6), int)
+    f = Range(0.1, 0.0, 1.0)
+    assert isinstance(f.clip(0.5), float)
+    rng = numpy.random.default_rng(0)
+    for _ in range(20):
+        assert 2 <= r.random(rng) <= 20
+        assert 0.0 <= f.mutate(0.5, rng, 0.2) <= 1.0
+
+
+def test_choice():
+    c = Choice("sgd", ["sgd", "adam", "adagrad"])
+    rng = numpy.random.default_rng(0)
+    assert c.random(rng) in c.choices
+    assert c.mutate("adam", rng, 0.0) in c.choices
+
+
+def test_collect_and_fix_config():
+    cfg = Config("test")
+    cfg.model.lr = Range(0.1, 0.01, 1.0)
+    cfg.model.depth = Range(3, 1, 8)
+    cfg.model.name = "mlp"
+    found = collect_tuneables(cfg)
+    assert [p for p, _ in found] == ["root.model.depth", "root.model.lr"]
+    fix_config(cfg)
+    assert cfg.model.lr == 0.1 and cfg.model.depth == 3
+    assert cfg.model.name == "mlp"
+
+
+def test_population_optimizes_quadratic():
+    cfg = Config("t")
+    cfg.x = Range(5.0, -10.0, 10.0)
+    tuneables = collect_tuneables(cfg)
+    pop = Population(tuneables, size=10, seed=3)
+    for _ in range(12):
+        for c in pop.individuals:
+            if c.fitness is None:
+                c.fitness = -(c.genes[0] - 2.0) ** 2
+        pop.evolve()
+    assert abs(pop.best.genes[0] - 2.0) < 0.5, pop.best.genes
+
+
+def test_fitness_from_results_priority():
+    assert fitness_from_results({"EvaluationFitness": 3.5}) == 3.5
+    assert fitness_from_results(
+        {"min_validation_n_err": 42, "validation_loss": 1.0}) == -42.0
+    with pytest.raises(KeyError):
+        fitness_from_results({"unrelated": 1})
+
+
+def test_optimizer_with_injected_evaluator():
+    cfg = Config("t")
+    cfg.a = Range(8.0, -10.0, 10.0)
+    cfg.b = Range(-8.0, -10.0, 10.0)
+
+    def evaluate(overrides, seed):
+        vals = {s.split(" = ")[0]: float(s.split(" = ")[1])
+                for s in overrides}
+        return -(vals["root.a"] - 1) ** 2 - (vals["root.b"] + 2) ** 2
+
+    opt = GeneticsOptimizer(cfg, evaluate, size=12, generations=10,
+                            seed=7)
+    outcome = opt.run()
+    assert outcome["best_fitness"] > -1.0, outcome
+    # monotone best-so-far history within noise-free evaluation
+    assert max(outcome["history"]) == outcome["history"][-1] \
+        or outcome["best_fitness"] >= max(outcome["history"]) - 1e-9
+
+
+def test_failed_individuals_get_fallback_fitness():
+    cfg = Config("t")
+    cfg.x = Range(0.0, -1.0, 1.0)
+    calls = []
+
+    def evaluate(overrides, seed):
+        calls.append(overrides)
+        return None if len(calls) % 2 == 0 else 1.0
+
+    opt = GeneticsOptimizer(cfg, evaluate, size=4, generations=2)
+    outcome = opt.run()
+    assert outcome["best_fitness"] == 1.0
+
+
+# -- CLI subprocess contracts --------------------------------------------------
+
+TINY = ("root.mnist_tpu.update({'max_epochs':1,'synthetic_train':512,"
+        "'synthetic_valid':128,'snapshot_time_interval':0.0,"
+        "'minibatch_size':128})")
+
+
+def test_cli_optimize_smoke(tmp_path):
+    out = tmp_path / "opt.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "veles_tpu", MNIST, MNIST_CFG,
+         "--optimize", "2:1",
+         "-c", "root.mnist_tpu.learning_rate = Range(0.02, 0.001, 0.5)",
+         "-c", TINY, "--result-file", str(out)],
+        capture_output=True, text=True, env=_env(), cwd=REPO)
+    assert r.returncode == 0, r.stderr[-800:]
+    outcome = json.loads(out.read_text())
+    assert "root.mnist_tpu.learning_rate" in outcome["best_genes"]
+    assert outcome["best_fitness"] is not None
+
+
+def test_cli_ensemble_train_and_test(tmp_path):
+    out = tmp_path / "ens.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "veles_tpu", MNIST, MNIST_CFG,
+         "--ensemble-train", "2", "--train-ratio", "0.75",
+         "-c", TINY, "--result-file", str(out)],
+        capture_output=True, text=True, env=_env(), cwd=REPO)
+    assert r.returncode == 0, r.stderr[-800:]
+    summary = json.loads(out.read_text())
+    assert summary["succeeded"] == 2
+    snaps = [i["snapshot"] for i in summary["instances"]]
+    assert all(s and os.path.isfile(s) for s in snaps)
+    assert len(set(snaps)) == 2  # per-instance suffixes kept them apart
+    # seeds differ → different trajectories
+    errs = [i["results"]["validation_error_pct"]
+            for i in summary["instances"]]
+    assert errs[0] != errs[1]
+
+    test_out = tmp_path / "test.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "veles_tpu", "--ensemble-test", str(out),
+         "--result-file", str(test_out)],
+        capture_output=True, text=True, env=_env(), cwd=REPO)
+    assert r.returncode == 0, r.stderr[-800:]
+    tested = json.loads(test_out.read_text())
+    assert len(tested["tests"]) == 2
+    assert all(t.get("results") for t in tested["tests"])

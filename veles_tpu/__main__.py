@@ -75,6 +75,60 @@ class Main:
 
     # -- run ------------------------------------------------------------------
 
+    # -- meta-optimization modes (L9; ref: __main__.py:716-734 dispatch) ------
+
+    def _child_argv(self):
+        """Flags forwarded to evaluation subprocesses."""
+        argv = []
+        if self.args.backend:
+            argv += ["-a", self.args.backend]
+        for _ in range(self.args.verbose):
+            argv += ["-v"]
+        return argv
+
+    def _write_json(self, data):
+        if self.args.result_file:
+            with open(self.args.result_file, "w") as f:
+                json.dump(data, f, indent=2, default=str)
+
+    def _run_optimize(self):
+        from veles_tpu.genetics import (
+            GeneticsOptimizer, SubprocessEvaluator)
+        size, _, gens = self.args.optimize.partition(":")
+        evaluator = SubprocessEvaluator(
+            self.args.workflow, self.args.config,
+            base_overrides=self.args.config_override,
+            extra_argv=self._child_argv())
+        opt = GeneticsOptimizer(
+            root, evaluator, size=int(size),
+            generations=int(gens) if gens else 4)
+        outcome = opt.run()
+        logging.getLogger("Main").info(
+            "optimization done: best fitness %s with %s",
+            outcome["best_fitness"], outcome["best_genes"])
+        self._write_json(outcome)
+        return 0
+
+    def _run_ensemble_train(self):
+        from veles_tpu.ensemble import EnsembleTrainer
+        trainer = EnsembleTrainer(
+            self.args.workflow, self.args.config,
+            size=self.args.ensemble_train,
+            train_ratio=self.args.train_ratio,
+            base_overrides=self.args.config_override,
+            extra_argv=self._child_argv())
+        summary = trainer.run(output_path=self.args.result_file)
+        return 0 if summary["succeeded"] == summary["size"] else 1
+
+    def _run_ensemble_test(self):
+        from veles_tpu.ensemble import EnsembleTester
+        tester = EnsembleTester(self.args.ensemble_test,
+                                extra_argv=self._child_argv())
+        out = tester.run(output_path=self.args.result_file)
+        ok = all("error" not in t and t.get("results") is not None
+                 for t in out["tests"])
+        return 0 if ok else 1
+
     def run(self):
         parser = build_parser()
         self.args = parser.parse_args(self.argv)
@@ -91,9 +145,20 @@ class Main:
         if self.args.dump_config:
             root.print_()
             return 0
+        if self.args.ensemble_test:
+            return self._run_ensemble_test()
         if not self.args.workflow:
             parser.print_help()
             return 1
+        if self.args.optimize:
+            return self._run_optimize()
+        if self.args.ensemble_train:
+            return self._run_ensemble_train()
+        # replace any un-tuned Range() markers with their defaults so a
+        # config written for --optimize also runs standalone
+        # (ref: genetics/config.py:164 fix_config)
+        from veles_tpu.genetics import fix_config
+        fix_config(root)
         self._seed_random()
         self.launcher = Launcher(
             backend=self.args.backend, device_index=self.args.device,

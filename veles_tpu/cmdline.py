@@ -51,6 +51,19 @@ def build_parser():
                    help="run as coordinator, listen on host:port")
     p.add_argument("-m", "--master-address", default=None, metavar="ADDR",
                    help="run as worker of the given coordinator")
+    p.add_argument("--optimize", default=None, metavar="SIZE[:GENS]",
+                   help="genetic hyper-parameter search over the "
+                        "config's Range() tuneables (ref: veles "
+                        "--optimize)")
+    p.add_argument("--ensemble-train", type=int, default=None,
+                   metavar="N", help="train N model instances and "
+                   "aggregate results (ref: veles ensemble mode)")
+    p.add_argument("--ensemble-test", default=None, metavar="SUMMARY",
+                   help="re-run the snapshots of an ensemble summary "
+                        "JSON and aggregate metrics")
+    p.add_argument("--train-ratio", type=float, default=1.0,
+                   help="ensemble: fraction of the train span each "
+                        "instance sees")
     p.add_argument("-v", "--verbose", action="count", default=0,
                    help="-v debug, -vv everything")
     p.add_argument("--timings", action="store_true",

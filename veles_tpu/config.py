@@ -134,10 +134,21 @@ root.common.update({
 root.common.protect("dirs")
 
 
+def _exec_globals():
+    g = {"root": root, "Config": Config}
+    try:  # genetics tuneables are first-class config values
+        from veles_tpu.genetics import Choice, Range
+        g["Range"] = Range
+        g["Choice"] = Choice
+    except ImportError:  # pragma: no cover
+        pass
+    return g
+
+
 def apply_config_file(path, extra_globals=None):
     """Execute a per-run config file: plain Python mutating ``root``
     (ref: veles/__main__.py:436-438)."""
-    g = {"root": root, "Config": Config}
+    g = _exec_globals()
     if extra_globals:
         g.update(extra_globals)
     runpy.run_path(path, init_globals=g)
@@ -146,7 +157,7 @@ def apply_config_file(path, extra_globals=None):
 def apply_override(snippet):
     """Apply a ``-c "root.x.y = z"`` CLI override
     (ref: veles/__main__.py:474-481)."""
-    exec(snippet, {"root": root, "Config": Config})
+    exec(snippet, _exec_globals())
 
 
 def load_site_configs():

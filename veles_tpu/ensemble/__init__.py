@@ -1,0 +1,134 @@
+"""ensemble — train/test fleets of model instances (L9).
+
+Rebuild of veles/ensemble/: train mode launches N CLI subprocesses of
+the same workflow with distinct seeds (and optionally sub-sampled train
+sets via ``train_ratio``), aggregating each instance's ``--result-file``
+metrics + snapshot path into one JSON (ref:
+ensemble/base_workflow.py:59-152, model_workflow.py:137); test mode
+re-runs each saved snapshot and aggregates its metrics (ref:
+ensemble/test_workflow.py:102).
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+
+log = logging.getLogger("ensemble")
+
+
+def _run_cli(argv, timeout=None):
+    with tempfile.NamedTemporaryFile(
+            mode="r", suffix=".json", delete=False) as f:
+        result_file = f.name
+    argv = list(argv) + ["--result-file", result_file]
+    try:
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=timeout, cwd=os.getcwd())
+        if proc.returncode != 0:
+            log.warning("instance failed (rc=%d): %s", proc.returncode,
+                        proc.stderr[-500:])
+            return None
+        with open(result_file) as f:
+            return json.load(f)
+    except (subprocess.TimeoutExpired, OSError, ValueError) as e:
+        log.warning("instance error: %s", e)
+        return None
+    finally:
+        try:
+            os.unlink(result_file)
+        except OSError:
+            pass
+
+
+class EnsembleTrainer:
+    """Train ``size`` instances; aggregate metrics + snapshot refs
+    (ref: EnsembleModelManagerBase, ensemble/base_workflow.py:59)."""
+
+    def __init__(self, workflow_file, config_file=None, size=4,
+                 train_ratio=1.0, base_overrides=(), extra_argv=(),
+                 timeout=None):
+        self.workflow_file = workflow_file
+        self.config_file = config_file
+        self.size = size
+        self.train_ratio = train_ratio
+        self.base_overrides = list(base_overrides)
+        self.extra_argv = list(extra_argv)
+        self.timeout = timeout
+
+    def _argv(self, seed, index):
+        argv = [sys.executable, "-m", "veles_tpu", self.workflow_file]
+        if self.config_file:
+            argv.append(self.config_file)
+        for ov in self.base_overrides:
+            argv += ["-c", ov]
+        # distinct snapshot filenames per instance (the reference
+        # suffixed snapshots per ensemble member the same way)
+        argv += ["-c", "root.common.snapshot_suffix = 'ens%d'" % index]
+        if self.train_ratio < 1.0:
+            argv += ["-c", "root.common.ensemble_train_ratio = %r"
+                     % self.train_ratio]
+        argv += ["--seed", str(seed)] + self.extra_argv
+        return argv
+
+    def run(self, output_path=None):
+        instances = []
+        for i in range(self.size):
+            log.info("training ensemble instance %d/%d", i + 1, self.size)
+            results = _run_cli(self._argv(seed=4242 + i, index=i),
+                               timeout=self.timeout)
+            instances.append({
+                "index": i,
+                "seed": 4242 + i,
+                "train_ratio": self.train_ratio,
+                "results": results,
+                "snapshot": (results or {}).get("Snapshot"),
+            })
+        summary = {"size": self.size, "instances": instances,
+                   "workflow_file": self.workflow_file,
+                   "config_file": self.config_file,
+                   "base_overrides": self.base_overrides}
+        summary["succeeded"] = sum(
+            1 for inst in instances if inst["results"] is not None)
+        if output_path:
+            with open(output_path, "w") as f:
+                json.dump(summary, f, indent=2, default=str)
+            log.info("ensemble summary -> %s", output_path)
+        return summary
+
+
+class EnsembleTester:
+    """Re-run every saved instance snapshot and aggregate its metrics
+    (ref: EnsembleTestWorkflow, ensemble/test_workflow.py:102)."""
+
+    def __init__(self, summary_path, extra_argv=(), timeout=None):
+        self.summary_path = summary_path
+        self.extra_argv = list(extra_argv)
+        self.timeout = timeout
+
+    def run(self, output_path=None):
+        with open(self.summary_path) as f:
+            summary = json.load(f)
+        tests = []
+        for inst in summary.get("instances", []):
+            snap = inst.get("snapshot")
+            if not snap or not os.path.isfile(snap):
+                tests.append({"index": inst.get("index"),
+                              "error": "snapshot missing"})
+                continue
+            argv = [sys.executable, "-m", "veles_tpu",
+                    summary["workflow_file"]]
+            if summary.get("config_file"):
+                argv.append(summary["config_file"])
+            for ov in summary.get("base_overrides", []):
+                argv += ["-c", ov]
+            argv += ["--snapshot", snap] + self.extra_argv
+            results = _run_cli(argv, timeout=self.timeout)
+            tests.append({"index": inst.get("index"), "results": results})
+        out = {"summary": self.summary_path, "tests": tests}
+        if output_path:
+            with open(output_path, "w") as f:
+                json.dump(out, f, indent=2, default=str)
+        return out

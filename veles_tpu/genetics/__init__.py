@@ -1,0 +1,12 @@
+"""genetics — GA hyper-parameter optimization (L9).
+
+Rebuild of veles/genetics/: ``Range`` markers in the config tree are
+the search space; individuals are evaluated by re-running the workflow
+CLI with ``-c`` overrides and reading ``--result-file`` fitness.
+"""
+
+from veles_tpu.genetics.core import (  # noqa: F401
+    Choice, Chromosome, Population, Range, Tuneable, collect_tuneables,
+    fix_config)
+from veles_tpu.genetics.optimizer import (  # noqa: F401
+    GeneticsOptimizer, SubprocessEvaluator, fitness_from_results)

@@ -1,0 +1,174 @@
+"""Genetic-algorithm core — tuneables, chromosomes, population.
+
+Rebuild of veles/genetics/ (config.py:45-128 Range/Tuneable markers in
+the config tree; core.py:133,371 Chromosome/Population).  The GA itself
+is pure host-side Python; fitness evaluation happens by running the
+model workflow (one CLI subprocess per individual — see optimizer.py),
+exactly the reference's evaluation-by-subprocess contract
+(genetics/optimization_workflow.py:70,298).
+"""
+
+import numpy
+
+from veles_tpu.config import Config
+
+
+class Tuneable:
+    """Base marker placed in the config tree (ref: genetics/config.py:45)."""
+
+    def __init__(self, default):
+        self.default = default
+
+    def random(self, rng):
+        raise NotImplementedError()
+
+    def mutate(self, value, rng, scale):
+        raise NotImplementedError()
+
+    def clip(self, value):
+        return value
+
+
+class Range(Tuneable):
+    """Numeric tuneable in [min_value, max_value]
+    (ref: genetics/config.py Range)."""
+
+    def __init__(self, default, min_value, max_value):
+        super(Range, self).__init__(default)
+        self.min_value = min_value
+        self.max_value = max_value
+        self._integer = all(
+            isinstance(v, (int, numpy.integer)) and not isinstance(v, bool)
+            for v in (default, min_value, max_value))
+
+    def clip(self, value):
+        value = min(max(value, self.min_value), self.max_value)
+        return int(round(value)) if self._integer else float(value)
+
+    def random(self, rng):
+        return self.clip(
+            rng.uniform(self.min_value, self.max_value))
+
+    def mutate(self, value, rng, scale):
+        span = (self.max_value - self.min_value) * scale
+        return self.clip(value + rng.normal(0.0, max(span, 1e-12)))
+
+    def __repr__(self):
+        return "Range(%r, %r, %r)" % (self.default, self.min_value,
+                                      self.max_value)
+
+
+class Choice(Tuneable):
+    """Categorical tuneable (capability extension of the same marker
+    family)."""
+
+    def __init__(self, default, choices):
+        super(Choice, self).__init__(default)
+        self.choices = list(choices)
+
+    def random(self, rng):
+        return self.choices[int(rng.integers(len(self.choices)))]
+
+    def mutate(self, value, rng, scale):
+        if rng.random() < max(scale, 0.1):
+            return self.random(rng)
+        return value
+
+
+def collect_tuneables(cfg, path="root"):
+    """Walk the config tree for Tuneable markers → [(dotted_path, t)]
+    (ref: genetics/config.py fix_config walk)."""
+    found = []
+    for k, v in vars(cfg).items():
+        if k.startswith("_") and k.endswith("_"):
+            continue
+        p = "%s.%s" % (path, k)
+        if isinstance(v, Config):
+            found.extend(collect_tuneables(v, p))
+        elif isinstance(v, Tuneable):
+            found.append((p, v))
+    return sorted(found)
+
+
+def fix_config(cfg):
+    """Replace remaining Tuneable markers with their defaults so a
+    workflow can run un-tuned (ref: genetics/config.py:164)."""
+    for k, v in list(vars(cfg).items()):
+        if k.startswith("_") and k.endswith("_"):
+            continue
+        if isinstance(v, Config):
+            fix_config(v)
+        elif isinstance(v, Tuneable):
+            setattr(cfg, k, v.default)
+
+
+class Chromosome:
+    """One config instantiation (ref: genetics/core.py:133)."""
+
+    __slots__ = ("genes", "fitness")
+
+    def __init__(self, genes):
+        self.genes = list(genes)
+        self.fitness = None
+
+    def overrides(self, tuneables):
+        """CLI ``-c`` snippets applying this individual's genes."""
+        return ["%s = %r" % (path, g)
+                for (path, _), g in zip(tuneables, self.genes)]
+
+
+class Population:
+    """GA population: tournament selection, blend crossover, gaussian
+    mutation, elitism (ref: genetics/core.py:371 — the reference's
+    roulette+two-point machinery, re-specialised for the small numeric
+    gene vectors hyper-parameter search actually uses)."""
+
+    def __init__(self, tuneables, size=8, seed=42, mutation_scale=0.15,
+                 crossover_rate=0.9, elite=1):
+        if not tuneables:
+            raise ValueError("no Tuneable markers found in the config")
+        self.tuneables = tuneables
+        self.size = size
+        self.rng = numpy.random.default_rng(seed)
+        self.mutation_scale = mutation_scale
+        self.crossover_rate = crossover_rate
+        self.elite = elite
+        first = Chromosome([t.default for _, t in tuneables])
+        self.individuals = [first] + [
+            Chromosome([t.random(self.rng) for _, t in tuneables])
+            for _ in range(size - 1)]
+        self.generation = 0
+        self.best = None
+
+    def _tournament(self, k=2):
+        picks = self.rng.choice(len(self.individuals), size=k,
+                                replace=False)
+        return max((self.individuals[i] for i in picks),
+                   key=lambda c: c.fitness)
+
+    def evolve(self):
+        """One generation step; every individual must have a fitness."""
+        assert all(c.fitness is not None for c in self.individuals)
+        ranked = sorted(self.individuals, key=lambda c: c.fitness,
+                        reverse=True)
+        if self.best is None or ranked[0].fitness > self.best.fitness:
+            self.best = ranked[0]
+        nxt = [Chromosome(list(c.genes)) for c in ranked[:self.elite]]
+        for c in nxt:
+            c.fitness = None
+        while len(nxt) < self.size:
+            a, b = self._tournament(), self._tournament()
+            genes = []
+            for (path, t), ga, gb in zip(self.tuneables, a.genes, b.genes):
+                if self.rng.random() < self.crossover_rate \
+                        and isinstance(t, Range):
+                    w = self.rng.random()
+                    g = t.clip(w * ga + (1 - w) * gb)  # blend crossover
+                else:
+                    g = ga if self.rng.random() < 0.5 else gb
+                if self.rng.random() < 0.3:
+                    g = t.mutate(g, self.rng, self.mutation_scale)
+                genes.append(g)
+            nxt.append(Chromosome(genes))
+        self.individuals = nxt
+        self.generation += 1

@@ -1,0 +1,134 @@
+"""GeneticsOptimizer — hyper-parameter search over CLI subprocesses.
+
+Rebuild of veles/genetics/optimization_workflow.py:70,298: each
+individual is evaluated by re-running ``python -m veles_tpu <workflow>
+<config>`` with ``-c`` overrides for its genes and ``--result-file``
+for the fitness, exactly the reference's subprocess contract.  The
+evaluator can also be swapped out (tests inject a python callable).
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+
+from veles_tpu.genetics.core import Population, collect_tuneables
+
+log = logging.getLogger("genetics")
+
+#: result-file keys tried (in order) when deriving fitness; all are
+#: minimized, so fitness = -value
+FITNESS_KEYS = ("EvaluationFitness", "min_validation_n_err",
+                "validation_error_pct", "validation_loss", "RMSE")
+
+
+def fitness_from_results(results):
+    """Fitness (maximized) from a --result-file dict: an explicit
+    ``EvaluationFitness`` wins; error-like metrics are negated
+    (ref: genetics read of --result-file JSON)."""
+    if "EvaluationFitness" in results:
+        return float(results["EvaluationFitness"])
+    for key in FITNESS_KEYS[1:]:
+        if key in results:
+            return -float(results[key])
+    raise KeyError(
+        "no fitness key in result file (have: %s; expected one of %s)"
+        % (sorted(results), list(FITNESS_KEYS)))
+
+
+class SubprocessEvaluator:
+    """Runs one individual through the CLI (ref subprocess exec:
+    ensemble/base_workflow.py:135-152 — genetics uses the same shape)."""
+
+    def __init__(self, workflow_file, config_file=None, base_overrides=(),
+                 extra_argv=(), timeout=None):
+        self.workflow_file = workflow_file
+        self.config_file = config_file
+        self.base_overrides = list(base_overrides)
+        self.extra_argv = list(extra_argv)
+        self.timeout = timeout
+
+    def __call__(self, overrides, seed):
+        with tempfile.NamedTemporaryFile(
+                mode="r", suffix=".json", delete=False) as f:
+            result_file = f.name
+        argv = [sys.executable, "-m", "veles_tpu", self.workflow_file]
+        if self.config_file:
+            argv.append(self.config_file)
+        for ov in self.base_overrides + list(overrides):
+            argv += ["-c", ov]
+        argv += ["--result-file", result_file, "--seed", str(seed)]
+        argv += self.extra_argv
+        try:
+            proc = subprocess.run(
+                argv, capture_output=True, text=True, timeout=self.timeout,
+                cwd=os.getcwd())
+            if proc.returncode != 0:
+                log.warning("individual failed (rc=%d): %s",
+                            proc.returncode, proc.stderr[-500:])
+                return None
+            with open(result_file) as f:
+                return fitness_from_results(json.load(f))
+        except (subprocess.TimeoutExpired, OSError, ValueError,
+                KeyError) as e:
+            log.warning("individual evaluation error: %s", e)
+            return None
+        finally:
+            try:
+                os.unlink(result_file)
+            except OSError:
+                pass
+
+
+class GeneticsOptimizer:
+    """The population loop (ref: genetics/optimization_workflow.py:298).
+
+    ``evaluate(overrides, seed) -> fitness|None`` is pluggable; failed
+    individuals get the worst fitness seen so far (the reference dropped
+    them from the next generation the same way).
+    """
+
+    def __init__(self, config_root, evaluate, size=8, generations=4,
+                 seed=42):
+        self.tuneables = collect_tuneables(config_root)
+        self.population = Population(self.tuneables, size=size, seed=seed)
+        self.evaluate = evaluate
+        self.generations = generations
+        self.history = []
+
+    def run(self):
+        for gen in range(self.generations):
+            worst = None
+            for i, indiv in enumerate(self.population.individuals):
+                if indiv.fitness is not None:
+                    continue  # already evaluated (injected evaluators)
+                # note: elites are re-evaluated each generation — fitness
+                # from a short training run is noisy, and a lucky seed
+                # must not colonize the population forever
+                fit = self.evaluate(indiv.overrides(self.tuneables),
+                                    seed=1000 + gen * 100 + i)
+                indiv.fitness = fit
+                if fit is not None:
+                    worst = fit if worst is None else min(worst, fit)
+                log.info("gen %d individual %d: fitness %s  genes %s",
+                         gen, i, fit, indiv.genes)
+            fallback = (worst if worst is not None else 0.0) - 1.0
+            for indiv in self.population.individuals:
+                if indiv.fitness is None:
+                    indiv.fitness = fallback
+            self.history.append(max(
+                c.fitness for c in self.population.individuals))
+            self.population.evolve()
+            log.info("gen %d done: best fitness %s genes %s", gen,
+                     self.population.best.fitness,
+                     self.population.best.genes)
+        best = self.population.best
+        return {
+            "best_fitness": best.fitness,
+            "best_genes": {path: g for (path, _), g in
+                           zip(self.tuneables, best.genes)},
+            "history": self.history,
+            "generations": self.generations,
+        }

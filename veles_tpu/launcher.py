@@ -112,6 +112,13 @@ class Launcher(Logger):
         metrics = self.workflow.gather_results()
         metrics["elapsed_sec"] = time.time() - (self.start_time
                                                 or time.time())
+        # the ensemble aggregator needs to find each instance's snapshot
+        # (ref: ensemble/base_workflow.py reads them back for test mode)
+        from veles_tpu.snapshotter import SnapshotterBase
+        for u in self.workflow.units:
+            if isinstance(u, SnapshotterBase) \
+                    and getattr(u, "destination", None):
+                metrics["Snapshot"] = u.destination
         with open(path, "w") as f:
             json.dump(metrics, f, indent=2, default=str)
         self.info("results -> %s", path)

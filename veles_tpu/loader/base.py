@@ -138,6 +138,12 @@ class Loader(Unit, ILoader, IDistributable, IResultProvider):
 
     def initialize(self, **kwargs):
         super(Loader, self).initialize(**kwargs)
+        from veles_tpu.config import root
+        tr = root.common.get("ensemble_train_ratio")
+        if tr is not None:
+            # ensemble members train on sub-sampled train spans
+            # (ref: ensemble/base_workflow.py train_ratio contract)
+            self.train_ratio = float(tr)
         self.load_data()
         if self.total_samples == 0:
             raise ValueError("%s: load_data() produced no samples" % self)

@@ -64,6 +64,10 @@ class SnapshotterBase(Unit):
         super(SnapshotterBase, self).initialize(**kwargs)
         if self.directory is None:
             self.directory = root.common.dirs.get("snapshots", "snapshots")
+        if not self.suffix:
+            # ensemble/genetics instances disambiguate their snapshot
+            # files through this config key
+            self.suffix = root.common.get("snapshot_suffix", "")
         os.makedirs(self.directory, exist_ok=True)
         self._last_time = time.time()
 
