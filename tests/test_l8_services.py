@@ -1,0 +1,225 @@
+"""L8 service tier: plotting units, ZMQ graphics fan-out, web status
+(ref surfaces: veles/plotting_units.py:52-822, graphics_server.py:73,
+web_status.py:113, launcher.py:852-885)."""
+
+import gzip
+import json
+import pickle
+import socket
+import time
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.config import root
+from veles_tpu.memory import Array
+
+
+class Obj:
+    pass
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- plotting units -----------------------------------------------------------
+
+def test_accumulating_plotter():
+    from veles_tpu.plotting_units import AccumulatingPlotter
+    o = Obj()
+    o.err = 5.0
+    p = AccumulatingPlotter(None, obj=o, attr="err", label="err", collect=True)
+    p.run()
+    o.err = 3.0
+    p.run()
+    assert p.last_payload["kind"] == "curve"
+    assert p.last_payload["series"]["err"] == [5.0, 3.0]
+
+
+def test_accumulating_plotter_skips_none():
+    from veles_tpu.plotting_units import AccumulatingPlotter
+    o = Obj()
+    o.err = None
+    p = AccumulatingPlotter(None, obj=o, attr="err", collect=True)
+    p.run()
+    assert p.last_payload is None and p.series == []
+
+
+def test_matrix_and_histogram_and_table():
+    from veles_tpu.plotting_units import (
+        Histogram, MatrixPlotter, TableMaxMin)
+    o = Obj()
+    o.confusion_matrix = Array(numpy.eye(3, dtype=numpy.int32))
+    m = MatrixPlotter(None, obj=o, collect=True)
+    m.run()
+    assert numpy.asarray(m.last_payload["data"]).shape == (3, 3)
+
+    o.weights = Array(numpy.arange(12, dtype=numpy.float32))
+    h = Histogram(None, obj=o, attr="weights", bins=4, collect=True)
+    h.run()
+    assert sum(h.last_payload["counts"]) == 12
+
+    t = TableMaxMin(None, collect=True).watch("w", o, "weights")
+    t.run()
+    assert t.last_payload["rows"][0] == ["w", 11.0, 0.0]
+
+
+def test_image_plotter_2d_weights():
+    from veles_tpu.plotting_units import ImagePlotter
+    o = Obj()
+    o.weights = Array(numpy.random.rand(16, 6).astype(numpy.float32))
+    p = ImagePlotter(None, obj=o, limit=4, collect=True)
+    p.run()
+    tiles = numpy.asarray(p.last_payload["tiles"])
+    assert tiles.shape == (4, 4, 4)  # 16 inputs → 4x4 tiles, limit 4
+
+
+def test_render_all_kinds(tmp_path):
+    from veles_tpu.graphics_client import render_payload
+    payloads = [
+        {"kind": "curve", "series": {"a": [1, 2, 3]}, "name": "c"},
+        {"kind": "matrix", "data": [[1, 0], [0, 1]], "name": "m"},
+        {"kind": "images", "tiles": numpy.random.rand(3, 4, 4).tolist(),
+         "name": "i"},
+        {"kind": "histogram", "counts": [1, 2], "edges": [0, 1, 2],
+         "name": "h"},
+        {"kind": "multi_histogram", "layers": {
+            "fc0": {"counts": [1], "edges": [0, 1]}}, "name": "mh"},
+        {"kind": "table", "header": ["a"], "rows": [["x"]], "name": "t"},
+    ]
+    for pl in payloads:
+        fig = render_payload(pl)
+        fig.savefig(tmp_path / (pl["name"] + ".png"))
+    assert len(list(tmp_path.glob("*.png"))) == len(payloads)
+
+
+# -- graphics fan-out ---------------------------------------------------------
+
+def test_graphics_server_pub_sub():
+    zmq = pytest.importorskip("zmq")
+    from veles_tpu.graphics_server import GraphicsServer
+    server = GraphicsServer()
+    sub = zmq.Context.instance().socket(zmq.SUB)
+    sub.setsockopt(zmq.SUBSCRIBE, b"")
+    sub.connect(server.endpoint)
+    time.sleep(0.3)  # PUB/SUB join
+    payload = {"kind": "curve", "series": {"x": [1.0]}, "name": "p"}
+    server.enqueue(payload)
+    assert sub.poll(3000), "no payload arrived"
+    got = pickle.loads(gzip.decompress(sub.recv()))
+    assert got == payload
+    sub.close(0)
+    server.close()
+
+
+def test_plotter_publishes_through_launcher():
+    """Workflow → launcher.graphics_server → SUB loopback."""
+    zmq = pytest.importorskip("zmq")
+    from veles_tpu.graphics_server import GraphicsServer
+    from veles_tpu.plotting_units import AccumulatingPlotter
+    from veles_tpu.workflow import Workflow
+
+    class FakeLauncher:
+        def add_ref(self, wf):
+            self.workflow = wf
+
+        def del_ref(self, wf):
+            pass
+
+    launcher = FakeLauncher()
+    launcher.graphics_server = GraphicsServer()
+    wf = Workflow(launcher, name="gfx")
+    o = Obj()
+    o.v = 1.5
+    p = AccumulatingPlotter(wf, obj=o, attr="v")
+    sub = zmq.Context.instance().socket(zmq.SUB)
+    sub.setsockopt(zmq.SUBSCRIBE, b"")
+    sub.connect(launcher.graphics_server.endpoint)
+    time.sleep(0.3)
+    p.run()
+    assert sub.poll(3000)
+    got = pickle.loads(gzip.decompress(sub.recv()))
+    assert got["series"] == {"v": [1.5]}
+    sub.close(0)
+    launcher.graphics_server.close()
+
+
+# -- web status ---------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def status_server():
+    pytest.importorskip("tornado")
+    from veles_tpu.web_status import WebStatusServer
+    server = WebStatusServer(port=_free_port())
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_web_status_update_and_pages(status_server):
+    url = "http://127.0.0.1:%d" % status_server.port
+    body = json.dumps({
+        "id": "run-1", "workflow": "MNIST", "mode": "master",
+        "metrics": {"validation_error_pct": 2.5},
+        "workers": [{"id": "w0", "state": "WORK", "jobs": 3}],
+    }).encode()
+    req = urllib.request.Request(
+        url + "/update", data=body,
+        headers={"Content-Type": "application/json"})
+    assert json.load(urllib.request.urlopen(req, timeout=5))["ok"]
+    runs = json.load(urllib.request.urlopen(url + "/api/runs",
+                                            timeout=5))["runs"]
+    assert runs["run-1"]["workflow"] == "MNIST"
+    page = urllib.request.urlopen(url + "/", timeout=5).read().decode()
+    assert "MNIST" in page and "w0: WORK" in page
+
+
+def test_status_notifier(status_server):
+    from veles_tpu.web_status import StatusNotifier
+
+    class FakeWorkflow:
+        name = "FakeWF"
+
+        def gather_results(self):
+            return {"loss": 0.5}
+
+    class FakeLauncher:
+        mode = "standalone"
+        workflow = FakeWorkflow()
+        coordinator = None
+
+    url = "http://127.0.0.1:%d" % status_server.port
+    notifier = StatusNotifier(url, FakeLauncher(), interval=60)
+    notifier._post_once()
+    runs = json.load(urllib.request.urlopen(url + "/api/runs",
+                                            timeout=5))["runs"]
+    assert any(r.get("workflow") == "FakeWF" for r in runs.values())
+
+
+# -- end-to-end through a training run ---------------------------------------
+
+def test_standard_workflow_plotters_collect():
+    from veles_tpu.backends import Device
+    from veles_tpu.samples.mnist import MnistWorkflow
+    root.mnist_tpu.update({
+        "max_epochs": 2, "synthetic_train": 512, "synthetic_valid": 128,
+        "minibatch_size": 128, "snapshot_time_interval": 1e9,
+    })
+    wf = MnistWorkflow(None, layers=[32, 10])
+    wf.snapshotter.interval = 10**9
+    wf.snapshotter.time_interval = 10**9
+    for p in wf.plotters:
+        p.collect = True  # no graphics server in tests
+    wf.initialize(device=Device(backend="numpy"))
+    wf.run()
+    assert wf.plotters, "StandardWorkflow wired no plotters"
+    curves = {p.name: p.last_payload for p in wf.plotters}
+    assert curves["loss_curve"] is not None
+    err = curves["error_curve"]
+    assert err is not None and len(err["series"]["validation error"]) >= 2

@@ -82,6 +82,8 @@ class Main:
         argv = []
         if self.args.backend:
             argv += ["-a", self.args.backend]
+        if self.args.device:
+            argv += ["-d", str(self.args.device)]
         for _ in range(self.args.verbose):
             argv += ["-v"]
         return argv
@@ -163,7 +165,9 @@ class Main:
         self.launcher = Launcher(
             backend=self.args.backend, device_index=self.args.device,
             listen=self.args.listen,
-            master_address=self.args.master_address)
+            master_address=self.args.master_address,
+            graphics=self.args.graphics or None,
+            status_url=self.args.web_status)
         module = import_file_as_module(self.args.workflow)
         if not hasattr(module, "run"):
             print("workflow file must define run(load, main)",

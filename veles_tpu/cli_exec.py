@@ -1,0 +1,42 @@
+"""Shared subprocess-evaluation harness.
+
+Genetics individuals and ensemble instances are both evaluated by
+re-running the CLI with ``--result-file`` (ref:
+veles/ensemble/base_workflow.py:135-152 — genetics shells out the same
+way); this is the one copy of that contract.
+"""
+
+import json
+import logging
+import os
+import subprocess
+import tempfile
+
+log = logging.getLogger("cli_exec")
+
+
+def run_cli_collect_results(argv, timeout=None):
+    """Run ``argv + [--result-file tmp]``; return the parsed metrics
+    dict, or None on any failure (logged, never raised — a dead
+    individual/instance must not kill the fleet)."""
+    with tempfile.NamedTemporaryFile(
+            mode="r", suffix=".json", delete=False) as f:
+        result_file = f.name
+    argv = list(argv) + ["--result-file", result_file]
+    try:
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=timeout, cwd=os.getcwd())
+        if proc.returncode != 0:
+            log.warning("subprocess failed (rc=%d): %s", proc.returncode,
+                        proc.stderr[-500:])
+            return None
+        with open(result_file) as f:
+            return json.load(f)
+    except (subprocess.TimeoutExpired, OSError, ValueError) as e:
+        log.warning("subprocess evaluation error: %s", e)
+        return None
+    finally:
+        try:
+            os.unlink(result_file)
+        except OSError:
+            pass

@@ -51,6 +51,12 @@ def build_parser():
                    help="run as coordinator, listen on host:port")
     p.add_argument("-m", "--master-address", default=None, metavar="ADDR",
                    help="run as worker of the given coordinator")
+    p.add_argument("-g", "--graphics", action="store_true",
+                   help="publish live plot payloads over ZMQ PUB "
+                        "(attach: python -m veles_tpu.graphics_client)")
+    p.add_argument("--web-status", default=None, metavar="URL",
+                   help="POST run status to a veles_tpu.web_status "
+                        "dashboard")
     p.add_argument("--optimize", default=None, metavar="SIZE[:GENS]",
                    help="genetic hyper-parameter search over the "
                         "config's Range() tuneables (ref: veles "

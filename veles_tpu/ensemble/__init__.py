@@ -12,35 +12,11 @@ ensemble/test_workflow.py:102).
 import json
 import logging
 import os
-import subprocess
 import sys
-import tempfile
+
+from veles_tpu.cli_exec import run_cli_collect_results as _run_cli
 
 log = logging.getLogger("ensemble")
-
-
-def _run_cli(argv, timeout=None):
-    with tempfile.NamedTemporaryFile(
-            mode="r", suffix=".json", delete=False) as f:
-        result_file = f.name
-    argv = list(argv) + ["--result-file", result_file]
-    try:
-        proc = subprocess.run(argv, capture_output=True, text=True,
-                              timeout=timeout, cwd=os.getcwd())
-        if proc.returncode != 0:
-            log.warning("instance failed (rc=%d): %s", proc.returncode,
-                        proc.stderr[-500:])
-            return None
-        with open(result_file) as f:
-            return json.load(f)
-    except (subprocess.TimeoutExpired, OSError, ValueError) as e:
-        log.warning("instance error: %s", e)
-        return None
-    finally:
-        try:
-            os.unlink(result_file)
-        except OSError:
-            pass
 
 
 class EnsembleTrainer:

@@ -7,13 +7,10 @@ for the fitness, exactly the reference's subprocess contract.  The
 evaluator can also be swapped out (tests inject a python callable).
 """
 
-import json
 import logging
-import os
-import subprocess
 import sys
-import tempfile
 
+from veles_tpu.cli_exec import run_cli_collect_results
 from veles_tpu.genetics.core import Population, collect_tuneables
 
 log = logging.getLogger("genetics")
@@ -51,35 +48,20 @@ class SubprocessEvaluator:
         self.timeout = timeout
 
     def __call__(self, overrides, seed):
-        with tempfile.NamedTemporaryFile(
-                mode="r", suffix=".json", delete=False) as f:
-            result_file = f.name
         argv = [sys.executable, "-m", "veles_tpu", self.workflow_file]
         if self.config_file:
             argv.append(self.config_file)
         for ov in self.base_overrides + list(overrides):
             argv += ["-c", ov]
-        argv += ["--result-file", result_file, "--seed", str(seed)]
-        argv += self.extra_argv
-        try:
-            proc = subprocess.run(
-                argv, capture_output=True, text=True, timeout=self.timeout,
-                cwd=os.getcwd())
-            if proc.returncode != 0:
-                log.warning("individual failed (rc=%d): %s",
-                            proc.returncode, proc.stderr[-500:])
-                return None
-            with open(result_file) as f:
-                return fitness_from_results(json.load(f))
-        except (subprocess.TimeoutExpired, OSError, ValueError,
-                KeyError) as e:
-            log.warning("individual evaluation error: %s", e)
+        argv += ["--seed", str(seed)] + self.extra_argv
+        results = run_cli_collect_results(argv, timeout=self.timeout)
+        if results is None:
             return None
-        finally:
-            try:
-                os.unlink(result_file)
-            except OSError:
-                pass
+        try:
+            return fitness_from_results(results)
+        except KeyError as e:
+            log.warning("individual produced no fitness: %s", e)
+            return None
 
 
 class GeneticsOptimizer:

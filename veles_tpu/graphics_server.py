@@ -1,0 +1,53 @@
+"""GraphicsServer — ZMQ PUB fan-out of plot payloads.
+
+Rebuild of veles/graphics_server.py:73-135: the training process binds a
+PUB socket (tcp + inproc endpoints) and pushes each plotter payload as
+one gzip-pickled message; any number of client processes subscribe.
+The reference additionally offered epgm multicast — out of scope on a
+TPU pod's DCN, where the web-status tier covers fan-out.
+"""
+
+import gzip
+import pickle
+
+from veles_tpu.logger import Logger
+
+try:
+    import zmq
+    HAS_ZMQ = True
+except ImportError:  # pragma: no cover
+    HAS_ZMQ = False
+
+
+class GraphicsServer(Logger):
+    """PUB endpoint for plot payloads (ref: graphics_server.py:73)."""
+
+    def __init__(self, port=0, host="127.0.0.1"):
+        super(GraphicsServer, self).__init__()
+        if not HAS_ZMQ:  # pragma: no cover
+            raise RuntimeError("pyzmq is unavailable")
+        self._ctx = zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.PUB)
+        self._sock.setsockopt(zmq.LINGER, 0)
+        if port:
+            self._sock.bind("tcp://%s:%d" % (host, port))
+            self.port = port
+        else:
+            self.port = self._sock.bind_to_random_port("tcp://" + host)
+        self.endpoint = "tcp://%s:%d" % (host, self.port)
+        self.sent = 0
+        self.info("graphics PUB on %s", self.endpoint)
+
+    def enqueue(self, payload):
+        """Publish one plot payload (non-blocking; slow subscribers drop
+        — live plots must never stall training)."""
+        blob = gzip.compress(
+            pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL), 1)
+        try:
+            self._sock.send(blob, zmq.NOBLOCK)
+            self.sent += 1
+        except zmq.ZMQError:  # pragma: no cover - full HWM
+            pass
+
+    def close(self):
+        self._sock.close(0)

@@ -23,16 +23,22 @@ class Launcher(Logger):
     ("slave"), else standalone."""
 
     def __init__(self, backend=None, device_index=0, listen=None,
-                 master_address=None, **kwargs):
+                 master_address=None, graphics=None, status_url=None,
+                 **kwargs):
         super(Launcher, self).__init__()
         self._listen = listen
         self._master_address = master_address
         self._backend = backend
         self._device_index = device_index
+        self._graphics = graphics
+        self._status_url = status_url
         self.device = None
         self.workflow = None
         self.start_time = None
         self.stopped = False
+        self.coordinator = None
+        self.graphics_server = None
+        self.status_notifier = None
 
     # -- mode (ref: launcher.py:333-356) --------------------------------------
 
@@ -68,15 +74,33 @@ class Launcher(Logger):
             self.workflow = None
 
     def initialize(self, **kwargs):
+        from veles_tpu.config import root
         if self.device is None:
             self.device = Device(backend=self._backend,
                                  device_index=self._device_index)
         self.info("mode: %s, device: %s", self.mode, self.device)
+        # graphics PUB fan-out (ref: launcher starting the graphics
+        # server process, veles/launcher.py:431-548); client processes
+        # attach with `python -m veles_tpu.graphics_client <endpoint>`
+        graphics = self._graphics
+        if graphics is None:
+            graphics = root.common.graphics.get("enabled", False)
+        if graphics and not self.is_slave:
+            from veles_tpu.graphics_server import GraphicsServer
+            self.graphics_server = GraphicsServer(
+                port=int(root.common.graphics.get("port", 0)))
         self.workflow.initialize(device=self.device, **kwargs)
 
     def run(self):
         """Run to completion (standalone) or serve (distributed)."""
+        from veles_tpu.config import root
         self.start_time = time.time()
+        status_url = self._status_url \
+            or root.common.web.get("status_url")
+        if status_url and not self.is_slave:
+            from veles_tpu.web_status import StatusNotifier
+            self.status_notifier = StatusNotifier(status_url, self)
+            self.status_notifier.start()
         try:
             if self.is_standalone:
                 self.workflow.run()
@@ -97,6 +121,10 @@ class Launcher(Logger):
         if self.stopped:
             return
         self.stopped = True
+        if self.status_notifier is not None:
+            self.status_notifier.stop()
+        if self.graphics_server is not None:
+            self.graphics_server.close()
         elapsed = time.time() - (self.start_time or time.time())
         self.workflow.stop()
         self.workflow.print_stats()

@@ -57,6 +57,11 @@ class DecisionGD(Unit, IResultProvider):
         return isinstance(ev, EvaluatorMSE)
 
     @property
+    def validation_error_pct(self):
+        """Last closed epoch's validation error % (plotter feed)."""
+        return self.epoch_metrics.get("validation_error_pct")
+
+    @property
     def fail_count(self):
         return (self.effective_epoch -
                 max(self.min_validation_n_err_epoch, 0))

@@ -118,7 +118,8 @@ class StandardWorkflow(AcceleratedWorkflow):
     def __init__(self, workflow, loader_factory=None, loader=None,
                  loader_config=None, layers=(), loss="softmax",
                  decision_config=None, snapshotter_config=None,
-                 mesh=None, name="StandardWorkflow", **trainer_kwargs):
+                 mesh=None, name="StandardWorkflow", plotters=True,
+                 **trainer_kwargs):
         from veles_tpu.models.decision import DecisionGD
         from veles_tpu.plumbing import Repeater
         from veles_tpu.snapshotter import Snapshotter
@@ -161,6 +162,24 @@ class StandardWorkflow(AcceleratedWorkflow):
             self.snapshotter.link_from(self.decision)
         else:
             self.snapshotter = None
+
+        # live plots (ref: znicz StandardWorkflow wired its plotter set
+        # the same way); payloads publish only when a graphics server or
+        # web-status notifier is attached
+        self.plotters = []
+        if plotters:
+            from veles_tpu.plotting_units import AccumulatingPlotter
+            err_plot = AccumulatingPlotter(
+                self, obj=self.decision, attr="validation_error_pct",
+                label="validation error", ylabel="%",
+                name="error_curve")
+            err_plot.gate_skip = ~self.loader.epoch_ended
+            loss_plot = AccumulatingPlotter(
+                self, obj=self.gd, attr="loss", label="train loss",
+                ylabel="loss", name="loss_curve")
+            for plot in (err_plot, loss_plot):
+                plot.link_from(self.decision)
+                self.plotters.append(plot)
 
         self.repeater.link_from(self.decision)
         self.loader.gate_block = self.decision.complete

@@ -302,6 +302,7 @@ def serve_master(launcher):
     async def _main():
         coord = Coordinator(launcher.workflow, host or "0.0.0.0",
                             int(port or 5050))
+        launcher.coordinator = coord  # SlaveStats / web status read it
         await coord.start()
         await coord.wait_finished()
         await coord.stop()

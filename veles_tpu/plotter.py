@@ -1,0 +1,62 @@
+"""Plotter base — live-visualization units.
+
+Rebuild of veles/plotter.py:48 + graphics_server.py:73: plotting units
+run inside the training graph, but rendering happens OUT of process —
+the unit's ``run()`` only snapshots host-side state into a small
+picklable *payload* which the :class:`~veles_tpu.graphics_server.
+GraphicsServer` fans out over ZMQ PUB to any number of
+:mod:`~veles_tpu.graphics_client` processes (matplotlib lives there,
+never in the training process).
+
+Redesign note: the reference pickled the entire live Plotter unit
+through the PUB socket (plotter.py DataStreamer); payloads here are
+plain dicts — cheaper to serialize, and the client needs no access to
+framework classes.
+"""
+
+import time
+
+from veles_tpu.units import Unit
+
+
+class Plotter(Unit):
+    """Base plotting unit (ref: veles/plotter.py:48).
+
+    Subclasses implement :meth:`payload` returning a picklable dict with
+    at least ``kind`` (the client's renderer key).  ``run()`` publishes
+    it through the launcher's graphics server when one is live; the
+    latest payload is always kept on ``last_payload`` (tests and the
+    direct-render path read it).
+    """
+
+    VIEW_GROUP = "PLOTTER"
+
+    def __init__(self, workflow, name=None, collect=False, **kwargs):
+        super(Plotter, self).__init__(workflow, name=name, **kwargs)
+        self.last_payload = None
+        #: build payloads even without a publisher (tests / direct
+        #: rendering); off by default — payload() may sync the device,
+        #: which must not happen on the hot loop of a plain run
+        self.collect = collect
+
+    @property
+    def graphics_server(self):
+        # walk up through nested workflows to the launcher
+        launcher = getattr(self._workflow, "launcher", None)
+        return getattr(launcher, "graphics_server", None)
+
+    def payload(self):
+        raise NotImplementedError()
+
+    def run(self):
+        server = self.graphics_server
+        if server is None and not self.collect:
+            return
+        data = self.payload()
+        if data is None:
+            return
+        data.setdefault("name", self.name)
+        data.setdefault("time", time.time())
+        self.last_payload = data
+        if server is not None:
+            server.enqueue(data)

@@ -1,0 +1,186 @@
+"""Plotting unit family (rebuild of veles/plotting_units.py:52-822).
+
+Each unit snapshots host-visible training state into a renderer payload
+(see :mod:`veles_tpu.plotter`); the matplotlib side lives in
+:mod:`veles_tpu.graphics_client`.
+"""
+
+import numpy
+
+from veles_tpu.memory import Array
+from veles_tpu.plotter import Plotter
+
+
+def _value_of(obj, attr):
+    v = getattr(obj, attr)
+    if isinstance(v, Array):
+        v.map_read()
+        v = v.mem
+    if isinstance(v, numpy.ndarray) and v.ndim == 0:
+        v = v.item()
+    return v
+
+
+class AccumulatingPlotter(Plotter):
+    """Scalar curve over time (ref: plotting_units.py:52
+    AccumulatingPlotter): reads ``<obj>.<attr>`` each run and appends to
+    the named series."""
+
+    def __init__(self, workflow, obj=None, attr=None, label=None,
+                 ylabel="value", **kwargs):
+        super(AccumulatingPlotter, self).__init__(workflow, **kwargs)
+        self.obj = obj
+        self.attr = attr
+        self.label = label or attr
+        self.ylabel = ylabel
+        self.series = []
+        self.demand("obj", "attr")
+
+    def payload(self):
+        v = _value_of(self.obj, self.attr)
+        if v is None:
+            return None
+        self.series.append(float(v))
+        return {"kind": "curve", "ylabel": self.ylabel,
+                "series": {self.label: list(self.series)}}
+
+
+class MatrixPlotter(Plotter):
+    """Confusion-matrix heatmap (ref: plotting_units.py MatrixPlotter);
+    reads an Array-valued attr (e.g. evaluator.confusion_matrix)."""
+
+    def __init__(self, workflow, obj=None, attr="confusion_matrix",
+                 **kwargs):
+        super(MatrixPlotter, self).__init__(workflow, **kwargs)
+        self.obj = obj
+        self.attr = attr
+        self.demand("obj")
+
+    def payload(self):
+        m = _value_of(self.obj, self.attr)
+        if m is None:
+            return None
+        return {"kind": "matrix", "data": numpy.asarray(m).tolist()}
+
+
+class ImagePlotter(Plotter):
+    """Image grid (ref: plotting_units.py ImagePlotter / Weights2D):
+    renders rows of an Array as tiles — weights filters or samples."""
+
+    def __init__(self, workflow, obj=None, attr="weights", limit=16,
+                 sample_shape=None, **kwargs):
+        super(ImagePlotter, self).__init__(workflow, **kwargs)
+        self.obj = obj
+        self.attr = attr
+        self.limit = limit
+        self.sample_shape = sample_shape
+        self.demand("obj")
+
+    def payload(self):
+        w = _value_of(self.obj, self.attr)
+        if w is None:
+            return None
+        w = numpy.asarray(w, numpy.float32)
+        if w.ndim == 4:  # HWIO conv kernels → [O, H, W] mean over I
+            tiles = numpy.transpose(w.mean(axis=2), (2, 0, 1))
+        elif w.ndim == 2:
+            side = self.sample_shape
+            if side is None:
+                n = int(numpy.sqrt(w.shape[0]))
+                side = (n, n) if n * n == w.shape[0] else None
+            if side is None:
+                return None
+            tiles = w.T.reshape(-1, *side)
+        else:
+            tiles = w.reshape((-1,) + w.shape[-2:])
+        tiles = tiles[:self.limit]
+        return {"kind": "images", "tiles": tiles.tolist()}
+
+
+class Histogram(Plotter):
+    """Value histogram of one Array (ref: plotting_units.py
+    Histogram)."""
+
+    def __init__(self, workflow, obj=None, attr="weights", bins=30,
+                 **kwargs):
+        super(Histogram, self).__init__(workflow, **kwargs)
+        self.obj = obj
+        self.attr = attr
+        self.bins = bins
+        self.demand("obj")
+
+    def payload(self):
+        v = _value_of(self.obj, self.attr)
+        if v is None:
+            return None
+        counts, edges = numpy.histogram(
+            numpy.asarray(v).ravel(), bins=self.bins)
+        return {"kind": "histogram", "counts": counts.tolist(),
+                "edges": edges.tolist()}
+
+
+class MultiHistogram(Plotter):
+    """One histogram per forward layer's weights (ref:
+    plotting_units.py MultiHistogram)."""
+
+    def __init__(self, workflow, forwards=None, bins=20, **kwargs):
+        super(MultiHistogram, self).__init__(workflow, **kwargs)
+        self.forwards = forwards
+        self.bins = bins
+        self.demand("forwards")
+
+    def payload(self):
+        hists = {}
+        for u in self.forwards:
+            arrs = u.param_arrays()
+            if "weights" not in arrs:
+                continue
+            arrs["weights"].map_read()
+            counts, edges = numpy.histogram(
+                arrs["weights"].mem.ravel(), bins=self.bins)
+            hists[u.name] = {"counts": counts.tolist(),
+                             "edges": edges.tolist()}
+        if not hists:
+            return None
+        return {"kind": "multi_histogram", "layers": hists}
+
+
+class TableMaxMin(Plotter):
+    """min/max text table over watched Arrays (ref: plotting_units.py
+    TableMaxMin)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(TableMaxMin, self).__init__(workflow, **kwargs)
+        self.watched = []  # (label, obj, attr)
+
+    def watch(self, label, obj, attr):
+        self.watched.append((label, obj, attr))
+        return self
+
+    def payload(self):
+        rows = []
+        for label, obj, attr in self.watched:
+            v = numpy.asarray(_value_of(obj, attr))
+            rows.append([label, float(v.max()), float(v.min())])
+        if not rows:
+            return None
+        return {"kind": "table", "header": ["array", "max", "min"],
+                "rows": rows}
+
+
+class SlaveStats(Plotter):
+    """Per-worker state table on the coordinator (ref:
+    plotting_units.py SlaveStats + server.py:172-229
+    SlaveDescription)."""
+
+    def __init__(self, workflow, coordinator=None, **kwargs):
+        super(SlaveStats, self).__init__(workflow, **kwargs)
+        self.coordinator = coordinator
+        self.demand("coordinator")
+
+    def payload(self):
+        rows = [[w.id, w.state, round(w.power, 1), w.jobs_done]
+                for w in self.coordinator.workers.values()]
+        return {"kind": "table",
+                "header": ["worker", "state", "power", "jobs"],
+                "rows": rows}

@@ -1,0 +1,196 @@
+"""Web status dashboard (rebuild of veles/web_status.py:113 +
+launcher.py:852-885 status POSTs).
+
+A small tornado service: launchers POST their run status to ``/update``
+once a second; browsers read ``/`` (an auto-refreshing table of runs
+with per-worker state) and machines read ``/api/runs``.  The
+reference's MongoDB-backed log/event viewer maps onto the JSONL event
+stream (veles_tpu.logger) — the dashboard links the raw feed instead of
+embedding a Mongo browser.
+
+Run standalone:  ``python -m veles_tpu.web_status --port 8090``
+"""
+
+import argparse
+import json
+import threading
+import time
+
+from veles_tpu.logger import Logger
+
+try:
+    import tornado.ioloop
+    import tornado.web
+    HAS_TORNADO = True
+except ImportError:  # pragma: no cover
+    HAS_TORNADO = False
+
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu status</title>
+<meta http-equiv="refresh" content="2">
+<style>
+ body { font-family: sans-serif; margin: 2em; }
+ table { border-collapse: collapse; }
+ td, th { border: 1px solid #999; padding: 4px 10px; }
+ th { background: #eee; }
+ .dead { color: #999; }
+</style></head>
+<body><h2>veles_tpu runs</h2>%TABLE%</body></html>
+"""
+
+
+def _render_runs(runs):
+    rows = []
+    now = time.time()
+    for rid, r in sorted(runs.items()):
+        age = now - r.get("_received", now)
+        cls = ' class="dead"' if age > 10 else ""
+        workers = r.get("workers", [])
+        wtable = "".join(
+            "<br>%s: %s (%.0f jobs)" % (w.get("id"), w.get("state"),
+                                        w.get("jobs", 0))
+            for w in workers)
+        metrics = ", ".join("%s=%s" % (k, v)
+                            for k, v in (r.get("metrics") or {}).items())
+        rows.append(
+            "<tr%s><td>%s</td><td>%s</td><td>%s</td><td>%s</td>"
+            "<td>%s</td><td>%.0fs ago</td></tr>"
+            % (cls, rid, r.get("workflow", "?"), r.get("mode", "?"),
+               metrics, wtable or "-", age))
+    return ("<table><tr><th>run</th><th>workflow</th><th>mode</th>"
+            "<th>metrics</th><th>workers</th><th>updated</th></tr>"
+            + "".join(rows) + "</table>")
+
+
+class WebStatusServer(Logger):
+    """The dashboard service (ref: web_status.py:113)."""
+
+    def __init__(self, port=8090):
+        super(WebStatusServer, self).__init__()
+        if not HAS_TORNADO:  # pragma: no cover
+            raise RuntimeError("tornado is unavailable")
+        self.port = port
+        self.runs = {}
+        server = self
+
+        class Update(tornado.web.RequestHandler):
+            def post(self):
+                data = json.loads(self.request.body)
+                data["_received"] = time.time()
+                server.runs[data.get("id", "?")] = data
+                self.write({"ok": True})
+
+        class Page(tornado.web.RequestHandler):
+            def get(self):
+                self.write(_PAGE.replace(
+                    "%TABLE%", _render_runs(server.runs)))
+
+        class Api(tornado.web.RequestHandler):
+            def get(self):
+                self.write({"runs": server.runs})
+
+        self.app = tornado.web.Application([
+            (r"/update", Update), (r"/", Page), (r"/api/runs", Api)])
+        self._loop = None
+        self._thread = None
+
+    def start(self, background=True):
+        if not background:
+            self.app.listen(self.port)
+            tornado.ioloop.IOLoop.current().start()
+            return
+
+        started = threading.Event()
+
+        def run():
+            import asyncio
+            asyncio.set_event_loop(asyncio.new_event_loop())
+            self.app.listen(self.port)
+            self._loop = tornado.ioloop.IOLoop.current()
+            started.set()
+            self._loop.start()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="web-status")
+        self._thread.start()
+        started.wait(5)
+        self.info("web status on http://localhost:%d/", self.port)
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.add_callback(self._loop.stop)
+            self._thread.join(5)
+
+
+class StatusNotifier(Logger):
+    """Launcher-side POST loop (ref: launcher.py:852-885 upload_status):
+    periodically reports {id, workflow, mode, metrics, workers} to a
+    WebStatusServer's /update."""
+
+    def __init__(self, url, launcher, interval=1.0):
+        super(StatusNotifier, self).__init__()
+        self.url = url.rstrip("/") + "/update"
+        self.launcher = launcher
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = None
+
+    def _status(self):
+        import os
+        launcher = self.launcher
+        wf = launcher.workflow
+        status = {
+            "id": "%s-%d" % (type(wf).__name__, os.getpid()),
+            "workflow": getattr(wf, "name", type(wf).__name__),
+            "mode": launcher.mode,
+            "metrics": wf.gather_results() if wf is not None else {},
+        }
+        coord = getattr(launcher, "coordinator", None)
+        if coord is not None:
+            status["workers"] = [
+                {"id": w.id, "state": w.state, "power": w.power,
+                 "jobs": w.jobs_done} for w in coord.workers.values()]
+        return status
+
+    def _post_once(self):
+        import urllib.request
+        body = json.dumps(self._status(), default=str).encode()
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=2).read()
+
+    def run_forever(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self._post_once()
+            except Exception as e:
+                self.debug("status POST failed: %s", e)
+
+    def start(self):
+        self._thread = threading.Thread(target=self.run_forever,
+                                        daemon=True, name="status-notify")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            try:  # final state lands even if the loop never fired
+                self._post_once()
+            except Exception:
+                pass
+            self._thread.join(3)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="veles_tpu.web_status")
+    p.add_argument("--port", type=int, default=8090)
+    args = p.parse_args(argv)
+    WebStatusServer(port=args.port).start(background=False)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
