@@ -1,0 +1,61 @@
+"""Worker script for the 2-process multi-host SPMD test (SURVEY.md §4:
+"loopback multi-host tests — multi-process jax.distributed on one
+host").  Each process exposes 2 virtual CPU devices; the gang sees 4.
+
+Usage: python tests/multihost_worker.py <coordinator> <nproc> <pid>
+Prints PROOF lines the parent asserts on.
+"""
+
+import os
+import sys
+
+
+def main():
+    coordinator, nproc, pid = (sys.argv[1], int(sys.argv[2]),
+                               int(sys.argv[3]))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+
+    from veles_tpu.parallel import multihost
+    got_pid, got_nproc = multihost.initialize(
+        coordinator_address=coordinator, num_processes=nproc,
+        process_id=pid)
+    import jax
+    import jax.numpy as jnp
+    import numpy
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    print("PROOF process %d/%d devices=%d local=%d" % (
+        got_pid, got_nproc, len(jax.devices()),
+        len(jax.local_devices())), flush=True)
+
+    # 1. global mesh + sharded collective
+    mesh = multihost.global_mesh({"dp": 4})
+    x = numpy.arange(16, dtype=numpy.float32).reshape(4, 4)
+    gx = multihost.global_put(x, mesh, P("dp", None))
+    total = jax.jit(
+        lambda a: jnp.sum(a),
+        out_shardings=NamedSharding(mesh, P()))(gx)
+    print("PROOF sum=%s" % float(total), flush=True)
+
+    # 2. the FULL sharded train step over the global mesh (the same
+    # program dryrun_multichip proves single-process)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import __graft_entry__ as graft
+    from veles_tpu.backends import Device
+    dev = Device(backend="numpy")
+    loader, layers, gd = graft._build_flagship(dev, mesh=mesh)
+    loader.run()
+    gd.run()
+    gd.loss.map_read()
+    print("PROOF loss=%.6f" % float(gd.loss.mem), flush=True)
+    multihost.sync_global_devices("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

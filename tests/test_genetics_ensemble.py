@@ -139,6 +139,7 @@ def test_cli_optimize_smoke(tmp_path):
     assert outcome["best_fitness"] is not None
 
 
+@pytest.mark.slow
 def test_cli_ensemble_train_and_test(tmp_path):
     out = tmp_path / "ens.json"
     r = subprocess.run(

@@ -88,9 +88,17 @@ class Device(Logger, metaclass=BackendRegistry):
 
     def _discover(self):
         try:
-            return jax.devices(self._PLATFORM)
+            devices = jax.devices(self._PLATFORM)
         except RuntimeError:
             return []
+        if jax.process_count() > 1:
+            # a Device owns only THIS process's chips in a multi-host
+            # gang (device_put to another host's device is invalid);
+            # global placement goes through parallel.sharding.put over a
+            # mesh spanning jax.devices()
+            devices = [d for d in devices
+                       if d.process_index == jax.process_index()]
+        return devices
 
     @classmethod
     def available(cls):

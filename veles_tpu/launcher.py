@@ -75,6 +75,14 @@ class Launcher(Logger):
 
     def initialize(self, **kwargs):
         from veles_tpu.config import root
+        # join the multi-host gang first (no-op unless VELES_TPU_
+        # COORDINATOR/NUM_PROCESSES/PROCESS_ID configure one; pod
+        # auto-detection needs multihost.initialize(auto=True)) — must
+        # precede the first JAX use
+        from veles_tpu.parallel import multihost
+        pid, nproc = multihost.initialize()
+        if nproc > 1:
+            self.info("multi-host gang: process %d/%d", pid, nproc)
         if self.device is None:
             self.device = Device(backend=self._backend,
                                  device_index=self._device_index)

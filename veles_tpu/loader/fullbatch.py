@@ -68,10 +68,12 @@ class FullBatchLoader(Loader):
 
     def rehome_dataset(self, sharding):
         """Re-place the resident dataset (e.g. replicate over a mesh);
-        the previous placement is released."""
-        self._dataset_dev_ = jax.device_put(self._dataset_dev_, sharding)
+        the previous placement is released.  Multi-host meshes assemble
+        from host data (parallel.sharding.put)."""
+        from veles_tpu.parallel.sharding import put
+        self._dataset_dev_ = put(self._dataset_dev_, sharding)
         if self._labels_dev_ is not None:
-            self._labels_dev_ = jax.device_put(self._labels_dev_, sharding)
+            self._labels_dev_ = put(self._labels_dev_, sharding)
 
     # -- ILoader ---------------------------------------------------------------
 
@@ -216,8 +218,8 @@ class FullBatchLoaderMSE(FullBatchLoader):
     def rehome_dataset(self, sharding):
         super(FullBatchLoaderMSE, self).rehome_dataset(sharding)
         if self._targets_dev_ is not None:
-            self._targets_dev_ = jax.device_put(self._targets_dev_,
-                                                sharding)
+            from veles_tpu.parallel.sharding import put
+            self._targets_dev_ = put(self._targets_dev_, sharding)
 
     def create_minibatch_data(self):
         super(FullBatchLoaderMSE, self).create_minibatch_data()

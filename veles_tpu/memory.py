@@ -195,9 +195,27 @@ class Array(Pickleable):
     def map_read(self):
         """Make the host mirror current."""
         if self._state == DEV_DIRTY and self._devmem_ is not None:
-            self._mem = numpy.asarray(self._devmem_)
+            self._mem = self._fetch_host(self._devmem_)
             self._state = COHERENT
         return self
+
+    @staticmethod
+    def _fetch_host(devmem):
+        """Device→host fetch that also works for multi-host arrays:
+        fully-replicated global arrays read the local shard; sharded
+        ones allgather across processes.  Plain numpy passes through."""
+        if not hasattr(devmem, "sharding"):
+            return numpy.asarray(devmem)
+        try:
+            return numpy.asarray(devmem)
+        except RuntimeError:
+            sharding = devmem.sharding
+            if getattr(sharding, "is_fully_replicated", False):
+                shard = next(iter(devmem.addressable_shards))
+                return numpy.asarray(shard.data)
+            from jax.experimental import multihost_utils
+            return numpy.asarray(
+                multihost_utils.process_allgather(devmem, tiled=True))
 
     def map_write(self):
         """Host mirror current *and* about to be written."""

@@ -304,6 +304,7 @@ class GradientDescent(AcceleratedUnit):
         params_sh, opt_sh, x_sh, tgt_sh, rep = self._ensure_shardings()
         batch_axes = x_sh.spec[0] if len(x_sh.spec) else None
         idx_sh = NamedSharding(self.mesh, P(None, batch_axes))
+        self._idx_sharding_ = idx_sh  # _run_span pre-places host indices
         sizes_sh = rep
         return jax.jit(
             span_step,
@@ -371,15 +372,15 @@ class GradientDescent(AcceleratedUnit):
         write (rollback, snapshot resume) reset a leaf to single-device
         placement — one leaf check suffices since all leaves travel
         together; normally state adopts the sharded step outputs."""
+        from veles_tpu.parallel import sharding as shlib
         params_sh, opt_sh, _, _, rep = self._shardings_
         if self.epoch_acc.devmem.sharding != rep:
-            self.epoch_acc.devmem = jax.device_put(
-                self.epoch_acc.devmem, rep)
+            self.epoch_acc.devmem = shlib.put(self.epoch_acc.devmem, rep)
         i0 = next(iter(params))
         n0 = next(iter(params[i0]))
         if params[i0][n0].sharding != params_sh[i0][n0]:
-            params = jax.tree.map(jax.device_put, params, params_sh)
-            opt_state = jax.tree.map(jax.device_put, opt_state, opt_sh)
+            params = jax.tree.map(shlib.put, params, params_sh)
+            opt_state = jax.tree.map(shlib.put, opt_state, opt_sh)
         return params, opt_state
 
     def run(self):
@@ -396,9 +397,17 @@ class GradientDescent(AcceleratedUnit):
         target = targets.devmem if isinstance(self.evaluator, EvaluatorMSE) \
             else labels
         if self._shardings_ is not None:
+            from veles_tpu.parallel import sharding as shlib
             _, _, x_sh, tgt_sh, _ = self._shardings_
-            x = jax.device_put(x, x_sh)
-            target = jax.device_put(target, tgt_sh)
+            if shlib.is_cross_process(x_sh):
+                # feed the host mirror directly: putting the local device
+                # buffer would download it again just to re-assemble
+                x = l.minibatch_data.map_read().mem
+                target = (l.minibatch_targets if isinstance(
+                    self.evaluator, EvaluatorMSE)
+                    else l.minibatch_labels).map_read().mem
+            x = shlib.put(x, x_sh)
+            target = shlib.put(target, tgt_sh)
             params, opt_state = self._mesh_prepare(params, opt_state)
         key = self.prng.peek_key(self.global_step)
         new_params, new_opt, acc, loss, n_err = self._train_step_(
@@ -434,10 +443,16 @@ class GradientDescent(AcceleratedUnit):
                 ds = l.dataset_dev
                 tgt = l.targets_dev if is_mse else l.labels_dev
             params, opt_state = self._mesh_prepare(params, opt_state)
+        idx = l.span_indices_
+        if getattr(self, "_idx_sharding_", None) is not None:
+            # multi-process meshes reject numpy args with non-trivial
+            # shardings — assemble the global index array explicitly
+            from veles_tpu.parallel import sharding as shlib
+            idx = shlib.put(idx, self._idx_sharding_)
         key = self.prng.peek_key(self.global_step)
         new_params, new_opt, acc, loss, n_err = self._span_step_(
             params, opt_state, self.epoch_acc.devmem, ds, tgt,
-            l.span_indices_, l.span_sizes_,
+            idx, l.span_sizes_,
             jnp.int32(l.span_class_), jnp.float32(self.global_step),
             jnp.float32(self.lr_multiplier), key)
         self.epoch_acc.devmem = acc

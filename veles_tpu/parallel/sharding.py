@@ -22,6 +22,32 @@ The default policy:
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
+def is_cross_process(sharding):
+    """True when the sharding includes devices of other processes."""
+    import jax
+    return any(d.process_index != jax.process_index()
+               for d in sharding.device_set)
+
+
+def put(value, sharding):
+    """``jax.device_put`` that also works when the sharding spans other
+    hosts' devices (multi-host gangs): the local fast path is a plain
+    device_put; the cross-host path re-assembles the global array from
+    host data, each process contributing the shards its devices own
+    (every process holds the same host value — the framework's
+    replicated-input convention)."""
+    import jax
+
+    if isinstance(value, jax.Array) and value.sharding == sharding:
+        return value  # already placed
+    if not is_cross_process(sharding):
+        return jax.device_put(value, sharding)
+    from veles_tpu.memory import Array
+    host = Array._fetch_host(value)  # handles global source arrays too
+    return jax.make_array_from_callback(
+        host.shape, sharding, lambda idx: host[idx])
+
+
 def _axis_size(mesh, name):
     return mesh.shape[name] if name in mesh.axis_names else 1
 
