@@ -87,18 +87,14 @@ class Device(Logger, metaclass=BackendRegistry):
     _PLATFORM = None
 
     def _discover(self):
+        # a Device owns only THIS process's chips (in a multi-host gang
+        # device_put to another host's device is invalid); global
+        # placement goes through parallel.sharding.put over a mesh
+        # spanning jax.devices()
         try:
-            devices = jax.devices(self._PLATFORM)
+            return list(jax.local_devices(backend=self._PLATFORM))
         except RuntimeError:
             return []
-        if jax.process_count() > 1:
-            # a Device owns only THIS process's chips in a multi-host
-            # gang (device_put to another host's device is invalid);
-            # global placement goes through parallel.sharding.put over a
-            # mesh spanning jax.devices()
-            devices = [d for d in devices
-                       if d.process_index == jax.process_index()]
-        return devices
 
     @classmethod
     def available(cls):

@@ -1,0 +1,64 @@
+"""Ensemble (stacking) loader — rebuild of veles/loader/ensemble.py:
+53-143: the meta-model's dataset is the concatenated per-instance
+outputs of a trained ensemble over a base dataset.
+
+The reference read per-model output dumps; here each instance's
+snapshot (from the ensemble summary JSON) is loaded and its forward
+chain applied to the base loader's samples — same capability, one file
+format fewer."""
+
+import json
+
+import numpy
+
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+
+class EnsembleLoader(FullBatchLoader):
+    """features[i] = concat(model_k.forward(sample_i) for k) over the
+    ensemble's instances; labels = the base loader's labels."""
+
+    def __init__(self, workflow, summary_path=None, base_loader=None,
+                 batch=256, **kwargs):
+        super(EnsembleLoader, self).__init__(workflow, **kwargs)
+        if summary_path is None or base_loader is None:
+            raise ValueError("summary_path and base_loader are required")
+        self.summary_path = summary_path
+        #: an (uninitialized) loader supplying the underlying dataset
+        self.base_loader = base_loader
+        self.batch = batch
+
+    def _forward_outputs(self, workflow, data):
+        """Apply a snapshot workflow's forward chain on host-visible
+        data in minibatch chunks."""
+        import jax.numpy as jnp
+        outs = []
+        for start in range(0, len(data), self.batch):
+            h = jnp.asarray(data[start:start + self.batch])
+            for u in workflow.forwards:
+                params = {k: jnp.asarray(a.map_read().mem)
+                          for k, a in u.param_arrays().items()}
+                h = u.apply(params, h)
+            outs.append(numpy.asarray(h))
+        return numpy.concatenate(outs)
+
+    def load_data(self):
+        from veles_tpu.snapshotter import SnapshotterToFile
+        with open(self.summary_path) as f:
+            summary = json.load(f)
+        base = self.base_loader
+        base.load_data()
+        data = numpy.asarray(base.original_data, numpy.float32)
+        features = []
+        for inst in summary["instances"]:
+            snap = inst.get("snapshot")
+            if not snap:
+                continue
+            wf = SnapshotterToFile.import_file(snap)
+            features.append(self._forward_outputs(wf, data))
+        if not features:
+            raise ValueError("no usable snapshots in %s"
+                             % self.summary_path)
+        self.class_lengths[:] = list(base.class_lengths)
+        self.original_data = numpy.concatenate(features, axis=1)
+        self.original_labels = base.original_labels

@@ -1,0 +1,145 @@
+"""HDF5 loaders (rebuild of veles/loader/loader_hdf5.py:48-151).
+
+File layout matches the reference's convention: one HDF5 file per class
+(test/validation/train) with ``data`` [n, ...] and ``labels`` [n]
+datasets.  :class:`FullBatchHDF5Loader` materializes everything into
+the HBM-resident dataset; :class:`HDF5Loader` streams minibatches from
+the on-disk datasets (bigger-than-RAM corpora).
+"""
+
+import numpy
+
+from veles_tpu.loader.base import TRAIN, VALID, Loader
+from veles_tpu.loader.fullbatch import FullBatchLoader
+
+try:
+    import h5py
+    HAS_H5PY = True
+except ImportError:  # pragma: no cover
+    HAS_H5PY = False
+
+
+def _require_h5py():
+    if not HAS_H5PY:  # pragma: no cover
+        raise RuntimeError("h5py is unavailable")
+
+
+class FullBatchHDF5Loader(FullBatchLoader):
+    """All class files into memory → HBM (ref: loader_hdf5.py:48)."""
+
+    def __init__(self, workflow, test_path=None, validation_path=None,
+                 train_path=None, data_name="data", labels_name="labels",
+                 **kwargs):
+        super(FullBatchHDF5Loader, self).__init__(workflow, **kwargs)
+        self.class_files = [test_path, validation_path, train_path]
+        self.data_name = data_name
+        self.labels_name = labels_name
+
+    def load_data(self):
+        _require_h5py()
+        datas, labels = [], []
+        for ci, path in enumerate(self.class_files):
+            if not path:
+                self.class_lengths[ci] = 0
+                continue
+            with h5py.File(path, "r") as f:
+                d = numpy.asarray(f[self.data_name])
+                datas.append(d)
+                self.class_lengths[ci] = len(d)
+                if self.labels_name in f:
+                    labels.extend(numpy.asarray(f[self.labels_name])
+                                  .tolist())
+        if not datas:
+            raise ValueError("%s: no HDF5 files given" % self)
+        self.original_data = numpy.concatenate(datas).astype(
+            numpy.float32)
+        if labels:
+            self.original_labels = labels
+
+
+class HDF5Loader(Loader):
+    """Streaming variant: minibatches gathered straight from the h5py
+    datasets (lazy chunked reads)."""
+
+    def __init__(self, workflow, test_path=None, validation_path=None,
+                 train_path=None, data_name="data", labels_name="labels",
+                 **kwargs):
+        super(HDF5Loader, self).__init__(workflow, **kwargs)
+        self.class_files = [test_path, validation_path, train_path]
+        self.data_name = data_name
+        self.labels_name = labels_name
+
+    def init_unpickled(self):
+        super(HDF5Loader, self).init_unpickled()
+        self._files_ = None
+        self._datasets_ = None
+        self._labels_ = None
+
+    def _open(self):
+        _require_h5py()
+        if self._files_ is not None:
+            return
+        self._files_, self._datasets_, self._labels_ = [], [], []
+        for path in self.class_files:
+            if not path:
+                self._files_.append(None)
+                self._datasets_.append(None)
+                self._labels_.append(None)
+                continue
+            f = h5py.File(path, "r")
+            self._files_.append(f)
+            self._datasets_.append(f[self.data_name])
+            self._labels_.append(f.get(self.labels_name))
+        return
+
+    def load_data(self):
+        self._open()
+        for ci, ds in enumerate(self._datasets_):
+            self.class_lengths[ci] = 0 if ds is None else len(ds)
+
+    def create_minibatch_data(self):
+        self._open()
+        shape = next(ds.shape[1:] for ds in self._datasets_
+                     if ds is not None)
+        self.minibatch_data.reset(numpy.zeros(
+            (self.max_minibatch_size,) + shape, numpy.float32))
+
+    def iterate_train(self):
+        self._open()
+        ds = self._datasets_[TRAIN]
+        if ds is None:
+            return
+        lab = self._labels_[TRAIN]
+        step = max(1, self.max_minibatch_size)
+        for start in range(0, len(ds), step):
+            stop = min(start + step, len(ds))
+            labels = None if lab is None \
+                else numpy.asarray(lab[start:stop]).tolist()
+            yield numpy.asarray(ds[start:stop]), labels
+
+    def _locate(self, global_idx):
+        """global sample index → (class index, local index)."""
+        base = 0
+        for ci, n in enumerate(self.class_lengths):
+            if global_idx < base + n:
+                return ci, global_idx - base
+            base += n
+        raise IndexError(global_idx)
+
+    def fill_minibatch(self):
+        self._open()
+        for i, gidx in enumerate(
+                self.minibatch_indices.mem[:self.minibatch_size]):
+            ci, local = self._locate(int(gidx))
+            self.minibatch_data.mem[i] = self._datasets_[ci][local]
+            lab = self._labels_[ci]
+            self.raw_minibatch_labels[i] = \
+                None if lab is None else lab[local].item()
+
+    def __del__(self):
+        for f in (self._files_ or []):
+            if f is not None:
+                try:
+                    f.close()
+                except Exception:
+                    pass

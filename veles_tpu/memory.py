@@ -202,10 +202,11 @@ class Array(Pickleable):
     @staticmethod
     def _fetch_host(devmem):
         """Device→host fetch that also works for multi-host arrays:
-        fully-replicated global arrays read the local shard; sharded
-        ones allgather across processes.  Plain numpy passes through."""
-        if not hasattr(devmem, "sharding"):
-            return numpy.asarray(devmem)
+        fully-replicated global arrays read the local shard.  A
+        cross-process *sharded* array is refused — the implicit
+        allgather would be a blocking collective inside a host-side
+        read, deadlocking any process-divergent code path; callers that
+        really want it use multihost.process_allgather explicitly."""
         try:
             return numpy.asarray(devmem)
         except RuntimeError:
@@ -213,9 +214,11 @@ class Array(Pickleable):
             if getattr(sharding, "is_fully_replicated", False):
                 shard = next(iter(devmem.addressable_shards))
                 return numpy.asarray(shard.data)
-            from jax.experimental import multihost_utils
-            return numpy.asarray(
-                multihost_utils.process_allgather(devmem, tiled=True))
+            raise RuntimeError(
+                "host read of a cross-process sharded array — gather it "
+                "explicitly with veles_tpu.parallel.multihost."
+                "process_allgather (an implicit collective here could "
+                "deadlock the gang)")
 
     def map_write(self):
         """Host mirror current *and* about to be written."""

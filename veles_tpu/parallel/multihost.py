@@ -34,6 +34,10 @@ def initialize(coordinator_address=None, num_processes=None,
     """
     import jax
 
+    if jax.distributed.is_initialized():
+        # idempotent: report the live gang's coordinates
+        return jax.process_index(), jax.process_count()
+
     coordinator_address = coordinator_address or os.environ.get(
         "VELES_TPU_COORDINATOR")
     if num_processes is None and "VELES_TPU_NUM_PROCESSES" in os.environ:
@@ -44,15 +48,11 @@ def initialize(coordinator_address=None, num_processes=None,
     if num_processes in (None, 1) and coordinator_address is None \
             and not auto:
         return 0, 1  # single process — nothing to join
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            local_device_ids=local_device_ids)
-    except RuntimeError as e:
-        if "already initialized" not in str(e):
-            raise
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
     return jax.process_index(), jax.process_count()
 
 

@@ -1,0 +1,125 @@
+"""REST inference serving (rebuild of veles/restful_api.py:78 +
+loader/restful.py:52).
+
+``RestfulLoader`` queues HTTP request payloads as minibatches;
+``RESTfulAPI`` owns the HTTP endpoint (stdlib threading server — the
+reference used twisted.web) and completes each pending request with the
+forward chain's output for its row.  Graph shape::
+
+    start → repeater → restful_loader → [forwards] → api ─→ repeater
+                                         (loop until the feed closes)
+"""
+
+import concurrent.futures
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy
+
+from veles_tpu.loader.interactive import InteractiveLoader
+from veles_tpu.memory import Array
+from veles_tpu.units import Unit
+
+
+class RestfulLoader(InteractiveLoader):
+    """Interactive loader whose samples carry reply futures
+    (ref: veles/loader/restful.py:52)."""
+
+    def init_unpickled(self):
+        super(RestfulLoader, self).init_unpickled()
+        self._futures_ = {}
+        self._fifo_ = []
+        self.pending_futures_ = []
+
+    def feed_request(self, sample):
+        future = concurrent.futures.Future()
+        self._fifo_.append(future)
+        self.feed(sample)
+        return future
+
+    def run(self):
+        super(RestfulLoader, self).run()
+        # the futures for exactly the rows just served, in row order
+        self.pending_futures_ = self._fifo_[:self.minibatch_size]
+        del self._fifo_[:self.minibatch_size]
+
+
+class RESTfulAPI(Unit):
+    """HTTP endpoint unit (ref: veles/restful_api.py:78): POST /api
+    ``{"input": [...]}`` → ``{"result": [...]}``.  Runs after the
+    forward chain; resolves each request's future with its output row.
+    """
+
+    VIEW_GROUP = "SERVICE"
+
+    def __init__(self, workflow, loader=None, port=0, host="127.0.0.1",
+                 request_timeout=30.0, **kwargs):
+        super(RESTfulAPI, self).__init__(workflow, **kwargs)
+        self.loader = loader
+        self.output = None  # linked from the head forward unit
+        self.port = port
+        self.host = host
+        self.request_timeout = request_timeout
+        self.demand("loader", "output")
+
+    def init_unpickled(self):
+        super(RESTfulAPI, self).init_unpickled()
+        self._server_ = None
+        self._thread_ = None
+
+    def initialize(self, **kwargs):
+        super(RESTfulAPI, self).initialize(**kwargs)
+        if self._server_ is not None:
+            return
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                if self.path.rstrip("/") != "/api":
+                    self.send_error(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length))
+                    sample = numpy.asarray(body["input"], numpy.float32)
+                    future = api.loader.feed_request(sample)
+                    result = future.result(api.request_timeout)
+                    blob = json.dumps({"result": result}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
+                except Exception as e:  # one bad request must not kill
+                    self.send_error(500, str(e)[:200])  # the server
+
+        self._server_ = ThreadingHTTPServer((self.host, self.port),
+                                            Handler)
+        self.port = self._server_.server_address[1]
+        self._thread_ = threading.Thread(
+            target=self._server_.serve_forever, daemon=True,
+            name="restful-api")
+        self._thread_.start()
+        self.info("REST API on http://%s:%d/api", self.host, self.port)
+
+    def run(self):
+        futures = getattr(self.loader, "pending_futures_", [])
+        if not futures:
+            return
+        out = self.output
+        if isinstance(out, Array):
+            out.map_read()
+            out = out.mem
+        for i, future in enumerate(futures):
+            if not future.done():
+                future.set_result(numpy.asarray(out[i]).tolist())
+        self.loader.pending_futures_ = []
+
+    def stop(self):
+        if self._server_ is not None:
+            self._server_.shutdown()
+            self._server_ = None

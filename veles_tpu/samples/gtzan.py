@@ -1,0 +1,78 @@
+"""GTZAN genre recognition — BASELINE.json config 5.
+
+Audio tracks under ``root.gtzan_tpu.dataset_dir`` (GTZAN layout:
+``genres/<genre>/<track>.wav``) flow through the XML feature pipeline
+(samples/gtzan_features.xml; schema per the reference's
+veles/genre_recognition.xml) into an MLP classifier.
+
+Run: ``python -m veles_tpu veles_tpu/samples/gtzan.py \
+-c "root.gtzan_tpu.dataset_dir='/path/to/genres'"``
+"""
+
+import os
+
+from veles_tpu.config import root
+from veles_tpu.loader.sound import SoundLoader
+from veles_tpu.models.standard import StandardWorkflow
+
+FEATURES_XML = os.path.join(os.path.dirname(__file__),
+                            "gtzan_features.xml")
+
+
+class GtzanLoader(SoundLoader):
+    def __init__(self, workflow, **kwargs):
+        cfg = root.gtzan_tpu
+        dataset = cfg.get("dataset_dir")
+        if not dataset:
+            raise ValueError(
+                "set root.gtzan_tpu.dataset_dir to the GTZAN genres/ "
+                "directory")
+        super(GtzanLoader, self).__init__(
+            workflow,
+            features_xml=cfg.get("features_xml", FEATURES_XML),
+            train_paths=[dataset],
+            max_seconds=cfg.get("max_seconds", 30.0),
+            train_ratio=float(cfg.get("train_ratio", 1.0)),
+            **kwargs)
+
+    def load_data(self):
+        super(GtzanLoader, self).load_data()
+        # GTZAN ships train data only: carve a validation span off the
+        # front (the loader walks [test|valid|train])
+        valid_frac = float(root.gtzan_tpu.get("validation_ratio", 0.2))
+        n = self.class_lengths[2]
+        n_valid = int(n * valid_frac)
+        self.class_lengths[:] = [0, n_valid, n - n_valid]
+
+
+class GtzanWorkflow(StandardWorkflow):
+    def __init__(self, workflow, **kwargs):
+        cfg = root.gtzan_tpu
+        classes = int(cfg.get("classes", 10))
+        super(GtzanWorkflow, self).__init__(
+            workflow, name="GTZAN",
+            loader_factory=GtzanLoader,
+            loader_config={
+                "minibatch_size": int(cfg.get("minibatch_size", 50)),
+                "normalization_type": "mean_disp",
+            },
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": (
+                    int(cfg.get("hidden", 100)),)},
+                {"type": "softmax", "output_sample_shape": (classes,)},
+            ],
+            solver=cfg.get("solver", "adam"),
+            learning_rate=float(cfg.get("learning_rate", 0.001)),
+            decision_config={
+                "fail_iterations": int(cfg.get("fail_iterations", 50)),
+                "max_epochs": cfg.get("max_epochs"),
+            },
+            snapshotter_config={
+                "prefix": cfg.get("snapshot_prefix", "gtzan"),
+            },
+            **kwargs)
+
+
+def run(load, main):
+    load(GtzanWorkflow)
+    main()

@@ -1,0 +1,62 @@
+"""ZeroMQ streaming ingestion (rebuild of veles/zmq_loader.py:74-138 —
+the Mastodon bridge's job feed).
+
+A PULL socket receives pickled samples from any producer (the
+reference's JVM/Hadoop bridge; here any pyzmq PUSH peer) and serves
+them as minibatches through the InteractiveLoader machinery."""
+
+import pickle
+import threading
+
+from veles_tpu.loader.interactive import InteractiveLoader
+
+try:
+    import zmq
+    HAS_ZMQ = True
+except ImportError:  # pragma: no cover
+    HAS_ZMQ = False
+
+
+class ZeroMQLoader(InteractiveLoader):
+    """PULL-socket loader (ref: veles/zmq_loader.py:74).  Producers
+    ``send_pyobj(sample)``; ``send_pyobj(None)`` closes the stream."""
+
+    def __init__(self, workflow, endpoint=None, **kwargs):
+        super(ZeroMQLoader, self).__init__(workflow, **kwargs)
+        #: "tcp://host:port" to bind; None binds a random tcp port
+        self.endpoint = endpoint
+
+    def init_unpickled(self):
+        super(ZeroMQLoader, self).init_unpickled()
+        self._sock_ = None
+        self._recv_thread_ = None
+
+    def initialize(self, **kwargs):
+        if not HAS_ZMQ:  # pragma: no cover
+            raise RuntimeError("pyzmq is unavailable")
+        super(ZeroMQLoader, self).initialize(**kwargs)
+        if self._sock_ is not None:
+            return
+        ctx = zmq.Context.instance()
+        self._sock_ = ctx.socket(zmq.PULL)
+        if self.endpoint:
+            self._sock_.bind(self.endpoint)
+        else:
+            port = self._sock_.bind_to_random_port("tcp://127.0.0.1")
+            self.endpoint = "tcp://127.0.0.1:%d" % port
+        self.info("ZeroMQ ingestion on %s", self.endpoint)
+        self._recv_thread_ = threading.Thread(
+            target=self._receive_loop, daemon=True, name="zmq-ingest")
+        self._recv_thread_.start()
+
+    def _receive_loop(self):
+        while True:
+            try:
+                blob = self._sock_.recv()
+            except zmq.ZMQError:  # pragma: no cover - socket closed
+                break
+            sample = pickle.loads(blob)
+            if sample is None:
+                self.close()
+                break
+            self.feed(sample)
