@@ -1,0 +1,173 @@
+"""Supporting services: downloader, shell, avatar, publishing, forge,
+compare_snapshots (ref surfaces: downloader.py:56, interaction.py:49,
+avatar.py:22, publishing/publisher.py:57, forge/forge_client.py:91 +
+forge_server.py:462, scripts/)."""
+
+import gzip
+import json
+import os
+import pickle
+import tarfile
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.memory import Array
+
+
+# -- downloader ---------------------------------------------------------------
+
+def test_downloader_unpacks_local_archive(tmp_path):
+    from veles_tpu.downloader import Downloader
+    src = tmp_path / "payload"
+    src.mkdir()
+    (src / "data.txt").write_text("hello")
+    archive = tmp_path / "payload.tar.gz"
+    with tarfile.open(archive, "w:gz") as t:
+        t.add(src / "data.txt", arcname="data.txt")
+    dest = tmp_path / "dataset"
+    d = Downloader(None, url=str(archive), directory=str(dest),
+                   files=["data.txt"])
+    d.initialize()
+    assert (dest / "data.txt").read_text() == "hello"
+    # second initialize: no-op (already complete)
+    d2 = Downloader(None, url="/nonexistent", directory=str(dest),
+                    files=["data.txt"])
+    d2.initialize()
+
+
+def test_downloader_missing_file_fails(tmp_path):
+    from veles_tpu.downloader import Downloader
+    d = Downloader(None, url=str(tmp_path / "nope.tar"),
+                   directory=str(tmp_path / "out"), files=["x"])
+    with pytest.raises(FileNotFoundError):
+        d.initialize()
+
+
+# -- shell --------------------------------------------------------------------
+
+def test_shell_unit_hook_and_once():
+    from veles_tpu.interaction import Shell
+    calls = []
+    sh = Shell(None)
+    sh.interact_hook = lambda scope: calls.append(sorted(scope))
+    sh.run()
+    sh.run()  # once=True → second run is a no-op
+    assert calls == [["launcher", "unit", "workflow"]]
+
+
+# -- avatar -------------------------------------------------------------------
+
+def test_avatar_bridges_arrays():
+    pytest.importorskip("zmq")
+    import threading
+    from veles_tpu.avatar import Avatar, AvatarServer
+    weights = Array(numpy.arange(6, dtype=numpy.float32))
+    server = AvatarServer({"weights": weights})
+    t = threading.Thread(target=server.serve_once, daemon=True)
+    t.start()
+    avatar = Avatar(None, endpoint=server.endpoint, names=["weights"])
+    avatar.run()
+    t.join(5)
+    numpy.testing.assert_array_equal(
+        avatar.mirrors["weights"].mem, weights.mem)
+    # source mutates; next pull sees it
+    weights.map_write()
+    weights.mem[0] = 99
+    t = threading.Thread(target=server.serve_once, daemon=True)
+    t.start()
+    avatar.run()
+    t.join(5)
+    assert avatar.mirrors["weights"].mem[0] == 99
+    server.close()
+
+
+# -- publishing ---------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def trained_wf():
+    from veles_tpu.config import root
+    from veles_tpu.samples.mnist import MnistWorkflow
+    root.mnist_tpu.update({
+        "max_epochs": 1, "synthetic_train": 256, "synthetic_valid": 64,
+        "minibatch_size": 64, "snapshot_time_interval": 1e9,
+    })
+    wf = MnistWorkflow(None, layers=[16, 10])
+    wf.snapshotter.interval = 10**9
+    wf.snapshotter.time_interval = 10**9
+    for p in wf.plotters:
+        p.collect = True
+    wf.initialize(device=Device(backend="numpy"))
+    wf.run()
+    return wf
+
+
+@pytest.mark.parametrize("backend,ext", [
+    ("markdown", ".md"), ("html", ".html"), ("notebook", ".ipynb")])
+def test_publisher_backends(trained_wf, tmp_path, backend, ext):
+    from veles_tpu.publishing import Publisher
+    pub = Publisher(trained_wf, backend=backend,
+                    output_dir=str(tmp_path))
+    pub.run()
+    assert pub.destination.endswith(ext)
+    content = open(pub.destination).read()
+    assert "MNIST" in content
+    if backend == "markdown":
+        assert "validation_error_pct" in content
+    if backend == "notebook":
+        json.loads(content)  # valid ipynb JSON
+
+
+# -- forge --------------------------------------------------------------------
+
+def test_forge_roundtrip(tmp_path):
+    from veles_tpu.forge import ForgeServer, fetch, list_packages, upload
+    server = ForgeServer(str(tmp_path / "store")).start()
+    try:
+        pkg = tmp_path / "model.tar.gz"
+        with tarfile.open(pkg, "w:gz") as t:
+            manifest = tmp_path / "contents.json"
+            manifest.write_text('{"workflow": "m"}')
+            t.add(manifest, arcname="contents.json")
+        meta = upload(server.url, "mnist-mlp", "1.0", str(pkg),
+                      "test model")
+        assert meta["name"] == "mnist-mlp" and meta["size"] > 0
+        upload(server.url, "mnist-mlp", "1.1", str(pkg), "newer")
+        listing = list_packages(server.url)
+        assert [m["version"] for m in listing
+                if m["name"] == "mnist-mlp"] == ["1.0", "1.1"]
+        # latest resolution
+        path, version = fetch(server.url, "mnist-mlp", str(tmp_path))
+        assert version == "1.1" and os.path.getsize(path) > 0
+        with tarfile.open(path) as t:
+            assert "contents.json" in t.getnames()
+    finally:
+        server.stop()
+
+
+def test_forge_rejects_bad_names(tmp_path):
+    from veles_tpu.forge.server import ForgeStore
+    store = ForgeStore(str(tmp_path))
+    with pytest.raises(ValueError):
+        store.save("../evil", "1.0", b"x", {})
+
+
+# -- compare_snapshots --------------------------------------------------------
+
+def test_compare_snapshots(trained_wf, tmp_path, capsys):
+    from veles_tpu.scripts.compare_snapshots import main
+    a = str(tmp_path / "a.pickle.gz")
+    with gzip.open(a, "wb") as f:
+        pickle.dump(trained_wf, f)
+    assert main([a, a]) == 0
+    out = capsys.readouterr().out
+    assert "identical" in out
+    # perturb one weight → diverged
+    trained_wf.forwards[0].weights.map_write()
+    trained_wf.forwards[0].weights.mem[0, 0] += 1.0
+    b = str(tmp_path / "b.pickle.gz")
+    with gzip.open(b, "wb") as f:
+        pickle.dump(trained_wf, f)
+    assert main([a, b]) == 1
+    assert "diverged" in capsys.readouterr().out

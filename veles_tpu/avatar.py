@@ -1,0 +1,95 @@
+"""Avatar — cross-workflow Array bridging (rebuild of
+veles/avatar.py:22).
+
+One workflow exposes chosen Arrays through an :class:`AvatarServer`
+(ZMQ REP); an :class:`Avatar` unit in another process/workflow pulls
+fresh copies each run.  The reference used the same shape to let a
+secondary workflow observe a primary's tensors without sharing memory.
+"""
+
+import pickle
+
+from veles_tpu.logger import Logger
+from veles_tpu.memory import Array
+from veles_tpu.units import Unit
+
+try:
+    import zmq
+    HAS_ZMQ = True
+except ImportError:  # pragma: no cover
+    HAS_ZMQ = False
+
+
+class AvatarServer(Logger):
+    """REP endpoint serving {name: Array} snapshots on demand."""
+
+    def __init__(self, arrays, port=0, host="127.0.0.1"):
+        super(AvatarServer, self).__init__()
+        if not HAS_ZMQ:  # pragma: no cover
+            raise RuntimeError("pyzmq is unavailable")
+        self.arrays = dict(arrays)
+        self._sock = zmq.Context.instance().socket(zmq.REP)
+        if port:
+            self._sock.bind("tcp://%s:%d" % (host, port))
+            self.port = port
+        else:
+            self.port = self._sock.bind_to_random_port("tcp://" + host)
+        self.endpoint = "tcp://%s:%d" % (host, self.port)
+        self.info("avatar server on %s", self.endpoint)
+
+    def serve_once(self, timeout=5000):
+        """Answer one request; returns False on timeout."""
+        if not self._sock.poll(timeout):
+            return False
+        names = pickle.loads(self._sock.recv())
+        payload = {}
+        for name in names or self.arrays:
+            arr = self.arrays.get(name)
+            if isinstance(arr, Array):
+                payload[name] = arr.map_read().mem
+        self._sock.send(pickle.dumps(
+            payload, protocol=pickle.HIGHEST_PROTOCOL))
+        return True
+
+    def close(self):
+        self._sock.close(0)
+
+
+class Avatar(Unit):
+    """Pulls remote Arrays into local mirrors each run
+    (ref: veles/avatar.py:22)."""
+
+    VIEW_GROUP = "SERVICE"
+
+    def __init__(self, workflow, endpoint=None, names=(), timeout=5.0,
+                 **kwargs):
+        super(Avatar, self).__init__(workflow, **kwargs)
+        self.endpoint = endpoint
+        self.names = list(names)
+        self.timeout = timeout
+        #: name -> local Array mirror, created on first fetch
+        self.mirrors = {}
+        self.demand("endpoint")
+
+    def init_unpickled(self):
+        super(Avatar, self).init_unpickled()
+        self._sock_ = None
+
+    def _connect(self):
+        if not HAS_ZMQ:  # pragma: no cover
+            raise RuntimeError("pyzmq is unavailable")
+        if self._sock_ is None:
+            self._sock_ = zmq.Context.instance().socket(zmq.REQ)
+            self._sock_.connect(self.endpoint)
+
+    def run(self):
+        self._connect()
+        self._sock_.send(pickle.dumps(self.names or None))
+        if not self._sock_.poll(self.timeout * 1000):
+            raise TimeoutError("avatar source %s silent" % self.endpoint)
+        payload = pickle.loads(self._sock_.recv())
+        for name, mem in payload.items():
+            mirror = self.mirrors.get(name)
+            if mirror is None:
+                mirror = self.mirrors[name] = Array()
+            mirror.reset(mem)

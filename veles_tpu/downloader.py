@@ -1,0 +1,91 @@
+"""Downloader unit (rebuild of veles/downloader.py:56): fetches and
+unpacks a dataset archive at initialize() when the target directory is
+missing.  Sources: local paths, ``file://`` and ``http(s)://`` URLs
+(the build environment is zero-egress — URL fetches are expected to be
+used on user machines)."""
+
+import os
+import shutil
+import tarfile
+import urllib.parse
+import urllib.request
+import zipfile
+
+from veles_tpu.config import root
+from veles_tpu.units import Unit
+
+
+class Downloader(Unit):
+    """Ensures ``directory`` exists, downloading+unpacking ``url`` if
+    not (ref: veles/downloader.py:56 — it shelled out to wget)."""
+
+    VIEW_GROUP = "SERVICE"
+
+    def __init__(self, workflow, url=None, directory=None, files=(),
+                 **kwargs):
+        super(Downloader, self).__init__(workflow, **kwargs)
+        self.url = url
+        self.directory = directory
+        #: files expected inside directory (presence check)
+        self.files = list(files)
+        self.demand("url", "directory")
+
+    @property
+    def _complete(self):
+        if not os.path.isdir(self.directory):
+            return False
+        return all(os.path.exists(os.path.join(self.directory, f))
+                   for f in self.files)
+
+    def initialize(self, **kwargs):
+        super(Downloader, self).initialize(**kwargs)
+        if self._complete:
+            self.debug("%s already present", self.directory)
+            return
+        os.makedirs(self.directory, exist_ok=True)
+        archive = self._fetch()
+        try:
+            self._unpack(archive)
+        finally:
+            if archive != self.url:
+                try:
+                    os.unlink(archive)
+                except OSError:
+                    pass
+        if not self._complete:
+            raise RuntimeError(
+                "%s: archive did not provide expected files %s"
+                % (self, self.files))
+
+    def _fetch(self):
+        scheme = urllib.parse.urlparse(str(self.url)).scheme
+        if scheme in ("", "file"):
+            path = urllib.parse.urlparse(str(self.url)).path \
+                if scheme == "file" else self.url
+            if not os.path.isfile(path):
+                raise FileNotFoundError(path)
+            return path
+        cache = root.common.dirs.get("cache", ".")
+        os.makedirs(cache, exist_ok=True)
+        target = os.path.join(
+            cache, os.path.basename(urllib.parse.urlparse(
+                self.url).path) or "download")
+        self.info("downloading %s -> %s", self.url, target)
+        with urllib.request.urlopen(self.url) as r, \
+                open(target, "wb") as f:
+            shutil.copyfileobj(r, f)
+        return target
+
+    def _unpack(self, archive):
+        self.info("unpacking %s -> %s", archive, self.directory)
+        if zipfile.is_zipfile(archive):
+            with zipfile.ZipFile(archive) as z:
+                z.extractall(self.directory)
+        elif tarfile.is_tarfile(archive):
+            with tarfile.open(archive) as t:
+                t.extractall(self.directory, filter="data")
+        else:
+            shutil.copy(archive, self.directory)
+
+    def run(self):
+        pass  # all the work happens at initialize

@@ -1,0 +1,6 @@
+"""forge — the model hub (rebuild of veles/forge/): share trained
+model packages (the package_export archive format) through a central
+server with versioning."""
+
+from veles_tpu.forge.client import fetch, list_packages, upload  # noqa: F401
+from veles_tpu.forge.server import ForgeServer, ForgeStore  # noqa: F401

@@ -1,0 +1,5 @@
+import sys
+
+from veles_tpu.forge.client import main
+
+sys.exit(main())

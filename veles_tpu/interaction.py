@@ -1,0 +1,39 @@
+"""Interaction — the Shell unit (rebuild of veles/interaction.py:49):
+drops into a live REPL mid-graph with the workflow in scope.  IPython
+when importable, stdlib ``code.interact`` otherwise; ``gate_skip``
+makes it a no-op until a debugging session flips the gate."""
+
+from veles_tpu.units import Unit
+
+
+class Shell(Unit):
+    """Interactive break-point unit (ref: veles/interaction.py:49)."""
+
+    VIEW_GROUP = "SERVICE"
+
+    def __init__(self, workflow, banner=None, once=True, **kwargs):
+        super(Shell, self).__init__(workflow, **kwargs)
+        self.banner = banner or (
+            "veles_tpu shell — `workflow`, `unit` are live; Ctrl-D "
+            "resumes the graph")
+        #: open the shell only on the first run (default) or every run
+        self.once = once
+        self._fired = False
+        #: tests inject a callable instead of a real terminal session
+        self.interact_hook = None
+
+    def run(self):
+        if self.once and self._fired:
+            return
+        self._fired = True
+        scope = {"workflow": self._workflow, "unit": self,
+                 "launcher": getattr(self._workflow, "launcher", None)}
+        if self.interact_hook is not None:
+            self.interact_hook(scope)
+            return
+        try:  # pragma: no cover - interactive only
+            from IPython import embed
+            embed(banner1=self.banner, user_ns=scope)
+        except ImportError:  # pragma: no cover
+            import code
+            code.interact(banner=self.banner, local=scope)

@@ -37,7 +37,7 @@ class FullBatchHDF5Loader(FullBatchLoader):
 
     def load_data(self):
         _require_h5py()
-        datas, labels = [], []
+        datas, labels, labelled = [], [], []
         for ci, path in enumerate(self.class_files):
             if not path:
                 self.class_lengths[ci] = 0
@@ -46,11 +46,18 @@ class FullBatchHDF5Loader(FullBatchLoader):
                 d = numpy.asarray(f[self.data_name])
                 datas.append(d)
                 self.class_lengths[ci] = len(d)
-                if self.labels_name in f:
+                has = self.labels_name in f
+                labelled.append(has)
+                if has:
                     labels.extend(numpy.asarray(f[self.labels_name])
                                   .tolist())
         if not datas:
             raise ValueError("%s: no HDF5 files given" % self)
+        if labels and not all(labelled):
+            # partial labels would silently shift every row's label
+            raise ValueError(
+                "%s: %r present in some class files but not all"
+                % (self, self.labels_name))
         self.original_data = numpy.concatenate(datas).astype(
             numpy.float32)
         if labels:

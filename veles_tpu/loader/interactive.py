@@ -70,6 +70,9 @@ class InteractiveLoader(Loader):
         try:
             first = self._queue_.get(timeout=self.max_wait)
         except queue.Empty:
+            # idle feed: serve an empty minibatch WITHOUT closing — only
+            # close() ends the stream (an idle REST endpoint must keep
+            # serving later requests)
             first = None
         if first is not None:
             samples.append(first)
@@ -82,8 +85,6 @@ class InteractiveLoader(Loader):
                     self._closed_ = True
                     break
                 samples.append(s)
-        else:
-            self._closed_ = True
         self.minibatch_class = TEST
         self.minibatch_size = len(samples)
         self.minibatch_data.map_invalidate()

@@ -1,0 +1,13 @@
+"""publishing — end-of-train report generation (rebuild of
+veles/publishing/: Publisher unit + pluggable backends).
+
+The reference rendered to Confluence, Markdown, LaTeX/PDF and IPython
+notebooks (publishing/*_backend.py); the rebuild keeps the
+backend-registry shape with Markdown, HTML and notebook backends (the
+Confluence uploader is out of scope in a zero-egress build — its slot
+in the registry is where it would land).
+"""
+
+from veles_tpu.publishing.publisher import Publisher  # noqa: F401
+from veles_tpu.publishing.backends import (  # noqa: F401
+    BACKENDS, HTMLBackend, MarkdownBackend, NotebookBackend)

@@ -1,0 +1,124 @@
+"""Report rendering backends (rebuild of veles/publishing/*_backend.py
++ registry.py).  Each backend renders the Publisher's payload dict to a
+file and returns its path."""
+
+import json
+import os
+
+
+def _slug(name):
+    return "".join(c if c.isalnum() else "_" for c in name).lower()
+
+
+def _metrics_rows(metrics):
+    return [(k, v) for k, v in sorted(metrics.items())]
+
+
+class MarkdownBackend:
+    """ref: publishing/markdown_backend.py role."""
+
+    NAME = "markdown"
+    EXT = ".md"
+
+    def render(self, payload, out_dir):
+        lines = ["# %s" % payload["title"], "",
+                 "- workflow: `%s` (%s)" % (payload["workflow"],
+                                            payload["workflow_class"]),
+                 "- generated: %s" % payload["generated"],
+                 "- checksum: `%s`" % payload["checksum"][:16], "",
+                 "## Metrics", "",
+                 "| metric | value |", "|---|---|"]
+        for k, v in _metrics_rows(payload["metrics"]):
+            lines.append("| %s | %s |" % (k, v))
+        lines += ["", "## Unit timings", "",
+                  "| unit | class | runs | seconds |", "|---|---|---|---|"]
+        for u in payload["units"]:
+            lines.append("| %s | %s | %d | %.4f |"
+                         % (u["name"], u["class"], u["runs"],
+                            u["seconds"]))
+        if payload.get("plots"):
+            lines += ["", "## Plots", ""]
+            for name, plot in sorted(payload["plots"].items()):
+                lines.append("- **%s** (%s)" % (name, plot.get("kind")))
+        lines += ["", "## Workflow graph", "", "```dot",
+                  payload["graph_dot"], "```", ""]
+        path = os.path.join(out_dir,
+                            _slug(payload["workflow"]) + "_report.md")
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+        return path
+
+
+class HTMLBackend:
+    """Standalone HTML page; plots render as PNGs beside it when
+    matplotlib is available."""
+
+    NAME = "html"
+    EXT = ".html"
+
+    def render(self, payload, out_dir):
+        imgs = []
+        try:
+            from veles_tpu.graphics_client import render_payload
+            for name, plot in sorted(payload.get("plots", {}).items()):
+                png = os.path.join(
+                    out_dir, "%s_%s.png" % (_slug(payload["workflow"]),
+                                            _slug(name)))
+                render_payload(plot).savefig(png)
+                imgs.append((name, os.path.basename(png)))
+        except Exception:  # plots are garnish; the report must land
+            imgs = []
+        rows = "".join("<tr><td>%s</td><td>%s</td></tr>" % kv
+                       for kv in _metrics_rows(payload["metrics"]))
+        figures = "".join(
+            '<figure><img src="%s" alt="%s"/><figcaption>%s'
+            "</figcaption></figure>" % (src, name, name)
+            for name, src in imgs)
+        html = (
+            "<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<title>%s</title></head><body><h1>%s</h1>"
+            "<p>%s — generated %s</p>"
+            "<h2>Metrics</h2><table>%s</table>%s</body></html>"
+            % (payload["title"], payload["title"], payload["workflow"],
+               payload["generated"], rows, figures))
+        path = os.path.join(out_dir,
+                            _slug(payload["workflow"]) + "_report.html")
+        with open(path, "w") as f:
+            f.write(html)
+        return path
+
+
+class NotebookBackend:
+    """Jupyter notebook (ref: publishing/ipython_backend.py role): one
+    markdown summary cell + a code cell reloading the metrics."""
+
+    NAME = "notebook"
+    EXT = ".ipynb"
+
+    def render(self, payload, out_dir):
+        md = ["# %s\n" % payload["title"],
+              "%s — generated %s\n" % (payload["workflow"],
+                                       payload["generated"]),
+              "\n## Metrics\n"]
+        md += ["- **%s**: %s\n" % kv
+               for kv in _metrics_rows(payload["metrics"])]
+        nb = {
+            "nbformat": 4, "nbformat_minor": 5,
+            "metadata": {"language_info": {"name": "python"}},
+            "cells": [
+                {"cell_type": "markdown", "metadata": {}, "source": md},
+                {"cell_type": "code", "metadata": {},
+                 "execution_count": None, "outputs": [],
+                 "source": ["metrics = %r\n" % payload["metrics"],
+                            "metrics\n"]},
+            ],
+        }
+        path = os.path.join(out_dir,
+                            _slug(payload["workflow"]) + "_report.ipynb")
+        with open(path, "w") as f:
+            json.dump(nb, f, indent=1, default=str)
+        return path
+
+
+BACKENDS = {b.NAME: b for b in (MarkdownBackend, HTMLBackend,
+                                NotebookBackend)}

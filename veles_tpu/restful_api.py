@@ -28,14 +28,23 @@ class RestfulLoader(InteractiveLoader):
 
     def init_unpickled(self):
         super(RestfulLoader, self).init_unpickled()
-        self._futures_ = {}
         self._fifo_ = []
+        self._feed_lock_ = threading.Lock()
         self.pending_futures_ = []
 
     def feed_request(self, sample):
+        # validate BEFORE registering the future, and register+enqueue
+        # atomically — concurrent HTTP threads must keep the reply FIFO
+        # aligned with the sample queue, and a rejected sample must not
+        # leave an orphan future shifting every later reply
+        sample = numpy.asarray(sample, numpy.float32)
+        if sample.shape != self.sample_shape:
+            raise ValueError("sample shape %s != %s"
+                             % (sample.shape, self.sample_shape))
         future = concurrent.futures.Future()
-        self._fifo_.append(future)
-        self.feed(sample)
+        with self._feed_lock_:
+            self._fifo_.append(future)
+            self.feed(sample)
         return future
 
     def run(self):

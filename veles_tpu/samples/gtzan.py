@@ -36,11 +36,17 @@ class GtzanLoader(SoundLoader):
             **kwargs)
 
     def load_data(self):
+        import numpy
         super(GtzanLoader, self).load_data()
-        # GTZAN ships train data only: carve a validation span off the
-        # front (the loader walks [test|valid|train])
+        # GTZAN ships train data only: carve a validation span off a
+        # SHUFFLED order (directory scan is genre-sorted — an unshuffled
+        # front span would be entirely the alphabetically-first genres,
+        # and Loader.shuffle() only permutes the train span)
         valid_frac = float(root.gtzan_tpu.get("validation_ratio", 0.2))
         n = self.class_lengths[2]
+        perm = numpy.random.default_rng(42).permutation(n)
+        self.original_data = self.original_data[perm]
+        self.original_labels = [self.original_labels[i] for i in perm]
         n_valid = int(n * valid_frac)
         self.class_lengths[:] = [0, n_valid, n - n_valid]
 

@@ -1,0 +1,5 @@
+"""scripts — operational command-line tools (rebuild of
+veles/scripts/): compare_snapshots (parameter diffing).  The
+reference's bboxer image-labeling web tool and frontend generator are
+web assets outside this rebuild's scope; forge maintenance lives in
+``python -m veles_tpu.forge``."""

@@ -55,8 +55,13 @@ class ZeroMQLoader(InteractiveLoader):
                 blob = self._sock_.recv()
             except zmq.ZMQError:  # pragma: no cover - socket closed
                 break
-            sample = pickle.loads(blob)
-            if sample is None:
-                self.close()
-                break
-            self.feed(sample)
+            try:
+                sample = pickle.loads(blob)
+                if sample is None:
+                    self.close()
+                    break
+                self.feed(sample)
+            except Exception as e:
+                # one malformed producer frame must not kill the ingest
+                # thread (and with it the whole stream)
+                self.warning("dropped bad ingest frame: %s", e)
