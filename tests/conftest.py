@@ -4,9 +4,17 @@ All tests run on CPU with 8 virtual XLA devices so mesh/sharding tests
 exercise real multi-device code paths without TPU hardware
 (SURVEY.md §4: the JAX equivalent of the reference's loopback
 master+slave-in-one-process tests, veles/tests/test_network.py:52-149).
+
+The TPU-tunnel sitecustomize (PALLAS_AXON_POOL_IPS) registers a PJRT
+plugin at interpreter start that can pin the CPU platform to ONE device
+regardless of XLA_FLAGS/jax config — and by then it is irreversible
+in-process.  pytest_configure re-execs pytest once with the plugin
+scrubbed (after stopping pytest's fd capture, or the child's output
+would vanish into the orphaned capture tempfiles).
 """
 
 import os
+import sys
 
 # hard-set, not setdefault: the ambient environment may select a TPU
 # platform (e.g. JAX_PLATFORMS=axon) and tests must stay on virtual CPUs
@@ -15,3 +23,25 @@ xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _needs_reexec():
+    if os.environ.get("VELES_TPU_TEST_REEXEC") == "1":
+        return False
+    return bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+
+
+def pytest_configure(config):
+    if not _needs_reexec():
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()  # restore the real stdout fds
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["VELES_TPU_TEST_REEXEC"] = "1"
+    # invocation_params.args is correct for every entry mode (CLI,
+    # python -m pytest, programmatic pytest.main)
+    args = list(config.invocation_params.args)
+    os.execve(sys.executable,
+              [sys.executable, "-m", "pytest"] + args, env)

@@ -34,6 +34,7 @@ from veles_tpu.models.pooling import (  # noqa: F401
     AvgPooling, Depooling, MaxPooling)
 from veles_tpu.models.dropout import DropoutForward  # noqa: F401
 from veles_tpu.models.lrn import LRNormalizerForward  # noqa: F401
+from veles_tpu.models.attention import MultiHeadAttention  # noqa: F401
 from veles_tpu.models.evaluator import (  # noqa: F401
     EvaluatorMSE, EvaluatorSoftmax)
 from veles_tpu.models.gd import GradientDescent  # noqa: F401

@@ -1,0 +1,62 @@
+"""Multi-head attention forward unit — the sequence-model entry of the
+zoo (no reference analogue: RNN/LSTM existed only untested in the
+absent Znicz submodule, manualrst_veles_algorithms.rst:115-140).
+
+This unit's ``apply`` is the single-program formulation (XLA/GSPMD
+shards it like any other op).  For long contexts where each chip must
+hold only 1/sp of K/V, use veles_tpu.ops.attention.ring_attention_
+sharded explicitly — the ring is a different communication schedule,
+not something sharding propagation derives from this op."""
+
+import numpy
+
+from veles_tpu.models.nn_units import ForwardBase
+from veles_tpu.ops.gemm import matmul
+
+
+class MultiHeadAttention(ForwardBase):
+    """y = (softmax(QK^T/sqrt(d)) V) Wo with Q/K/V = x·Wq/Wk/Wv.
+
+    x: [batch, seq, model_dim]."""
+
+    PARAMS = ("wq", "wk", "wv", "wo")
+
+    def __init__(self, workflow, heads=4, causal=False, **kwargs):
+        from veles_tpu.memory import Array
+        super(MultiHeadAttention, self).__init__(workflow, **kwargs)
+        self.heads = int(heads)
+        self.causal = causal
+        for p in self.PARAMS:
+            setattr(self, p, Array())
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def fill_params(self):
+        d = self.input.shape[-1]
+        if d % self.heads:
+            raise ValueError("model dim %d not divisible by %d heads"
+                             % (d, self.heads))
+        for p in self.PARAMS:
+            arr = getattr(self, p)
+            arr.reset(numpy.zeros((d, d), numpy.float32))
+            self._fill(arr.mem, self.weights_filling,
+                       self.weights_stddev, d, d)
+
+    def export_config(self):
+        return {"heads": self.heads, "causal": self.causal}
+
+    def _project(self, w, x):
+        b, s, d = x.shape
+        y = matmul(x.reshape(b * s, d), w, out_dtype=x.dtype)
+        return y.reshape(b, s, self.heads, d // self.heads)
+
+    def apply(self, params, x):
+        from veles_tpu.ops.attention import attention
+        q = self._project(params["wq"], x)
+        k = self._project(params["wk"], x)
+        v = self._project(params["wv"], x)
+        o = attention(q, k, v, causal=self.causal)
+        b, s, d = x.shape
+        return matmul(o.reshape(b * s, d), params["wo"],
+                      out_dtype=x.dtype).reshape(b, s, d)

@@ -110,7 +110,11 @@ class ForwardBase(AcceleratedUnit):
     def initialize(self, device=None, **kwargs):
         if not isinstance(self.input, Array) or not bool(self.input):
             raise MissingDemand(self, {"input"})
-        if not bool(self.weights):  # not restored from snapshot
+        # fill only when NO param is populated (i.e. not restored from a
+        # snapshot) — checked across PARAMS, not just "weights", so units
+        # with custom param sets (e.g. attention's wq/wk/wv/wo) keep
+        # their restored values too
+        if not any(bool(getattr(self, p)) for p in self.PARAMS):
             self.fill_params()
         out_shape = self.output_shape_for(self.input.shape)
         self.output.reset(numpy.zeros(out_shape, numpy.float32))
