@@ -57,8 +57,19 @@ class Main:
 
     def _load(self, workflow_class, **kwargs):
         if self.args.snapshot:
-            self.workflow = SnapshotterToFile.import_file(
-                self.args.snapshot)
+            snap = self.args.snapshot
+            if snap.startswith(("sqlite:", "odbc:")):
+                # DB resume (ref odbc:// URIs, __main__.py:539-589);
+                # optional "#table/prefix" suffix selects the store
+                from veles_tpu.snapshotter import SnapshotterToDB
+                dsn, _, frag = snap.partition("#")
+                table, _, prefix = frag.partition("/")
+                if dsn.startswith("odbc:"):
+                    dsn = dsn[5:]
+                self.workflow = SnapshotterToDB.import_db(
+                    dsn, table=table or "veles", prefix=prefix or None)
+            else:
+                self.workflow = SnapshotterToFile.import_file(snap)
             self.workflow.workflow = self.launcher
             self.restored = True
             logging.getLogger("Main").info(
@@ -167,7 +178,8 @@ class Main:
             listen=self.args.listen,
             master_address=self.args.master_address,
             graphics=self.args.graphics or None,
-            status_url=self.args.web_status)
+            status_url=self.args.web_status,
+            profile_dir=self.args.profile)
         module = import_file_as_module(self.args.workflow)
         if not hasattr(module, "run"):
             print("workflow file must define run(load, main)",

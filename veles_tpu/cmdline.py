@@ -74,6 +74,10 @@ def build_parser():
                    help="-v debug, -vv everything")
     p.add_argument("--timings", action="store_true",
                    help="per-unit run timing printout")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the run into "
+                        "DIR (view with tensorboard / xprof); also "
+                        "annotates each unit run")
     for fn in EXTRA_PARSERS:
         fn(p)
     return p

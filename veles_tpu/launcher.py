@@ -24,7 +24,7 @@ class Launcher(Logger):
 
     def __init__(self, backend=None, device_index=0, listen=None,
                  master_address=None, graphics=None, status_url=None,
-                 **kwargs):
+                 profile_dir=None, **kwargs):
         super(Launcher, self).__init__()
         self._listen = listen
         self._master_address = master_address
@@ -39,6 +39,8 @@ class Launcher(Logger):
         self.coordinator = None
         self.graphics_server = None
         self.status_notifier = None
+        self._profile_dir = profile_dir
+        self._profiling = False
 
     # -- mode (ref: launcher.py:333-356) --------------------------------------
 
@@ -109,6 +111,15 @@ class Launcher(Logger):
             from veles_tpu.web_status import StatusNotifier
             self.status_notifier = StatusNotifier(status_url, self)
             self.status_notifier.start()
+        if self._profile_dir:
+            # device-level trace of the whole run (SURVEY.md §5: the
+            # fused programs need jax.profiler, not host wall timers);
+            # per-unit TraceAnnotations ride root.common.trace.run
+            import jax.profiler
+            root.common.trace.run = True
+            jax.profiler.start_trace(self._profile_dir)
+            self._profiling = True
+            self.info("jax.profiler trace -> %s", self._profile_dir)
         try:
             if self.is_standalone:
                 self.workflow.run()
@@ -129,6 +140,10 @@ class Launcher(Logger):
         if self.stopped:
             return
         self.stopped = True
+        if self._profiling:
+            import jax.profiler
+            jax.profiler.stop_trace()
+            self._profiling = False
         if self.status_notifier is not None:
             self.status_notifier.stop()
         if self.graphics_server is not None:

@@ -77,19 +77,24 @@ class All2AllSigmoid(All2All):
 
 class All2AllSoftmax(All2All):
     """FC + softmax head (znicz All2AllSoftmax): ``output`` holds the
-    probabilities, ``max_idx`` the argmax per sample."""
+    probabilities, ``max_idx`` the argmax per sample, ``logits_out``
+    the pre-softmax scores (evaluators compute the CE loss from these —
+    reconstructing logits as log(probs) loses precision)."""
 
     ACTIVATION = "linear"
-    WRITES = ("output", "max_idx")
+    WRITES = ("output", "max_idx", "logits_out")
 
     def __init__(self, workflow, **kwargs):
         super(All2AllSoftmax, self).__init__(workflow, **kwargs)
         self.max_idx = Array()
+        self.logits_out = Array()
 
     def initialize(self, device=None, **kwargs):
         super(All2AllSoftmax, self).initialize(device=device, **kwargs)
         self.max_idx.reset(numpy.zeros((self.input.shape[0],),
                                        numpy.int32))
+        self.logits_out.reset(numpy.zeros(self.output.shape,
+                                          numpy.float32))
 
     def logits(self, params, x):
         """Pre-softmax scores — the trainer's softmax-CE loss composes
@@ -102,6 +107,9 @@ class All2AllSoftmax(All2All):
         return probs / jnp.sum(probs, axis=-1, keepdims=True)
 
     def step(self, input, **params):
-        probs = self.apply(params, input)
+        z = self.logits(params, input)
+        probs = jnp.exp(z - jnp.max(z, axis=-1, keepdims=True))
+        probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
         return {"output": probs,
-                "max_idx": jnp.argmax(probs, axis=-1).astype(jnp.int32)}
+                "max_idx": jnp.argmax(probs, axis=-1).astype(jnp.int32),
+                "logits_out": z.astype(jnp.float32)}

@@ -144,6 +144,9 @@ class StandardWorkflow(AcceleratedWorkflow):
         else:
             self.evaluator = EvaluatorSoftmax(self)
             self.evaluator.labels = self.loader.minibatch_labels
+            if isinstance(self.forwards[-1], All2AllSoftmax):
+                # exact in-graph loss from the head's real logits
+                self.evaluator.logits = self.forwards[-1].logits_out
         self.evaluator.output = self.forwards[-1].output
         self.evaluator.loader = self.loader
 

@@ -15,6 +15,15 @@ import jax
 import jax.numpy as jnp
 
 
+def _pvary(x, axis_name):
+    """Mark a fresh (axis-invariant) value as varying over axis_name —
+    pcast on new JAX, pvary fallback on older releases."""
+    try:
+        return jax.lax.pcast(x, axis_name, to="varying")
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(x, (axis_name,))
+
+
 def attention(q, k, v, causal=False, scale=None):
     """Reference attention on one chip.  q/k/v: [..., seq, heads, dim]
     (seq-major layout keeps the sp sharding a leading-dim spec)."""
@@ -103,7 +112,7 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     o0 = jnp.zeros(q.shape, q.dtype)
     # freshly-created carries are axis-invariant constants; the scan
     # outputs vary over the ring axis — align the types up front
-    m0, s0, o0 = (jax.lax.pvary(t, (axis_name,)) for t in (m0, s0, o0))
+    m0, s0, o0 = (_pvary(t, axis_name) for t in (m0, s0, o0))
     (acc, _, _), _ = jax.lax.scan(
         body, ((m0, s0, o0), (k, v), my_idx), None, length=n)
     m, s, o = acc

@@ -14,6 +14,15 @@ import jax
 import jax.numpy as jnp
 
 
+def _pvary(x, axis_name):
+    """Mark a fresh (axis-invariant) value as varying over axis_name —
+    pcast on new JAX, pvary fallback on older releases."""
+    try:
+        return jax.lax.pcast(x, axis_name, to="varying")
+    except (AttributeError, TypeError):
+        return jax.lax.pvary(x, (axis_name,))
+
+
 def split_stages(n_layers, n_stages):
     """Contiguous layer→stage assignment: [n_stages] lists of layer
     indices, balanced within ±1 (the first n_layers %% n_stages stages
@@ -58,9 +67,9 @@ def gpipe_apply(stage_fn, stacked_params, microbatches, axis_name):
 
     # replicated-input zeros become stage-varying through the loop —
     # align the carry types for the new shard_map varying-axis checks
-    h0 = jax.lax.pvary(jnp.zeros_like(microbatches[0]), (axis_name,))
-    outputs0 = jax.lax.pvary(jnp.zeros_like(microbatches), (axis_name,))
-    microbatches = jax.lax.pvary(microbatches, (axis_name,))
+    h0 = _pvary(jnp.zeros_like(microbatches[0]), axis_name)
+    outputs0 = _pvary(jnp.zeros_like(microbatches), axis_name)
+    microbatches = _pvary(microbatches, axis_name)
 
     def body(carry, t):
         recv, outputs = carry
@@ -92,6 +101,11 @@ def pipeline_forward(mesh, stage_fn, per_stage_params, x, n_micro,
     from jax.sharding import NamedSharding, PartitionSpec as P
     from jax import shard_map
 
+    if len(per_stage_params) != mesh.shape[axis]:
+        raise ValueError(
+            "%d stages != %s axis size %d — each mesh position holds "
+            "exactly one stage (group layers with split_stages first)"
+            % (len(per_stage_params), axis, mesh.shape[axis]))
     if x.shape[0] % n_micro:
         raise ValueError("batch %d not divisible into %d microbatches"
                          % (x.shape[0], n_micro))

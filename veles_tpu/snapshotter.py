@@ -130,34 +130,109 @@ class SnapshotterToFile(SnapshotterBase):
 
 
 class SnapshotterToDB(SnapshotterBase):
-    """ODBC-backed snapshot store (ref: snapshotter.py:428-518); import
-    guard keeps the capability declared even where pyodbc is absent."""
+    """Database-backed snapshot store (ref: snapshotter.py:428-518 — the
+    reference spoke ODBC).  DB-API backends: ``sqlite:<path>`` (stdlib,
+    the tested default) or an ODBC connection string via pyodbc when
+    installed.  The table name is validated as an identifier (it cannot
+    ride a parameter marker in DDL)."""
 
     def __init__(self, workflow, odbc=None, table="veles", **kwargs):
         super(SnapshotterToDB, self).__init__(workflow, **kwargs)
         self.odbc = odbc
+        if not table.isidentifier():
+            raise ValueError("table %r is not a valid identifier" % table)
         self.table = table
 
+    def init_unpickled(self):
+        super(SnapshotterToDB, self).init_unpickled()
+        self._conn_ = None
+
+    @staticmethod
+    def _connect(dsn):
+        if dsn.startswith("sqlite:"):
+            import sqlite3
+            return sqlite3.connect(dsn[len("sqlite:"):])
+        import pyodbc
+        return pyodbc.connect(dsn)
+
     def initialize(self, **kwargs):
-        import pyodbc  # noqa: F401 — hard requirement of this backend
         super(SnapshotterToDB, self).initialize(**kwargs)
-        self._conn_ = __import__("pyodbc").connect(self.odbc)
-        cur = self._conn_.cursor()
-        cur.execute(
-            "CREATE TABLE IF NOT EXISTS %s (id SERIAL, prefix TEXT, "
-            "ts TIMESTAMP, blob BYTEA)" % self.table)
-        self._conn_.commit()
+        self._ensure_conn()
+
+    def _ensure_conn(self):
+        if self._conn_ is None:
+            self._conn_ = self._connect(self.odbc)
+            if self.odbc.startswith("sqlite:"):
+                ddl = ("CREATE TABLE IF NOT EXISTS %s (id INTEGER "
+                       "PRIMARY KEY, prefix TEXT, ts TIMESTAMP, "
+                       "blob BLOB)")
+            else:  # Postgres-over-ODBC, the reference's deployment
+                ddl = ("CREATE TABLE IF NOT EXISTS %s (id SERIAL "
+                       "PRIMARY KEY, prefix TEXT, ts TIMESTAMP, "
+                       "blob BYTEA)")
+            cur = self._conn_.cursor()
+            cur.execute(ddl % self.table)
+            self._conn_.commit()
 
     def export(self):
-        blob = pickle.dumps(self.workflow,
-                            protocol=pickle.HIGHEST_PROTOCOL)
+        self._ensure_conn()
+        blob = self._codec_dump(self.workflow)
         cur = self._conn_.cursor()
         cur.execute(
             "INSERT INTO %s (prefix, ts, blob) VALUES (?, "
             "CURRENT_TIMESTAMP, ?)" % self.table, (self.prefix, blob))
         self._conn_.commit()
-        self.info("snapshot -> odbc:%s (%.1f MiB)",
-                  self.table, len(blob) / 2 ** 20)
+        self.destination = "db:%s/%s" % (self.table, self.prefix)
+        self.info("snapshot -> %s (%.1f MiB)", self.destination,
+                  len(blob) / 2 ** 20)
+
+    _DB_CODECS = {None: lambda b: b, "": lambda b: b,
+                  "gz": lambda b: gzip.compress(b, 1),
+                  "bz2": lambda b: bz2.compress(b),
+                  "xz": lambda b: lzma.compress(b)}
+
+    def _codec_dump(self, obj):
+        raw = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        try:
+            return self._DB_CODECS[self.compression](raw)
+        except KeyError:
+            raise ValueError("unsupported DB snapshot codec %r"
+                             % self.compression)
+
+    @classmethod
+    def import_db(cls, dsn, table="veles", prefix=None):
+        """Load the newest snapshot (optionally for one prefix) back
+        into a live workflow (ref resume path: __main__.py:539-589)."""
+        if not table.isidentifier():
+            raise ValueError("table %r is not a valid identifier" % table)
+        conn = cls._connect(dsn)
+        try:
+            cur = conn.cursor()
+            if prefix is not None:
+                cur.execute(
+                    "SELECT blob FROM %s WHERE prefix = ? "
+                    "ORDER BY id DESC LIMIT 1" % table, (prefix,))
+            else:
+                cur.execute("SELECT blob FROM %s ORDER BY id DESC "
+                            "LIMIT 1" % table)
+            row = cur.fetchone()
+        finally:
+            conn.close()
+        if row is None:
+            raise KeyError("no snapshot in %s" % table)
+        blob = bytes(row[0])
+        if blob[:2] == b"\x1f\x8b":
+            blob = gzip.decompress(blob)
+        elif blob[:3] == b"BZh":
+            blob = bz2.decompress(blob)
+        elif blob[:6] == b"\xfd7zXZ\x00":
+            blob = lzma.decompress(blob)
+        obj = pickle.loads(blob)
+        try:
+            obj._restored_from_snapshot_ = True
+        except AttributeError:  # plain payloads (no attr dict)
+            pass
+        return obj
 
 
 def Snapshotter(workflow, odbc=None, **kwargs):

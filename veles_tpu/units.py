@@ -203,17 +203,28 @@ class Unit(RegisteredDistributable):
 
     def _run_wrapped(self):
         """run() with timing + initialization check
-        (ref: units.py:805-845)."""
+        (ref: units.py:805-845).  Under ``root.common.trace.run`` each
+        run is additionally a jax.profiler TraceAnnotation, so per-unit
+        spans appear inside the device trace — the fused XLA programs
+        make host wall-timers blind to where device time goes
+        (SURVEY.md §5 jax.profiler requirement)."""
         if not self._is_initialized:
             raise RuntimeError("%s.run() before initialize()" % self)
+        from veles_tpu.config import root
+        tracing = root.common.trace.get("run")
         t0 = time.time()
         try:
-            self.run()
+            if tracing:
+                import jax.profiler
+                with jax.profiler.TraceAnnotation(
+                        "unit:%s" % self.name):
+                    self.run()
+            else:
+                self.run()
         finally:
             dt = time.time() - t0
             self.timers["run"] += dt
             self.timers["runs"] += 1
-            from veles_tpu.config import root
             if root.common.get("timings"):
                 self.debug("%s ran in %.4fs", self.name, dt)
 
