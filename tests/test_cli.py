@@ -82,3 +82,36 @@ class TestCLI:
             ["wf.py", "cfg.py", "-a", "numpy", "--result-file", "r.json",
              "--listen", ":5050"], "-a", "--listen")
         assert out == ["-a", "numpy", "--listen", ":5050"]
+
+
+def test_master_spawns_workers_end_to_end(tmp_path):
+    """-l + -w: the master spawns worker subprocesses that join the
+    coordinator and drive the full distributed run from one command
+    (ref: veles/launcher.py:617-842 slave spawning)."""
+    import json
+    import socket
+    import subprocess
+    import sys
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = tmp_path / "dist.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run(
+        [sys.executable, "-m", "veles_tpu",
+         os.path.join(repo, "veles_tpu", "samples", "mnist.py"),
+         os.path.join(repo, "veles_tpu", "samples", "mnist_config.py"),
+         "-l", ":%d" % port, "-w", "2",
+         "-c", "root.mnist_tpu.update({'max_epochs':1,"
+         "'synthetic_train':512,'synthetic_valid':128,"
+         "'minibatch_size':128,'snapshot_time_interval':1e9})",
+         "--result-file", str(out)],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=240)
+    assert r.returncode == 0, r.stderr[-800:]
+    results = json.loads(out.read_text())
+    assert results["Total epochs"] >= 1
+    assert "validation_error_pct" in results

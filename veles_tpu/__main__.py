@@ -173,13 +173,29 @@ class Main:
         from veles_tpu.genetics import fix_config
         fix_config(root)
         self._seed_random()
+        workers = self.args.workers
+        if workers and workers.isdigit():
+            workers = int(workers)
+        # the re-exec tail spawned workers run: same workflow/config/
+        # overrides, their own seed handling (ref: launcher.py:75
+        # filter_argv)
+        worker_tail = [self.args.workflow]
+        if self.args.config:
+            worker_tail.append(self.args.config)
+        for snippet in self.args.config_override:
+            worker_tail += ["-c", snippet]
+        if self.args.backend:
+            worker_tail += ["-a", self.args.backend]
+        for _ in range(self.args.verbose):
+            worker_tail += ["-v"]
         self.launcher = Launcher(
             backend=self.args.backend, device_index=self.args.device,
             listen=self.args.listen,
             master_address=self.args.master_address,
             graphics=self.args.graphics or None,
             status_url=self.args.web_status,
-            profile_dir=self.args.profile)
+            profile_dir=self.args.profile,
+            workers=workers, worker_cmd_tail=worker_tail)
         module = import_file_as_module(self.args.workflow)
         if not hasattr(module, "run"):
             print("workflow file must define run(load, main)",

@@ -51,6 +51,9 @@ def build_parser():
                    help="run as coordinator, listen on host:port")
     p.add_argument("-m", "--master-address", default=None, metavar="ADDR",
                    help="run as worker of the given coordinator")
+    p.add_argument("-w", "--workers", default=None, metavar="N|HOSTS",
+                   help="with -l: spawn N local worker processes, or a "
+                        "comma list of hosts over ssh (ref: veles -n)")
     p.add_argument("-g", "--graphics", action="store_true",
                    help="publish live plot payloads over ZMQ PUB "
                         "(attach: python -m veles_tpu.graphics_client)")

@@ -24,7 +24,8 @@ class Launcher(Logger):
 
     def __init__(self, backend=None, device_index=0, listen=None,
                  master_address=None, graphics=None, status_url=None,
-                 profile_dir=None, **kwargs):
+                 profile_dir=None, workers=None, worker_cmd_tail=None,
+                 **kwargs):
         super(Launcher, self).__init__()
         self._listen = listen
         self._master_address = master_address
@@ -41,6 +42,14 @@ class Launcher(Logger):
         self.status_notifier = None
         self._profile_dir = profile_dir
         self._profiling = False
+        #: worker specs: int (N local), or list/comma-list of host specs
+        #: ("localhost" → subprocess, anything else → ssh, ref:
+        #: veles/launcher.py:617-842 SSH slave spawn)
+        self._workers = workers
+        #: the re-exec tail (workflow file, config, -c overrides…) the
+        #: CLI assembled for spawned workers
+        self._worker_cmd_tail = list(worker_cmd_tail or [])
+        self._worker_procs = []
 
     # -- mode (ref: launcher.py:333-356) --------------------------------------
 
@@ -124,6 +133,8 @@ class Launcher(Logger):
             if self.is_standalone:
                 self.workflow.run()
             elif self.is_master:
+                if self._workers:
+                    self._spawn_workers()
                 from veles_tpu.parallel.coordinator import serve_master
                 serve_master(self)
             else:
@@ -131,6 +142,52 @@ class Launcher(Logger):
                 serve_worker(self)
         finally:
             self.stop()
+
+    # -- worker spawning (ref: veles/launcher.py:617-842) ---------------------
+
+    def _spawn_workers(self):
+        import subprocess
+        import sys
+        import tempfile
+        specs = self._workers
+        if isinstance(specs, int):
+            specs = ["localhost"] * specs
+        elif isinstance(specs, str):
+            specs = [s for s in specs.split(",") if s]
+        host, _, port = (self._listen or ":5050").rpartition(":")
+        connect = "%s:%s" % (host or "127.0.0.1", port or "5050")
+        tail = self._worker_cmd_tail + ["-m", connect]
+        for i, spec in enumerate(specs):
+            if spec in ("localhost", "127.0.0.1", ""):
+                cmd = [sys.executable, "-m", "veles_tpu"] + tail
+            else:  # remote host over ssh (key-based auth, ref paramiko)
+                cmd = ["ssh", "-o", "BatchMode=yes", spec,
+                       "python3", "-m", "veles_tpu"] + tail
+            log = tempfile.NamedTemporaryFile(
+                mode="wb", suffix=".log", prefix="veles_worker%d_" % i,
+                delete=False)
+            proc = subprocess.Popen(cmd, stdout=log, stderr=log)
+            self._worker_procs.append((proc, log.name))
+            self.info("spawned worker %d on %s (pid %d, log %s)",
+                      i, spec or "localhost", proc.pid, log.name)
+
+    def _reap_workers(self, timeout=30.0):
+        import subprocess
+        for proc, log in self._worker_procs:
+            try:
+                rc = proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                rc = proc.wait(5)
+            if rc:
+                try:
+                    with open(log, "rb") as f:
+                        tail = f.read()[-500:].decode(errors="replace")
+                except OSError:
+                    tail = "<no log>"
+                self.warning("worker pid %d exited rc=%d: %s",
+                             proc.pid, rc, tail)
+        self._worker_procs = []
 
     def boot(self, **kwargs):
         self.initialize(**kwargs)
@@ -140,6 +197,8 @@ class Launcher(Logger):
         if self.stopped:
             return
         self.stopped = True
+        if self._worker_procs:
+            self._reap_workers()
         if self._profiling:
             import jax.profiler
             jax.profiler.stop_trace()
