@@ -174,20 +174,20 @@ class Main:
         fix_config(root)
         self._seed_random()
         workers = self.args.workers
+        if workers and not self.args.listen:
+            parser.error("-w/--workers requires -l/--listen "
+                         "(the coordinator spawns the workers)")
         if workers and workers.isdigit():
             workers = int(workers)
         # the re-exec tail spawned workers run: same workflow/config/
-        # overrides, their own seed handling (ref: launcher.py:75
-        # filter_argv)
+        # overrides + the shared child flags (ref: launcher.py:75
+        # filter_argv role); the spawner appends per-worker -d/-m
         worker_tail = [self.args.workflow]
         if self.args.config:
             worker_tail.append(self.args.config)
         for snippet in self.args.config_override:
             worker_tail += ["-c", snippet]
-        if self.args.backend:
-            worker_tail += ["-a", self.args.backend]
-        for _ in range(self.args.verbose):
-            worker_tail += ["-v"]
+        worker_tail += self._child_argv()
         self.launcher = Launcher(
             backend=self.args.backend, device_index=self.args.device,
             listen=self.args.listen,

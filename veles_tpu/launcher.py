@@ -146,6 +146,8 @@ class Launcher(Logger):
     # -- worker spawning (ref: veles/launcher.py:617-842) ---------------------
 
     def _spawn_workers(self):
+        import shlex
+        import socket
         import subprocess
         import sys
         import tempfile
@@ -155,21 +157,41 @@ class Launcher(Logger):
         elif isinstance(specs, str):
             specs = [s for s in specs.split(",") if s]
         host, _, port = (self._listen or ":5050").rpartition(":")
-        connect = "%s:%s" % (host or "127.0.0.1", port or "5050")
-        tail = self._worker_cmd_tail + ["-m", connect]
+        port = port or "5050"
+        n_local_devices = len(self.device.jax_devices) \
+            if self.device is not None else 1
+        local_count = 0
         for i, spec in enumerate(specs):
-            if spec in ("localhost", "127.0.0.1", ""):
+            # "host/D" pins the worker to device D (ref: veles -n
+            # host/0:0x3 device syntax); plain local workers round-robin
+            # over this host's devices
+            spec, _, dev = spec.partition("/")
+            is_local = spec in ("localhost", "127.0.0.1", "")
+            if not dev:
+                dev = str(local_count % n_local_devices) if is_local \
+                    else "0"
+            tail = list(self._worker_cmd_tail) + ["-d", dev]
+            if is_local:
+                tail += ["-m", "%s:%s" % (host or "127.0.0.1", port)]
                 cmd = [sys.executable, "-m", "veles_tpu"] + tail
-            else:  # remote host over ssh (key-based auth, ref paramiko)
+                local_count += 1
+            else:
+                # a remote worker must dial THIS host, not its own
+                # loopback; quote every arg — ssh re-joins argv through
+                # the remote shell
+                master_host = host if host not in ("", "0.0.0.0") \
+                    else socket.getfqdn()
+                tail += ["-m", "%s:%s" % (master_host, port)]
                 cmd = ["ssh", "-o", "BatchMode=yes", spec,
-                       "python3", "-m", "veles_tpu"] + tail
+                       "python3", "-m", "veles_tpu"] + [
+                           shlex.quote(a) for a in tail]
             log = tempfile.NamedTemporaryFile(
                 mode="wb", suffix=".log", prefix="veles_worker%d_" % i,
                 delete=False)
             proc = subprocess.Popen(cmd, stdout=log, stderr=log)
             self._worker_procs.append((proc, log.name))
-            self.info("spawned worker %d on %s (pid %d, log %s)",
-                      i, spec or "localhost", proc.pid, log.name)
+            self.info("spawned worker %d on %s dev %s (pid %d, log %s)",
+                      i, spec or "localhost", dev, proc.pid, log.name)
 
     def _reap_workers(self, timeout=30.0):
         import subprocess
