@@ -185,3 +185,20 @@ def test_cifar_workflow_learns():
     wf.run()
     err = wf.decision.epoch_metrics.get("validation_error_pct")
     assert err is not None and err < 30.0, err
+
+
+@pytest.mark.slow
+def test_alexnet_workflow_end_to_end():
+    """BASELINE config 3 mechanics at reduced spatial size."""
+    from veles_tpu.samples.alexnet import AlexNetWorkflow
+    root.alexnet_tpu.update({
+        "side": 67, "classes": 10, "minibatch_size": 8,
+        "synthetic_train": 32, "synthetic_valid": 8, "max_epochs": 1,
+    })
+    wf = AlexNetWorkflow(None)
+    wf.snapshotter.interval = 10**9
+    wf.snapshotter.time_interval = 10**9
+    wf.initialize(device=Device(backend="numpy"))
+    wf.run()
+    assert numpy.isfinite(
+        wf.decision.epoch_metrics["validation_loss"])

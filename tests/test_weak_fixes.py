@@ -139,3 +139,36 @@ def test_cli_profile_writes_trace(tmp_path):
     assert traces, "no trace artifacts under %s: %s" % (
         trace_dir, os.listdir(trace_dir) if os.path.isdir(trace_dir)
         else "missing")
+
+
+# -- pickle debugging ---------------------------------------------------------
+
+def test_find_unpicklable_names_path():
+    from veles_tpu.pickle_debug import find_unpicklable
+
+    class Holder:
+        pass
+
+    h = Holder()
+    h.fine = [1, 2, 3]
+    h.nested = Holder()
+    h.nested.bad = lambda: None  # unpicklable leaf
+    rows = find_unpicklable(h)
+    assert any(".nested.bad" in p for p, _ in rows), rows
+
+
+def test_cli_debug_pickle_flag(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run(
+        [sys.executable, "-m", "veles_tpu",
+         os.path.join(REPO, "veles_tpu", "samples", "mnist.py"),
+         os.path.join(REPO, "veles_tpu", "samples", "mnist_config.py"),
+         "--debug-pickle",
+         "-c", "root.mnist_tpu.update({'max_epochs':1,"
+         "'synthetic_train':256,'synthetic_valid':64,"
+         "'minibatch_size':64,'snapshot_time_interval':1e9})"],
+        capture_output=True, text=True, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "pickles cleanly" in r.stdout + r.stderr

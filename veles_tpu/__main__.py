@@ -80,7 +80,16 @@ class Main:
         return self.workflow, self.restored
 
     def _main(self, **kwargs):
-        self.launcher.boot(**kwargs)
+        self.launcher.initialize(**kwargs)
+        if self.args.debug_pickle:
+            from veles_tpu.pickle_debug import (
+                _try_pickle, explain_pickle_failure)
+            log = logging.getLogger("Main")
+            if _try_pickle(self.workflow) is None:
+                log.info("workflow pickles cleanly")
+            else:
+                log.error("%s", explain_pickle_failure(self.workflow))
+        self.launcher.run()
         if self.args.result_file:
             self.launcher.write_results(self.args.result_file)
 

@@ -77,6 +77,10 @@ def build_parser():
                    help="-v debug, -vv everything")
     p.add_argument("--timings", action="store_true",
                    help="per-unit run timing printout")
+    p.add_argument("--debug-pickle", action="store_true",
+                   help="after initialize, verify the workflow pickles "
+                        "and name any unpicklable attribute paths "
+                        "(ref: veles --debug-pickle)")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the run into "
                         "DIR (view with tensorboard / xprof); also "

@@ -98,8 +98,16 @@ class SnapshotterToFile(SnapshotterBase):
                            EXT[self.compression])
         path = os.path.join(self.directory, name)
         with self.timed_event("snapshot"):
-            with CODECS[self.compression](path, "w") as f:
-                pickle.dump(target, f, protocol=pickle.HIGHEST_PROTOCOL)
+            try:
+                with CODECS[self.compression](path, "w") as f:
+                    pickle.dump(target, f,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+            except (pickle.PicklingError, TypeError, AttributeError):
+                # name the offending attribute path, not just the
+                # innermost type (ref: pickle2.py debug hooks)
+                from veles_tpu.pickle_debug import explain_pickle_failure
+                explain_pickle_failure(target, logger=self)
+                raise
         self.destination = path
         size = os.path.getsize(path)
         self.info("snapshot -> %s (%.1f MiB)", path, size / 2 ** 20)
