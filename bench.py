@@ -25,7 +25,9 @@ import numpy
 #: train-only spans.  mlp_vs_baseline therefore reports the end-to-end
 #: speedup of the shipped training path, methodology change included.
 MLP_BASELINE_SAMPLES_PER_SEC = 48931.4
-#: first AlexNet measurement on the TPU v5e chip (round 2, this file).
+#: first AlexNet measurement on the TPU v5e chip (round 2, this file;
+#: same span methodology — best-of-N windows only drops tunnel stalls,
+#: steady-state windows match the single-window number within ~1%).
 ALEXNET_BASELINE_SAMPLES_PER_SEC = 15403.7
 
 #: published bf16 peak FLOP/s per chip by device kind; the measured GEMM

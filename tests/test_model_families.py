@@ -1,0 +1,212 @@
+"""Model-family breadth (manualrst_veles_algorithms.rst table):
+autoencoders (FC + conv), Kohonen maps, RNN/LSTM, RBM, VGG spec."""
+
+import jax
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.config import root
+from veles_tpu.memory import Array
+
+
+# -- autoencoders -------------------------------------------------------------
+
+def test_mnist_ae_trains():
+    from veles_tpu.samples.mnist_ae import MnistAEWorkflow
+    root.mnist_tpu.update({"synthetic_train": 1024,
+                           "synthetic_valid": 256})
+    root.mnist_ae_tpu.update({"max_epochs": 3, "conv": False,
+                              "minibatch_size": 128})
+    wf = MnistAEWorkflow(None)
+    wf.snapshotter.interval = 10**9
+    wf.snapshotter.time_interval = 10**9
+    wf.initialize(device=Device(backend="numpy"))
+    wf.run()
+    rmse = wf.rmse()
+    assert rmse is not None and rmse < 0.3, rmse
+
+
+def test_conv_ae_mechanics():
+    from veles_tpu.samples.mnist_ae import MnistAEWorkflow
+    root.mnist_tpu.update({"synthetic_train": 256,
+                           "synthetic_valid": 64})
+    root.mnist_ae_tpu.update({"max_epochs": 1, "conv": True,
+                              "minibatch_size": 64})
+    wf = MnistAEWorkflow(None)
+    wf.snapshotter.interval = 10**9
+    wf.snapshotter.time_interval = 10**9
+    wf.initialize(device=Device(backend="numpy"))
+    wf.run()
+    assert wf.rmse() is not None and numpy.isfinite(wf.rmse())
+    root.mnist_ae_tpu.conv = False  # don't leak into other tests
+
+
+# -- Kohonen ------------------------------------------------------------------
+
+def test_kohonen_workflow_organizes():
+    from veles_tpu import prng
+    prng.get("kohonen").seed(1234)
+    from veles_tpu.samples.kohonen import KohonenWorkflow
+    root.kohonen_tpu.update({"samples": 1024, "clusters": 4,
+                             "minibatch_size": 256, "max_epochs": 8,
+                             "shape": (6, 6)})
+    wf = KohonenWorkflow(None)
+    wf.initialize(device=Device(backend="numpy"))
+    wf.run()
+    errs = wf.decision.epoch_qerror
+    assert len(errs) >= 8
+    assert errs[-1] < errs[0] * 0.7, errs  # quantization error fell
+    # the trained map quantizes near the 4 cluster centers
+    assert errs[-1] < 0.35
+
+
+def test_kohonen_forward_bmu():
+    from veles_tpu.models.kohonen import KohonenForward
+    w = jnp.asarray([[0.0, 0.0], [10.0, 10.0]], jnp.float32)
+    x = jnp.asarray([[0.2, -0.1], [9.0, 11.0]], jnp.float32)
+    winners, d = KohonenForward.bmu(w, x)
+    assert winners.tolist() == [0, 1]
+    assert d.shape == (2, 2)
+
+
+# -- recurrent ----------------------------------------------------------------
+
+@pytest.mark.parametrize("ltype", ["rnn", "lstm"])
+def test_recurrent_units_shapes_and_grads(ltype):
+    from veles_tpu.models.standard import make_forwards
+    x = numpy.random.default_rng(0).normal(
+        size=(3, 7, 5)).astype(numpy.float32)
+    units = make_forwards(None, Array(x), [
+        {"type": ltype, "hidden": 6},
+        {"type": "last_timestep"},
+    ])
+    dev = Device(backend="numpy")
+    for u in units:
+        u.initialize(device=dev)
+    assert units[0].output.shape == (3, 7, 6)
+    assert units[1].output.shape == (3, 6)
+    params = {k: jnp.asarray(a.mem)
+              for k, a in units[0].param_arrays().items()}
+
+    def loss(p):
+        y = units[0].apply(p, jnp.asarray(x))
+        return jnp.sum(y[:, -1, :] ** 2)
+
+    grads = jax.grad(loss)(params)
+    for g in grads.values():
+        arr = numpy.asarray(g)
+        assert numpy.all(numpy.isfinite(arr))
+        assert numpy.any(arr != 0)
+
+
+def test_lstm_sequence_classification_learns():
+    """A tiny sequence task: classify by which half of the sequence has
+    the larger mean — needs memory over time."""
+    from veles_tpu import prng
+    for key in ("default", "loader", "trainer"):
+        prng.get(key).seed(1234)  # hermetic despite singleton streams
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.evaluator import EvaluatorSoftmax
+    from veles_tpu.models.gd import GradientDescent
+    from veles_tpu.models.standard import make_forwards
+
+    class SeqLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            n, t, f = 512, 8, 4
+            labels = rng.integers(0, 2, n)
+            x = rng.normal(scale=0.3, size=(n, t, f))
+            x[labels == 0, :4] += 1.0
+            x[labels == 1, 4:] += 1.0
+            self.class_lengths[:] = [0, 128, n - 128]
+            self.original_data = x.astype(numpy.float32)
+            self.original_labels = labels.tolist()
+
+    dev = Device(backend="numpy")
+    wf = AcceleratedWorkflow(None, name="seq")
+    loader = SeqLoader(wf, minibatch_size=128)
+    loader.initialize(device=dev)
+    units = make_forwards(wf, loader.minibatch_data, [
+        {"type": "lstm", "hidden": 8},
+        {"type": "last_timestep"},
+        {"type": "softmax", "output_sample_shape": (2,)},
+    ])
+    for u in units:
+        u.initialize(device=dev)
+    ev = EvaluatorSoftmax(wf, compute_confusion_matrix=False)
+    ev.output = units[-1].output
+    ev.labels = loader.minibatch_labels
+    ev.loader = loader
+    ev.initialize(device=dev)
+    gd = GradientDescent(wf, forwards=units, evaluator=ev,
+                         loader=loader, solver="adam",
+                         learning_rate=0.01)
+    gd.initialize(device=dev)
+    from veles_tpu.loader.base import VALID
+    for _ in range(10):  # epochs
+        while True:
+            loader.run()
+            gd.run()
+            if loader.train_ended:
+                break
+    acc = gd.read_epoch_acc()
+    err_pct = 100.0 * acc[VALID][0] / max(acc[VALID][2], 1)
+    assert err_pct < 15.0, err_pct
+
+
+# -- RBM ----------------------------------------------------------------------
+
+def test_rbm_reconstruction_improves():
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.rbm import BernoulliRBM
+
+    class BitsLoader(FullBatchLoader):
+        span_serving = False
+
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            # two binary prototypes + flip noise
+            protos = numpy.array(
+                [[1, 1, 1, 1, 0, 0, 0, 0],
+                 [0, 0, 0, 0, 1, 1, 1, 1]], numpy.float32)
+            idx = rng.integers(0, 2, 512)
+            x = protos[idx]
+            flip = rng.random(x.shape) < 0.05
+            x = numpy.abs(x - flip.astype(numpy.float32))
+            self.class_lengths[:] = [0, 0, 512]
+            self.original_data = x
+
+    dev = Device(backend="numpy")
+    wf = AcceleratedWorkflow(None, name="rbm")
+    loader = BitsLoader(wf, minibatch_size=128)
+    loader.initialize(device=dev)
+    from veles_tpu import prng
+    prng.get("rbm").seed(1234)
+    rbm = BernoulliRBM(wf, loader=loader, hidden=8, learning_rate=0.5)
+    rbm.initialize(device=dev)
+    errors = []
+    for _ in range(120):
+        loader.run()
+        rbm.run()
+        rbm.recon_error.map_read()
+        errors.append(float(rbm.recon_error.mem))
+    assert errors[-1] < errors[0] * 0.4, (errors[0], errors[-1])
+
+
+# -- VGG spec -----------------------------------------------------------------
+
+def test_vgg_a_spec_builds():
+    from veles_tpu.samples.alexnet import vgg_a_layers
+    from veles_tpu.models.standard import make_forwards
+    spec = vgg_a_layers(classes=10)
+    assert sum(1 for s in spec if s["type"] == "conv_relu") == 8
+    x = numpy.zeros((2, 64, 64, 3), numpy.float32)
+    units = make_forwards(None, Array(x), spec)
+    dev = Device(backend="numpy")
+    for u in units:
+        u.initialize(device=dev)
+    assert units[-1].output.shape == (2, 10)

@@ -35,6 +35,11 @@ from veles_tpu.models.pooling import (  # noqa: F401
 from veles_tpu.models.dropout import DropoutForward  # noqa: F401
 from veles_tpu.models.lrn import LRNormalizerForward  # noqa: F401
 from veles_tpu.models.attention import MultiHeadAttention  # noqa: F401
+from veles_tpu.models.recurrent import (  # noqa: F401
+    LSTM, LastTimestep, SimpleRNN)
+from veles_tpu.models.rbm import BernoulliRBM  # noqa: F401
+from veles_tpu.models.kohonen import (  # noqa: F401
+    KohonenDecision, KohonenForward, KohonenTrainer)
 from veles_tpu.models.evaluator import (  # noqa: F401
     EvaluatorMSE, EvaluatorSoftmax)
 from veles_tpu.models.gd import GradientDescent  # noqa: F401

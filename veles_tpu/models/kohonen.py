@@ -1,0 +1,184 @@
+"""Kohonen self-organizing maps (reconstruction of the znicz Kohonen
+unit family — manualrst_veles_algorithms.rst "Kohonen maps", the
+SpamKohonen/DemoKohonen workflows).
+
+TPU-native formulation: one jitted step per minibatch computes all
+sample↔neuron distances as a GEMM-shaped expression on the MXU, takes
+winners, and applies the Gaussian-neighborhood batch update — the
+reference spread this over several OpenCL kernels (distance, argmin,
+gravity, weight update).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Array
+from veles_tpu.result_provider import IResultProvider
+from veles_tpu.units import MissingDemand
+from veles_tpu import prng as prng_mod
+
+
+def _grid(sy, sx):
+    yy, xx = numpy.mgrid[0:sy, 0:sx]
+    return numpy.stack([yy.ravel(), xx.ravel()], axis=1).astype(
+        numpy.float32)
+
+
+class KohonenForward(AcceleratedUnit):
+    """Best-matching-unit lookup: ``output[b]`` = index of the nearest
+    neuron on the (sy, sx) grid (znicz KohonenForward role)."""
+
+    READS = ("input", "weights")
+    WRITES = ("output",)
+
+    def __init__(self, workflow, weights=None, shape=(8, 8), **kwargs):
+        super(KohonenForward, self).__init__(workflow, **kwargs)
+        self.input = None
+        self.weights = weights if weights is not None else Array()
+        self.shape = tuple(shape)
+        self.output = Array()
+        self.demand("input")
+
+    def initialize(self, device=None, **kwargs):
+        if not isinstance(self.input, Array) or not bool(self.input):
+            raise MissingDemand(self, {"input"})
+        self.output.reset(numpy.zeros((self.input.shape[0],),
+                                      numpy.int32))
+        super(KohonenForward, self).initialize(device=device, **kwargs)
+
+    @staticmethod
+    def bmu(weights, x):
+        """[batch] winner indices; distance via the expanded-norm GEMM
+        (‖x−w‖² = ‖x‖² − 2x·wᵀ + ‖w‖², the MXU carries the cross
+        term)."""
+        x2 = jnp.sum(x * x, axis=1, keepdims=True)
+        w2 = jnp.sum(weights * weights, axis=1)[None, :]
+        cross = x @ weights.T
+        d = x2 - 2.0 * cross + w2
+        return jnp.argmin(d, axis=1).astype(jnp.int32), d
+
+    def step(self, input, weights):
+        x = input.reshape(input.shape[0], -1)
+        winners, _ = self.bmu(weights, x)
+        return {"output": winners}
+
+
+class KohonenTrainer(AcceleratedUnit):
+    """Batch SOM update (znicz KohonenTrainer role): winners +
+    Gaussian neighborhood on the grid, learning rate and radius
+    annealed over ``time`` steps."""
+
+    FUSABLE = False  # owns its dispatch (donated weights)
+
+    def __init__(self, workflow, loader=None, shape=(8, 8),
+                 sigma0=None, sigma_decay=200.0, learning_rate=0.5,
+                 lr_decay=200.0, prng_key="kohonen", **kwargs):
+        super(KohonenTrainer, self).__init__(workflow, **kwargs)
+        self.loader = loader
+        self.shape = tuple(shape)
+        self.sigma0 = sigma0 if sigma0 is not None \
+            else max(self.shape) / 2.0
+        self.sigma_decay = sigma_decay
+        self.learning_rate = learning_rate
+        self.lr_decay = lr_decay
+        self.prng = prng_mod.get(prng_key)
+        self.weights = Array()
+        self.time = 0
+        self.qerror = Array()   # mean quantization error (host metric)
+        self.demand("loader")
+
+    def init_unpickled(self):
+        super(KohonenTrainer, self).init_unpickled()
+        self._step_ = None
+
+    @property
+    def n_neurons(self):
+        return self.shape[0] * self.shape[1]
+
+    def initialize(self, device=None, **kwargs):
+        if self.loader is None:
+            raise MissingDemand(self, {"loader"})
+        features = int(numpy.prod(self.loader.minibatch_data.shape[1:]))
+        if not bool(self.weights):
+            w = numpy.zeros((self.n_neurons, features), numpy.float32)
+            self.prng.fill(w, -0.1, 0.1)
+            self.weights.reset(w)
+        self.qerror.reset(numpy.zeros((), numpy.float32))
+        super(KohonenTrainer, self).initialize(device=device, **kwargs)
+
+    def _build_step(self):
+        coords = jnp.asarray(_grid(*self.shape))
+
+        def step(weights, x, size, t):
+            x = x.reshape(x.shape[0], -1)
+            winners, d = KohonenForward.bmu(weights, x)
+            mask = (jnp.arange(x.shape[0]) < size).astype(jnp.float32)
+            qerr = jnp.sum(
+                jnp.sqrt(jnp.maximum(
+                    jnp.take_along_axis(d, winners[:, None], 1)[:, 0],
+                    0.0)) * mask) / jnp.maximum(size, 1)
+            sigma = self.sigma0 * jnp.exp(-t / self.sigma_decay)
+            lr = self.learning_rate * jnp.exp(-t / self.lr_decay)
+            # neighborhood of each sample's winner over all neurons
+            wc = coords[winners]                      # [b, 2]
+            d2 = jnp.sum(
+                (wc[:, None, :] - coords[None, :, :]) ** 2, axis=-1)
+            h = jnp.exp(-d2 / (2.0 * sigma * sigma)) * mask[:, None]
+            # batch update: w_n += lr * Σ_b h_bn (x_b − w_n) / Σ_b h_bn
+            num = h.T @ x                             # [n, f]
+            den = jnp.sum(h, axis=0)[:, None]
+            target = num / jnp.maximum(den, 1e-12)
+            gate = (den > 1e-12).astype(jnp.float32)
+            new_w = weights + lr * gate * (target - weights)
+            return new_w, qerr
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def run(self):
+        if self._step_ is None:
+            self._step_ = self._build_step()
+        l = self.loader
+        new_w, qerr = self._step_(
+            self.weights.devmem, l.minibatch_data.devmem,
+            jnp.int32(l.minibatch_size), jnp.float32(self.time))
+        self.weights.devmem = new_w
+        self.qerror.devmem = qerr
+        self.time += 1
+
+    def step(self, **tensors):
+        raise RuntimeError("KohonenTrainer dispatches its own program")
+
+
+class KohonenDecision(AcceleratedUnit, IResultProvider):
+    """Epoch loop control for SOM training (no gradient/error signal —
+    stops on max_epochs; znicz used its KohonenDecision similarly)."""
+
+    FUSABLE = False
+
+    def __init__(self, workflow, max_epochs=10, **kwargs):
+        from veles_tpu.mutable import Bool
+        super(KohonenDecision, self).__init__(workflow, **kwargs)
+        self.max_epochs = max_epochs
+        self.loader = None
+        self.trainer = None
+        self.complete = Bool(False, "complete")
+        self.epoch_qerror = []
+        self.demand("loader", "trainer")
+
+    def run(self):
+        l = self.loader
+        if l.train_ended:
+            self.trainer.qerror.map_read()
+            self.epoch_qerror.append(float(self.trainer.qerror.mem))
+            self.info("epoch %d: quantization error %.4f",
+                      l.epoch_number, self.epoch_qerror[-1])
+            if l.epoch_number >= self.max_epochs:
+                self.complete.set(True)
+                if self._workflow is not None:
+                    self._workflow.on_workflow_finished()
+
+    def get_metric_values(self):
+        return {"quantization_error":
+                self.epoch_qerror[-1] if self.epoch_qerror else None}

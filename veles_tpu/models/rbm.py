@@ -1,0 +1,123 @@
+"""Restricted Boltzmann Machine (manualrst_veles_algorithms.rst
+"Restricted Boltzmann Machine": the reference's units were numpy-only
+with an untested workflow; these are live and tested).
+
+Bernoulli-Bernoulli RBM with CD-k training — the whole contrastive-
+divergence step (Gibbs chain + parameter update) is one jitted program.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedUnit
+from veles_tpu.memory import Array
+from veles_tpu.units import MissingDemand
+from veles_tpu import prng as prng_mod
+
+
+class BernoulliRBM(AcceleratedUnit):
+    """RBM unit: ``run()`` performs one CD-k update on the loader's
+    minibatch; ``hidden_probs(v)`` / ``reconstruct(v)`` are the
+    inference surfaces."""
+
+    FUSABLE = False
+
+    def __init__(self, workflow, loader=None, hidden=64, cd_k=1,
+                 learning_rate=0.1, prng_key="rbm", **kwargs):
+        super(BernoulliRBM, self).__init__(workflow, **kwargs)
+        self.loader = loader
+        self.hidden = int(hidden)
+        self.cd_k = int(cd_k)
+        self.learning_rate = float(learning_rate)
+        self.prng = prng_mod.get(prng_key)
+        self.weights = Array()   # [visible, hidden]
+        self.vbias = Array()
+        self.hbias = Array()
+        self.recon_error = Array()
+        self.global_step = 0
+        self.demand("loader")
+
+    def init_unpickled(self):
+        super(BernoulliRBM, self).init_unpickled()
+        self._step_ = None
+
+    def initialize(self, device=None, **kwargs):
+        if self.loader is None:
+            raise MissingDemand(self, {"loader"})
+        visible = int(numpy.prod(self.loader.minibatch_data.shape[1:]))
+        if not bool(self.weights):
+            w = numpy.zeros((visible, self.hidden), numpy.float32)
+            self.prng.fill_normal(w, 0.0, 0.01)
+            self.weights.reset(w)
+            self.vbias.reset(numpy.zeros((visible,), numpy.float32))
+            self.hbias.reset(numpy.zeros((self.hidden,), numpy.float32))
+        self.recon_error.reset(numpy.zeros((), numpy.float32))
+        super(BernoulliRBM, self).initialize(device=device, **kwargs)
+
+    # -- inference -------------------------------------------------------------
+
+    def hidden_probs(self, v, params=None):
+        w, _, hb = self._params_of(params)
+        return jax.nn.sigmoid(v @ w + hb)
+
+    def reconstruct(self, v, params=None):
+        w, vb, _ = self._params_of(params)
+        h = self.hidden_probs(v, params)
+        return jax.nn.sigmoid(h @ w.T + vb)
+
+    def _params_of(self, params):
+        if params is not None:
+            return params["weights"], params["vbias"], params["hbias"]
+        return (self.weights.devmem, self.vbias.devmem,
+                self.hbias.devmem)
+
+    # -- CD-k training ---------------------------------------------------------
+
+    def _build_step(self):
+        k = self.cd_k
+        lr = self.learning_rate
+
+        def step(w, vb, hb, v0, size, key):
+            mask = (jnp.arange(v0.shape[0]) < size).astype(
+                jnp.float32)[:, None]
+            v0 = v0.reshape(v0.shape[0], -1) * mask
+            h0p = jax.nn.sigmoid(v0 @ w + hb)
+
+            def gibbs(carry, kk):
+                hp, _ = carry
+                sub = jax.random.fold_in(key, kk)
+                h = jax.random.bernoulli(sub, hp).astype(v0.dtype)
+                vp = jax.nn.sigmoid(h @ w.T + vb)
+                hp2 = jax.nn.sigmoid(vp @ w + hb)
+                return (hp2, vp), None
+
+            (hkp, vk), _ = jax.lax.scan(
+                gibbs, (h0p, v0), jnp.arange(k))
+            n = jnp.maximum(jnp.sum(mask), 1.0)
+            pos = v0.T @ h0p
+            neg = (vk * mask).T @ hkp
+            w = w + lr * (pos - neg) / n
+            vb = vb + lr * jnp.sum((v0 - vk * mask), axis=0) / n
+            hb = hb + lr * jnp.sum((h0p - hkp) * mask, axis=0) / n
+            err = jnp.sum(((v0 - vk) * mask) ** 2) / n
+            return w, vb, hb, err
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def run(self):
+        if self._step_ is None:
+            self._step_ = self._build_step()
+        l = self.loader
+        key = self.prng.peek_key(self.global_step)
+        w, vb, hb, err = self._step_(
+            self.weights.devmem, self.vbias.devmem, self.hbias.devmem,
+            l.minibatch_data.devmem, jnp.int32(l.minibatch_size), key)
+        self.weights.devmem = w
+        self.vbias.devmem = vb
+        self.hbias.devmem = hb
+        self.recon_error.devmem = err
+        self.global_step += 1
+
+    def step(self, **tensors):
+        raise RuntimeError("BernoulliRBM dispatches its own program")

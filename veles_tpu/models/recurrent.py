@@ -1,0 +1,117 @@
+"""Recurrent layers — SimpleRNN and LSTM forward units
+(manualrst_veles_algorithms.rst "Recurrent Neural Networks" / "Long
+short-term memory": the reference's units existed in the absent Znicz
+submodule with status "created but not tested"; these are live and
+tested).
+
+x: [batch, time, features] → outputs [batch, time, hidden]; the time
+loop is ``lax.scan`` (static-shape, TPU-compilable), hidden state
+carried functionally.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.models.nn_units import ForwardBase
+from veles_tpu.ops.gemm import matmul
+
+
+class SimpleRNN(ForwardBase):
+    """h_t = tanh(x_t·Wx + h_{t-1}·Wh + b)."""
+
+    PARAMS = ("wx", "wh", "bias")
+
+    def __init__(self, workflow, hidden=None, **kwargs):
+        from veles_tpu.memory import Array
+        super(SimpleRNN, self).__init__(workflow, **kwargs)
+        if hidden is None:
+            raise ValueError("hidden is required")
+        self.hidden = int(hidden)
+        for p in self.PARAMS:
+            setattr(self, p, Array())
+
+    def output_shape_for(self, input_shape):
+        return (input_shape[0], input_shape[1], self.hidden)
+
+    def fill_params(self):
+        f = self.input.shape[-1]
+        h = self.hidden
+        self.wx.reset(numpy.zeros((f, h), numpy.float32))
+        self._fill(self.wx.mem, self.weights_filling,
+                   self.weights_stddev, f, h)
+        self.wh.reset(numpy.zeros((h, h), numpy.float32))
+        self._fill(self.wh.mem, self.weights_filling,
+                   self.weights_stddev, h, h)
+        self.bias.reset(numpy.zeros((h,), numpy.float32))
+
+    def apply(self, params, x):
+        def cell(h, xt):
+            h = jnp.tanh(matmul(xt, params["wx"], out_dtype=xt.dtype)
+                         + matmul(h, params["wh"], out_dtype=xt.dtype)
+                         + params["bias"])
+            return h, h
+
+        h0 = jnp.zeros((x.shape[0], self.hidden), x.dtype)
+        _, ys = jax.lax.scan(cell, h0, jnp.swapaxes(x, 0, 1))
+        return jnp.swapaxes(ys, 0, 1)
+
+
+class LSTM(ForwardBase):
+    """Standard LSTM (i, f, g, o gates; one fused [f+h, 4h] GEMM per
+    step rides the MXU)."""
+
+    PARAMS = ("weights", "bias")
+
+    def __init__(self, workflow, hidden=None, forget_bias=1.0, **kwargs):
+        super(LSTM, self).__init__(workflow, **kwargs)
+        if hidden is None:
+            raise ValueError("hidden is required")
+        self.hidden = int(hidden)
+        self.forget_bias = float(forget_bias)
+
+    def output_shape_for(self, input_shape):
+        return (input_shape[0], input_shape[1], self.hidden)
+
+    def fill_params(self):
+        f = self.input.shape[-1]
+        h = self.hidden
+        self.weights.reset(numpy.zeros((f + h, 4 * h), numpy.float32))
+        self._fill(self.weights.mem, self.weights_filling,
+                   self.weights_stddev, f + h, 4 * h)
+        self.bias.reset(numpy.zeros((4 * h,), numpy.float32))
+
+    def apply(self, params, x):
+        h_dim = self.hidden
+
+        def cell(carry, xt):
+            h, c = carry
+            z = matmul(jnp.concatenate([xt, h], axis=1),
+                       params["weights"], out_dtype=xt.dtype) \
+                + params["bias"]
+            i, f, g, o = jnp.split(z, 4, axis=1)
+            c = jax.nn.sigmoid(f + self.forget_bias) * c \
+                + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), h
+
+        zeros = jnp.zeros((x.shape[0], h_dim), x.dtype)
+        _, ys = jax.lax.scan(cell, (zeros, zeros),
+                             jnp.swapaxes(x, 0, 1))
+        return jnp.swapaxes(ys, 0, 1)
+
+
+class LastTimestep(ForwardBase):
+    """[batch, time, h] → [batch, h] (sequence classifier heads read
+    the final state)."""
+
+    PARAMS = ()
+
+    def fill_params(self):
+        pass
+
+    def output_shape_for(self, input_shape):
+        return (input_shape[0], input_shape[2])
+
+    def apply(self, params, x):
+        return x[:, -1, :]

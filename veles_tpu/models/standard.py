@@ -28,6 +28,7 @@ from veles_tpu.models.evaluator import EvaluatorMSE, EvaluatorSoftmax
 from veles_tpu.models.gd import GradientDescent
 from veles_tpu.models.lrn import LRNormalizerForward
 from veles_tpu.models.pooling import AvgPooling, Depooling, MaxPooling
+from veles_tpu.models.recurrent import LSTM, LastTimestep, SimpleRNN
 
 #: znicz layer-type names → forward unit classes
 LAYER_TYPES = {
@@ -48,6 +49,9 @@ LAYER_TYPES = {
     "dropout": DropoutForward,
     "norm": LRNormalizerForward,
     "attention": MultiHeadAttention,
+    "rnn": SimpleRNN,
+    "lstm": LSTM,
+    "last_timestep": LastTimestep,
 }
 
 

@@ -48,6 +48,28 @@ def alexnet_layers(classes=1000, dropout=0.5):
     ]
 
 
+def vgg_a_layers(classes=1000, dropout=0.5):
+    """VGG-A (extras item 6 "Last Models: AlexNet, VGG" — the
+    imagenet_workflow_vgga_config surface)."""
+    def conv(k):
+        return {"type": "conv_relu", "n_kernels": k, "kx": 3, "ky": 3,
+                "padding": 1}
+
+    pool = {"type": "max_pooling", "kx": 2, "ky": 2}
+    return [
+        conv(64), pool,
+        conv(128), pool,
+        conv(256), conv(256), pool,
+        conv(512), conv(512), pool,
+        conv(512), conv(512), pool,
+        {"type": "all2all_relu", "output_sample_shape": (4096,)},
+        {"type": "dropout", "dropout_ratio": dropout},
+        {"type": "all2all_relu", "output_sample_shape": (4096,)},
+        {"type": "dropout", "dropout_ratio": dropout},
+        {"type": "softmax", "output_sample_shape": (classes,)},
+    ]
+
+
 class ImagenetLoader(FullBatchLoader):
     """ImageNet-shaped loader: synthetic [N, 227, 227, 3] samples unless
     ``root.alexnet_tpu.train_dir`` points at a real image tree (then the
@@ -91,13 +113,17 @@ class AlexNetWorkflow(StandardWorkflow):
 
     def __init__(self, workflow, **kwargs):
         cfg = root.alexnet_tpu
+        # model = "alexnet" | "vgg_a" (the reference shipped both as
+        # configs of one imagenet workflow)
+        spec_fn = vgg_a_layers if cfg.get("model") == "vgg_a" \
+            else alexnet_layers
         super(AlexNetWorkflow, self).__init__(
             workflow, name="AlexNet",
             loader_factory=ImagenetLoader,
             loader_config={
                 "minibatch_size": int(cfg.get("minibatch_size", 256)),
             },
-            layers=alexnet_layers(
+            layers=spec_fn(
                 classes=int(cfg.get("classes", 1000)),
                 dropout=float(cfg.get("dropout", 0.5))),
             solver=cfg.get("solver", "sgd"),

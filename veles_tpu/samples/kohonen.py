@@ -1,0 +1,72 @@
+"""Kohonen map demo — the reference's DemoKohonen workflow
+(manualrst_veles_algorithms.rst "Kohonen maps"): a SOM grid organizes
+over 2-D Gaussian clusters.
+
+Run: ``python -m veles_tpu veles_tpu/samples/kohonen.py``
+"""
+
+import numpy
+
+from veles_tpu.accelerated_units import AcceleratedWorkflow
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.kohonen import (
+    KohonenDecision, KohonenForward, KohonenTrainer)
+from veles_tpu.plumbing import Repeater
+
+
+class ClustersLoader(FullBatchLoader):
+    """2-D points around ``clusters`` Gaussian centers (the DemoKohonen
+    dataset shape)."""
+
+    span_serving = False  # per-minibatch serving: the SOM trainer is
+    # not a span consumer
+
+    def load_data(self):
+        cfg = root.kohonen_tpu
+        rng = numpy.random.default_rng(7)
+        n = int(cfg.get("samples", 2048))
+        k = int(cfg.get("clusters", 4))
+        centers = rng.uniform(-1.0, 1.0, size=(k, 2))
+        idx = rng.integers(0, k, n)
+        pts = centers[idx] + rng.normal(scale=0.08, size=(n, 2))
+        self.class_lengths[:] = [0, 0, n]
+        self.original_data = pts.astype(numpy.float32)
+
+
+class KohonenWorkflow(AcceleratedWorkflow):
+    def __init__(self, workflow, **kwargs):
+        super(KohonenWorkflow, self).__init__(workflow, name="Kohonen",
+                                              **kwargs)
+        cfg = root.kohonen_tpu
+        shape = tuple(cfg.get("shape", (8, 8)))
+        self.repeater = Repeater(self)
+        self.repeater.link_from(self.start_point)
+        self.loader = ClustersLoader(
+            self, minibatch_size=int(cfg.get("minibatch_size", 256)))
+        self.loader.link_from(self.repeater)
+        self.trainer = KohonenTrainer(
+            self, loader=self.loader, shape=shape,
+            learning_rate=float(cfg.get("learning_rate", 0.5)))
+        self.trainer.link_from(self.loader)
+        self.forward = KohonenForward(
+            self, weights=self.trainer.weights, shape=shape)
+        self.forward.input = self.loader.minibatch_data
+        # BMU mapping is the inference surface — run it once per epoch,
+        # not per minibatch (the trainer computes its own winners)
+        self.forward.gate_skip = ~self.loader.train_ended
+        self.forward.link_from(self.trainer)
+        self.decision = KohonenDecision(
+            self, max_epochs=int(cfg.get("max_epochs", 10)))
+        self.decision.loader = self.loader
+        self.decision.trainer = self.trainer
+        self.decision.link_from(self.forward)
+        self.repeater.link_from(self.decision)
+        self.loader.gate_block = self.decision.complete
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+
+def run(load, main):
+    load(KohonenWorkflow)
+    main()

@@ -1,0 +1,82 @@
+"""MNIST autoencoder — the reference's MnistAE workflow family
+(manualrst_veles_algorithms.rst "Autoencoder Neural Networks";
+published result: 0.5478 validation RMSE).
+
+Default topology is the FC autoencoder (784 → tanh(100) → 784, MSE on
+the input); ``root.mnist_ae_tpu.conv = True`` switches to the
+convolutional autoencoder shape (conv/pool encoder → deconv/depool
+decoder — the ImagenetAE family, extras item 1).
+
+Run: ``python -m veles_tpu veles_tpu/samples/mnist_ae.py``
+"""
+
+import numpy
+
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoaderMSE
+from veles_tpu.models.standard import StandardWorkflow
+from veles_tpu.samples.mnist import MnistLoader
+
+
+class MnistAELoader(FullBatchLoaderMSE, MnistLoader):
+    """MNIST images as both input and regression target
+    (ref: MnistAE loader shape)."""
+
+    def load_data(self):
+        MnistLoader.load_data(self)
+        if root.mnist_ae_tpu.get("conv"):
+            self.original_data = self.original_data.reshape(
+                -1, 28, 28, 1)
+        self.original_targets = self.original_data
+        self.original_labels = None  # regression: no classes
+
+
+class MnistAEWorkflow(StandardWorkflow):
+    def __init__(self, workflow, **kwargs):
+        cfg = root.mnist_ae_tpu
+        if cfg.get("conv"):
+            # conv/pool encoder → deconv/depool decoder (ImagenetAE
+            # family; extras item 1: Deconvolution, Depooling)
+            layers = [
+                {"type": "conv_relu", "n_kernels": 16, "kx": 3, "ky": 3,
+                 "padding": "same"},
+                {"type": "max_pooling", "kx": 2, "ky": 2},
+                {"type": "depooling", "kx": 2, "ky": 2},
+                {"type": "deconv", "n_kernels": 1, "kx": 3, "ky": 3,
+                 "padding": "same", "activation": "sigmoid"},
+            ]
+        else:
+            hidden = int(cfg.get("hidden", 100))
+            layers = [
+                {"type": "all2all_tanh", "output_sample_shape": (hidden,)},
+                {"type": "all2all_sigmoid",
+                 "output_sample_shape": (784,)},
+            ]
+        super(MnistAEWorkflow, self).__init__(
+            workflow, name="MnistAE",
+            loader_factory=MnistAELoader,
+            loader_config={
+                "minibatch_size": int(cfg.get("minibatch_size", 128)),
+            },
+            layers=layers,
+            loss="mse",
+            solver=cfg.get("solver", "adam"),
+            learning_rate=float(cfg.get("learning_rate", 0.001)),
+            decision_config={
+                "fail_iterations": int(cfg.get("fail_iterations", 20)),
+                "max_epochs": cfg.get("max_epochs"),
+            },
+            snapshotter_config={
+                "prefix": cfg.get("snapshot_prefix", "mnist_ae"),
+            },
+            **kwargs)
+
+    def rmse(self):
+        """Validation RMSE (the reference's published AE metric)."""
+        loss = self.decision.epoch_metrics.get("validation_loss")
+        return float(numpy.sqrt(loss)) if loss is not None else None
+
+
+def run(load, main):
+    load(MnistAEWorkflow)
+    main()

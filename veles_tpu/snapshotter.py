@@ -102,7 +102,7 @@ class SnapshotterToFile(SnapshotterBase):
                 with CODECS[self.compression](path, "w") as f:
                     pickle.dump(target, f,
                                 protocol=pickle.HIGHEST_PROTOCOL)
-            except (pickle.PicklingError, TypeError, AttributeError):
+            except Exception:  # any failure class — diagnose, then re-raise
                 # name the offending attribute path, not just the
                 # innermost type (ref: pickle2.py debug hooks)
                 from veles_tpu.pickle_debug import explain_pickle_failure
