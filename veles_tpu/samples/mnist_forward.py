@@ -1,0 +1,60 @@
+"""MNIST inference usage example (the reference shipped
+MNIST/mnist_forward.py as the "how do I run a trained model" demo).
+
+Two sources, matching the deployment surfaces:
+
+    python veles_tpu/samples/mnist_forward.py snapshots/mnist_current.pickle.gz
+    python veles_tpu/samples/mnist_forward.py model.tar.gz   # package_export
+
+Prints per-sample predicted digits + confidence for a batch of
+validation samples drawn through the workflow's own loader (snapshot
+source) or random inputs (package source).
+"""
+
+import sys
+
+import numpy
+
+
+def forward_from_snapshot(path, n=8):
+    import jax.numpy as jnp
+    from veles_tpu.snapshotter import SnapshotterToFile
+    wf = SnapshotterToFile.import_file(path)
+    loader = wf.loader
+    loader.load_data()  # datasets are not stored in snapshots
+    x = numpy.asarray(loader.original_data[:n], numpy.float32)
+    h = jnp.asarray(x)
+    for u in wf.forwards:
+        params = {k: jnp.asarray(a.map_read().mem)
+                  for k, a in u.param_arrays().items()}
+        h = u.apply(params, h)
+    return numpy.asarray(h)
+
+
+def forward_from_package(path, n=8):
+    from veles_tpu.package_export import load_package
+    pkg = load_package(path)
+    rng = numpy.random.default_rng(0)
+    x = rng.random((n,) + pkg.input_shape[1:], numpy.float32)
+    return numpy.asarray(pkg.run(x))
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print(__doc__)
+        return 2
+    path = argv[0]
+    n = int(argv[1]) if len(argv) > 1 else 8
+    if path.endswith((".tar.gz", ".tgz")):
+        probs = forward_from_package(path, n)
+    else:
+        probs = forward_from_snapshot(path, n)
+    for i, row in enumerate(probs):
+        digit = int(numpy.argmax(row))
+        print("sample %d: digit %d (p=%.3f)" % (i, digit, row[digit]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
