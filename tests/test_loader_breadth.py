@@ -330,3 +330,57 @@ def test_mnist_forward_example(tmp_path, capsys):
     assert fwd_main([path, "4"]) == 0
     out = capsys.readouterr().out
     assert out.count("sample ") == 4 and "digit" in out
+
+
+def test_serve_workflow_end_to_end(tmp_path):
+    """Snapshot → ServeWorkflow → live HTTP prediction round-trip
+    (ref pairing: restful_api.py:78 + loader/restful.py:52)."""
+    import gzip
+    import pickle
+    import time
+    import urllib.request
+    from veles_tpu.config import root
+    from veles_tpu.samples.mnist import MnistWorkflow
+
+    root.mnist_tpu.update({
+        "max_epochs": 1, "synthetic_train": 256, "synthetic_valid": 64,
+        "minibatch_size": 64, "snapshot_time_interval": 1e9,
+    })
+    dev = Device(backend="numpy")
+    trained = MnistWorkflow(None, layers=[16, 10])
+    trained.snapshotter.interval = 10**9
+    trained.snapshotter.time_interval = 10**9
+    trained.initialize(device=dev)
+    trained.run()
+    snap = str(tmp_path / "m.pickle.gz")
+    with gzip.open(snap, "wb") as f:
+        pickle.dump(trained, f)
+
+    from veles_tpu.samples.serve import ServeWorkflow
+    root.serve.update({"snapshot": snap, "port": 0, "max_wait": 0.5})
+    wf = ServeWorkflow(None)
+    wf.initialize(device=dev)
+    t = threading.Thread(target=wf.run, daemon=True)
+    t.start()
+    x = numpy.asarray(trained.loader.original_data[0])
+    body = json.dumps({"input": x.tolist()}).encode()
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d/api" % wf.api.port, data=body,
+        headers={"Content-Type": "application/json"})
+    reply = json.load(urllib.request.urlopen(req, timeout=20))
+    # clean shutdown over HTTP (the documented path)
+    sd = urllib.request.Request(
+        "http://127.0.0.1:%d/shutdown" % wf.api.port, data=b"{}")
+    assert json.load(urllib.request.urlopen(sd, timeout=10))["ok"]
+    t.join(15)
+    assert not t.is_alive(), "serve loop did not terminate"
+    probs = numpy.asarray(reply["result"])
+    assert probs.shape == (10,) and abs(probs.sum() - 1.0) < 1e-3
+    # must match the trained model's own forward on the same sample
+    import jax.numpy as jnp
+    h = jnp.asarray(x[None])
+    for u in trained.forwards:
+        params = {k: jnp.asarray(a.map_read().mem)
+                  for k, a in u.param_arrays().items()}
+        h = u.apply(params, h)
+    numpy.testing.assert_allclose(probs, numpy.asarray(h)[0], atol=5e-3)

@@ -92,6 +92,10 @@ class Main:
         self.launcher.run()
         if self.args.result_file:
             self.launcher.write_results(self.args.result_file)
+        if self.args.export_package:
+            self.workflow.package_export(self.args.export_package)
+            logging.getLogger("Main").info(
+                "package -> %s", self.args.export_package)
 
     # -- run ------------------------------------------------------------------
 
@@ -186,6 +190,11 @@ class Main:
         if workers and not self.args.listen:
             parser.error("-w/--workers requires -l/--listen "
                          "(the coordinator spawns the workers)")
+        if self.args.export_package and (
+                self.args.optimize or self.args.ensemble_train
+                or self.args.ensemble_test):
+            parser.error("--export-package applies to a single training "
+                         "run, not the optimize/ensemble fleet modes")
         if workers and workers.isdigit():
             workers = int(workers)
         # the re-exec tail spawned workers run: same workflow/config/

@@ -77,6 +77,11 @@ def build_parser():
                    help="-v debug, -vv everything")
     p.add_argument("--timings", action="store_true",
                    help="per-unit run timing printout")
+    p.add_argument("--export-package", default=None, metavar="FILE",
+                   help="after the run, export the forward chain as an "
+                        "inference package (contents.json + npy + "
+                        "StableHLO tar.gz; consumed by load_package and "
+                        "runtime/veles_runner)")
     p.add_argument("--debug-pickle", action="store_true",
                    help="after initialize, verify the workflow pickles "
                         "and name any unpicklable attribute paths "

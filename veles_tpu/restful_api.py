@@ -70,6 +70,9 @@ class RESTfulAPI(Unit):
         self.port = port
         self.host = host
         self.request_timeout = request_timeout
+        #: optional callable fired by POST /shutdown (serving workflows
+        #: wire their stop request here)
+        self.shutdown_callback = None
         self.demand("loader", "output")
 
     def init_unpickled(self):
@@ -88,6 +91,15 @@ class RESTfulAPI(Unit):
                 pass
 
             def do_POST(self):
+                if self.path.rstrip("/") == "/shutdown":
+                    blob = b'{"ok": true}'
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
+                    if api.shutdown_callback is not None:
+                        api.shutdown_callback()
+                    return
                 if self.path.rstrip("/") != "/api":
                     self.send_error(404)
                     return
