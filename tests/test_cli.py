@@ -115,3 +115,48 @@ def test_master_spawns_workers_end_to_end(tmp_path):
     results = json.loads(out.read_text())
     assert results["Total epochs"] >= 1
     assert "validation_error_pct" in results
+
+
+def test_frontend_composes_and_executes(tmp_path):
+    """--frontend serves the composer form; a submitted form becomes a
+    real executed run (ref: veles --frontend, __main__.py:258-332)."""
+    import threading
+    import urllib.parse
+    import urllib.request
+    from veles_tpu.cmdline import build_parser
+    from veles_tpu.frontend import Frontend, compose_argv
+
+    parser = build_parser()
+    # page renders every flag
+    frontend = Frontend(parser, port=0)
+    page = urllib.request.urlopen(
+        "http://127.0.0.1:%d/" % frontend.port, timeout=5).read().decode()
+    assert "--optimize" in page and "--listen" in page
+
+    # submitting the form resolves wait() with the composed argv
+    form = {"workflow": "wf.py", "config": "cfg.py",
+            "config_override": "root.a=1;;root.b=2",
+            "graphics": "1", "verbose": "1",
+            "result_file": str(tmp_path / "r.json")}
+    body = urllib.parse.urlencode(form).encode()
+    out = {}
+
+    def submit():
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/compose" % frontend.port, data=body)
+        out["reply"] = json.load(urllib.request.urlopen(req, timeout=5))
+
+    t = threading.Thread(target=submit)
+    t.start()
+    argv = frontend.wait(10)
+    t.join(5)
+    frontend.stop()
+    assert argv[:2] == ["wf.py", "cfg.py"]
+    assert argv.count("--config-override") == 2 and "root.b=2" in argv
+    assert "--graphics" in argv and "--verbose" in argv
+    assert out["reply"]["argv"] == argv
+
+    # compose_argv round-trips through the real parser
+    ns = build_parser().parse_args(argv)
+    assert ns.workflow == "wf.py" and ns.graphics
+    assert ns.config_override == ["root.a=1", "root.b=2"]

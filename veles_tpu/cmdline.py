@@ -77,6 +77,12 @@ def build_parser():
                    help="-v debug, -vv everything")
     p.add_argument("--timings", action="store_true",
                    help="per-unit run timing printout")
+    p.add_argument("--frontend", action="store_true",
+                   help="serve a browser form to compose the command "
+                        "line, then execute the submitted run "
+                        "(ref: veles --frontend)")
+    p.add_argument("--frontend-port", type=int, default=8070,
+                   help="frontend HTTP port")
     p.add_argument("--export-package", default=None, metavar="FILE",
                    help="after the run, export the forward chain as an "
                         "inference package (contents.json + npy + "
