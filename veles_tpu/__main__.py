@@ -161,6 +161,20 @@ class Main:
         level = (logging.WARNING, logging.INFO,
                  logging.DEBUG)[min(self.args.verbose + 1, 2)]
         setup_logging(level)
+        if self.args.frontend:
+            # browser-composed run (ref: __main__.py:258-332): wait for
+            # one submission, then execute it in this process.  Must
+            # dispatch BEFORE any config is applied — the composed run
+            # owns the global root tree, not this invocation's args.
+            from veles_tpu.frontend import Frontend
+            frontend = Frontend(parser, port=self.args.frontend_port)
+            argv = frontend.wait()
+            frontend.stop()
+            if not argv:
+                return 1
+            logging.getLogger("Main").info(
+                "frontend composed: %s", " ".join(argv))
+            return Main(argv).run()
         load_site_configs()
         if self.args.timings:
             root.common.timings = True
@@ -171,18 +185,6 @@ class Main:
         if self.args.dump_config:
             root.print_()
             return 0
-        if self.args.frontend:
-            # browser-composed run (ref: __main__.py:258-332): wait for
-            # one submission, then execute it in this process
-            from veles_tpu.frontend import Frontend
-            frontend = Frontend(parser, port=self.args.frontend_port)
-            argv = frontend.wait()
-            frontend.stop()
-            if not argv:
-                return 1
-            logging.getLogger("Main").info(
-                "frontend composed: %s", " ".join(argv))
-            return Main(argv).run()
         if self.args.ensemble_test:
             return self._run_ensemble_test()
         if not self.args.workflow:

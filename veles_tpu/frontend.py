@@ -15,7 +15,7 @@ _PAGE = """<!DOCTYPE html>
  body { font-family: sans-serif; margin: 2em; max-width: 48em; }
  label { display: block; margin-top: .6em; font-weight: bold; }
  .help { color: #666; font-weight: normal; font-size: .9em; }
- input[type=text] { width: 100%%; }
+ input[type=text] { width: 100%; }
  button { margin-top: 1em; padding: .5em 2em; }
 </style></head><body>
 <h2>Compose a veles_tpu run</h2>
@@ -50,14 +50,21 @@ def compose_argv(parser, form):
     then flags)."""
     argv = []
     by_dest = {a.dest: a for a in parser._actions}
-    for dest in ("workflow", "config"):
-        value = form.get(dest, "").strip()
-        if value:
-            argv.append(value)
+    workflow = form.get("workflow", "").strip()
+    config = form.get("config", "").strip()
+    if workflow:
+        argv.append(workflow)
+        if config:  # config is positional #2 — meaningless alone
+            argv.append(config)
+    elif config:
+        raise ValueError("a config file needs a workflow file")
     for dest, value in form.items():
         action = by_dest.get(dest)
         if action is None or not action.option_strings \
-                or dest in ("workflow", "config"):
+                or dest in ("workflow", "config",
+                            "frontend", "frontend_port"):
+            # composing another frontend would recurse into a second
+            # bind of the same port
             continue
         value = value.strip()
         if not value:
@@ -103,7 +110,11 @@ class Frontend(Logger):
                 length = int(self.headers.get("Content-Length", 0))
                 raw = self.rfile.read(length).decode()
                 form = dict(urllib.parse.parse_qsl(raw))
-                argv = compose_argv(frontend.parser, form)
+                try:
+                    argv = compose_argv(frontend.parser, form)
+                except ValueError as e:
+                    self.send_error(400, str(e))
+                    return
                 blob = json.dumps({"argv": argv}).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -129,3 +140,4 @@ class Frontend(Logger):
 
     def stop(self):
         self._server.shutdown()
+        self._server.server_close()  # release the port for the run
