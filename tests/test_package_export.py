@@ -232,3 +232,36 @@ def test_cpp_runner_grouped_conv_lrn(runner_binary, tmp_path):
     assert r.returncode == 0, r.stderr
     y = numpy.load(tmp_path / "out.npy")
     numpy.testing.assert_allclose(y, y_ref, atol=2e-2)
+
+
+def test_cpp_runner_mini_alexnet(runner_binary, tmp_path):
+    """The full AlexNet block set (strided valid conv, LRN, grouped
+    convs, pooling, dropout, big FC) through the native runner at
+    reduced spatial size."""
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.config import root as cfg_root
+    from veles_tpu.memory import Array
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.package_export import export_package, load_package
+    from veles_tpu.samples.alexnet import alexnet_layers
+
+    rng = numpy.random.default_rng(9)
+    x = rng.random((2, 67, 67, 3)).astype(numpy.float32)
+    wf = AcceleratedWorkflow(None, name="axmini")
+    units = make_forwards(wf, Array(x), alexnet_layers(classes=7))
+    dev = Device(backend="numpy")
+    for u in units:
+        u.initialize(device=dev)
+    path = str(tmp_path / "ax.tar.gz")
+    export_package(units, path, (2, 67, 67, 3), name="axmini")
+    y_ref = load_package(path).run(x, mode="python")
+    numpy.save(tmp_path / "in.npy", x)
+    r = subprocess.run(
+        [runner_binary, path, str(tmp_path / "in.npy"),
+         str(tmp_path / "out.npy")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    y = numpy.load(tmp_path / "out.npy")
+    assert y.shape == (2, 7)
+    numpy.testing.assert_allclose(y, y_ref, atol=2e-2)
+    assert numpy.all(abs(y.sum(axis=1) - 1.0) < 1e-3)
