@@ -192,3 +192,103 @@ class TestCoordinator:
 
         master = run_loop(main())
         assert len(master.applied) >= 3
+
+    def test_slow_worker_rejoins_after_one_strike(self):
+        """A single timeout drops the worker but does NOT blacklist it
+        (repeat-offender semantics, ref veles/server.py:383-394): the
+        once-slow worker reconnects and finishes the run."""
+        async def main():
+            master = FakeMasterWorkflow(n_jobs=2)
+            coord = Coordinator(master, port=0, job_timeout=0.2,
+                                blacklist_strikes=2,
+                                watchdog_interval=0.05)
+            await coord.start()
+
+            from veles_tpu.parallel.coordinator import (
+                recv_frame, send_frame)
+            # session 1: take a job, hang past the timeout
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", coord.port)
+            await send_frame(writer, {"checksum": "abc123", "power": 1.0,
+                                      "id": "slowpoke"})
+            await recv_frame(reader)
+            await send_frame(writer, {"cmd": "job"})
+            await recv_frame(reader)  # job in hand, now stall
+            await asyncio.sleep(0.6)  # > job_timeout, 1 strike
+            assert coord.strikes.get("slowpoke") == 1
+            assert "slowpoke" not in coord.blacklist
+            writer.close()
+
+            # session 2: same id rejoins and completes everything
+            good = WorkerClient(FakeWorkerWorkflow(),
+                                "127.0.0.1:%d" % coord.port,
+                                worker_id="slowpoke")
+            await asyncio.wait_for(good.run(), 10)
+            await coord.stop()
+            return master, coord
+
+        master, coord = run_loop(main())
+        assert len(master.applied) >= 2
+        assert "slowpoke" not in coord.blacklist
+        # the completed job cleared the strike record
+        assert coord.strikes.get("slowpoke") is None
+
+    def test_repeat_offender_blacklisted_then_forgiven(self):
+        """N strikes ban the worker; forgive() (or ban expiry) lets it
+        back in."""
+        async def main():
+            master = FakeMasterWorkflow(n_jobs=2)
+            coord = Coordinator(master, port=0, job_timeout=0.15,
+                                blacklist_strikes=2,
+                                blacklist_forgive=1e9,
+                                watchdog_interval=0.05)
+            await coord.start()
+
+            from veles_tpu.parallel.coordinator import (
+                recv_frame, send_frame)
+
+            async def stall_once():
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", coord.port)
+                await send_frame(writer, {"checksum": "abc123",
+                                          "power": 1.0, "id": "lemon"})
+                reply = await recv_frame(reader)
+                if "error" in reply:
+                    writer.close()
+                    return reply["error"]
+                await send_frame(writer, {"cmd": "job"})
+                await recv_frame(reader)
+                await asyncio.sleep(0.5)
+                writer.close()
+                return None
+
+            assert await stall_once() is None   # strike 1
+            assert await stall_once() is None   # strike 2 -> banned
+            assert "lemon" in coord.blacklist
+            assert await stall_once() == "blacklisted"
+
+            coord.forgive("lemon")
+            assert "lemon" not in coord.blacklist
+            good = WorkerClient(FakeWorkerWorkflow(),
+                                "127.0.0.1:%d" % coord.port,
+                                worker_id="lemon")
+            await asyncio.wait_for(good.run(), 10)
+            await coord.stop()
+            return master
+
+        master = run_loop(main())
+        assert len(master.applied) >= 2
+
+    def test_duration_window_bounded(self):
+        async def main():
+            master = FakeMasterWorkflow(n_jobs=600)
+            coord = Coordinator(master, port=0)
+            await coord.start()
+            client = WorkerClient(FakeWorkerWorkflow(),
+                                  "127.0.0.1:%d" % coord.port)
+            await asyncio.wait_for(client.run(), 60)
+            await coord.stop()
+            return coord
+
+        coord = run_loop(main())
+        assert len(coord.job_durations) <= Coordinator.DURATION_WINDOW

@@ -158,6 +158,13 @@ class Launcher(Logger):
             specs = [s for s in specs.split(",") if s]
         host, _, port = (self._listen or ":5050").rpartition(":")
         port = port or "5050"
+        if port == "0":
+            # spawned workers need a dialable address before the
+            # coordinator binds — an OS-assigned port can't be forwarded
+            # to them
+            raise ValueError(
+                "-l :0 (OS-assigned port) cannot be combined with -w "
+                "worker spawning; pick a fixed port")
         n_local_devices = len(self.device.jax_devices) \
             if self.device is not None else 1
         local_count = 0

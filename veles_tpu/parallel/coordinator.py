@@ -17,11 +17,17 @@ workflow checksum (mismatch ⇒ reject, ref: server.py:490-493) and the
 worker's compute power (ref: server.py:540-567).
 
 Failure handling (ref: server.py:619-655): per-worker job timers; a job
-exceeding ``max(mean + 3σ, job_timeout)`` drops the worker, requeues its
-minibatches (``Workflow.drop_slave``) and blacklists repeat offenders.
+exceeding ``max(mean + 3σ, job_timeout)`` drops the worker and requeues
+its minibatches (``Workflow.drop_slave``).  Blacklisting follows the
+reference's *repeat offender* semantics (ref: server.py:383-394): a
+worker is banned only after ``blacklist_strikes`` timeouts, a completed
+job clears its strikes, and bans expire after ``blacklist_forgive``
+seconds (plus an explicit :meth:`Coordinator.forgive`) so a once-slow
+worker on a loaded host can rejoin the fleet.
 """
 
 import asyncio
+import collections
 import contextlib
 import gzip
 import pickle
@@ -74,17 +80,37 @@ class WorkerDescription:
 class Coordinator(Logger):
     """The coordinator service (ref: veles/server.py:659 Server)."""
 
+    #: rolling window of recent job durations feeding the mean+3σ
+    #: watchdog threshold — bounded so a week-long elastic fleet doesn't
+    #: accumulate unbounded floats (the reference kept no history at all,
+    #: it tracked only per-slave start times, server.py:619-635)
+    DURATION_WINDOW = 256
+
     def __init__(self, workflow, host="127.0.0.1", port=5050,
-                 job_timeout=60.0):
+                 job_timeout=60.0, blacklist_strikes=3,
+                 blacklist_forgive=300.0, watchdog_interval=1.0):
         super(Coordinator, self).__init__()
         self.workflow = workflow
         self.host, self.port = host, port
         self.job_timeout = job_timeout
+        self.watchdog_interval = float(watchdog_interval)
+        self.blacklist_strikes = int(blacklist_strikes)
+        self.blacklist_forgive = float(blacklist_forgive)
         self.workers = {}
         self.blacklist = set()
-        self.job_durations = []
+        #: worker id -> {"count", "last_strike", "banned_at"} — ONE
+        #: record per offender so strike count, aging, and ban expiry
+        #: can't drift apart
+        self._offenders = {}
+        self.job_durations = collections.deque(maxlen=self.DURATION_WINDOW)
         self._server = None
         self._done = asyncio.Event()
+        self._stopping = False
+
+    @property
+    def strikes(self):
+        """Read-only view: worker id -> current strike count."""
+        return {wid: rec["count"] for wid, rec in self._offenders.items()}
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -98,15 +124,25 @@ class Coordinator(Logger):
     async def wait_finished(self):
         await self._done.wait()
 
-    async def stop(self):
+    async def stop(self, drain_timeout=10.0):
+        # no new jobs from here on (an abort-stop with jobs remaining
+        # must not keep dispatching through the drain window)
+        self._stopping = True
+        await self._broadcast_terminate()
+        # wait for sessions to END on their own (worker reads terminate,
+        # closes its end, handler unregisters it) rather than closing
+        # under them: a server-side close() with an unread frame (e.g. a
+        # final "job" request racing the terminate) sends TCP RST, which
+        # DISCARDS the terminate buffered toward the worker and strands
+        # it in a reconnect loop against a dead server (ref:
+        # launcher.py:588-592 "master waits for slaves to drain")
+        deadline = time.time() + drain_timeout
+        while time.time() < deadline and self.workers:
+            await asyncio.sleep(0.05)
         self._watchdog_task.cancel()
         with contextlib.suppress(asyncio.CancelledError):
             await self._watchdog_task
         for w in list(self.workers.values()):
-            try:
-                await send_frame(w.writer, {"cmd": "terminate"})
-            except Exception:
-                pass
             w.writer.close()
         self._server.close()
         # py3.12 wait_closed() blocks until every connection handler AND
@@ -132,11 +168,24 @@ class Coordinator(Logger):
             writer.close()
             return
         wid = hello.get("id") or str(uuid.uuid4())[:8]
+        self._expire_bans()
         if wid in self.blacklist:
             await send_frame(writer, {"error": "blacklisted"})
             writer.close()
             return
         worker = WorkerDescription(wid, hello.get("power", 1.0), writer)
+        stale = self.workers.get(wid)
+        if stale is not None:
+            # same-id rejoin over a fresh connection (the old one died
+            # silently): evict the stale session's registration so its
+            # eventual read-error cleanup can't tear down OUR entry, and
+            # requeue whatever the dead session had in flight
+            self.info("worker %s rejoined — evicting stale session", wid)
+            self._drop(stale, requeue=True)
+            try:
+                stale.writer.close()
+            except Exception:
+                pass
         self.workers[wid] = worker
         self.info("worker %s joined from %s (power %.1f)", wid, peer,
                   worker.power)
@@ -156,7 +205,12 @@ class Coordinator(Logger):
             msg = await recv_frame(reader)
             cmd = msg.get("cmd")
             if cmd == "job":
-                if self._done.is_set():
+                if self.workers.get(worker.id) is not worker:
+                    # dropped/evicted session — don't hand a ghost a job
+                    # (its in-flight bookkeeping would pollute the live
+                    # worker registered under the same id)
+                    return
+                if self._done.is_set() or self._stopping:
                     await send_frame(worker.writer, {"cmd": "terminate"})
                     self._drop(worker, requeue=False)
                     return
@@ -164,8 +218,11 @@ class Coordinator(Logger):
                     job = self.workflow.generate_data_for_slave(worker.id)
                 else:
                     # out of fresh jobs but updates still in flight —
-                    # the worker idles until drained (ref NEED_UPDATE
-                    # postponement, server.py:369-399)
+                    # the worker parks until the coordinator pushes a
+                    # resume (ref NEED_UPDATE postponement,
+                    # server.py:369-399; the reference postponed the
+                    # deferred rather than polling)
+                    worker.state = "IDLE"
                     await send_frame(worker.writer, {"cmd": "wait"})
                     continue
                 worker.state = "WORK"
@@ -173,17 +230,61 @@ class Coordinator(Logger):
                 await send_frame(worker.writer, {"cmd": "job",
                                                  "data": job})
             elif cmd == "update":
+                if self._done.is_set() or self._stopping:
+                    # run already complete — the straggler's update is
+                    # redundant; release it cleanly
+                    worker.state = "WAIT"
+                    await send_frame(worker.writer, {"cmd": "terminate"})
+                    self._drop(worker, requeue=False)
+                    return
+                if self.workers.get(worker.id) is not worker:
+                    # this session was dropped (watchdog timeout or a
+                    # same-id rejoin evicted it) and its minibatches were
+                    # requeued — applying the late update would double-
+                    # count the work when the requeued job completes
+                    self.warning("late update from dropped worker %s "
+                                 "discarded", worker.id)
+                    return
                 dt = time.time() - (worker.job_started or time.time())
                 self.job_durations.append(dt)
                 worker.state = "WAIT"
                 worker.jobs_done += 1
+                # a completed job proves the worker is healthy — clear
+                # its timeout strikes (repeat-offender semantics)
+                self._offenders.pop(worker.id, None)
                 self.workflow.apply_data_from_slave(msg["data"], worker.id)
                 if self._finished():
                     self._done.set()
-                    await send_frame(worker.writer, {"cmd": "terminate"})
+                    # push terminate to EVERYONE now — parked workers
+                    # would otherwise only learn at stop(), racing the
+                    # server close into a reconnect storm
+                    await self._broadcast_terminate()
+                else:
+                    # applying an update may have freed jobs — wake every
+                    # parked worker so it re-requests
+                    await self._wake_idle()
             elif cmd == "bye":
                 self._drop(worker, requeue=False)
                 return
+
+    async def _broadcast_terminate(self):
+        for w in list(self.workers.values()):
+            try:
+                await send_frame(w.writer, {"cmd": "terminate"})
+            except Exception:
+                pass
+
+    async def _wake_idle(self):
+        """Push a resume to every parked worker (replaces the worker-side
+        0.2s busy poll); the woken worker re-requests a job and the job
+        branch decides job/wait/terminate."""
+        for w in list(self.workers.values()):
+            if w.state == "IDLE":
+                w.state = "WAIT"
+                try:
+                    await send_frame(w.writer, {"cmd": "resume"})
+                except (ConnectionError, OSError):
+                    pass
 
     def _has_more_jobs(self):
         wf = self.workflow
@@ -197,16 +298,40 @@ class Coordinator(Logger):
     # -- failure detection (ref: server.py:619-655) ----------------------------
 
     def _drop(self, worker, requeue):
-        if worker.id not in self.workers:
+        if self.workers.get(worker.id) is not worker:
+            # already dropped, or a rejoined session owns the id now —
+            # never unregister a registration we don't own
             return
         del self.workers[worker.id]
-        if requeue:
+        if requeue and not self._done.is_set():
             # the workflow refiles the worker's in-flight minibatches
-            # (ref: loader/base.py:679-687 failed_minibatches)
+            # (ref: loader/base.py:679-687 failed_minibatches); the
+            # requeued work may unpark idle workers
             self.workflow.drop_slave(worker.id)
             self.info("worker %s dropped — work requeued", worker.id)
+            asyncio.ensure_future(self._wake_idle())
+
+    def forgive(self, worker_id):
+        """Lift a ban (operator override; auto-expiry is
+        ``blacklist_forgive`` seconds)."""
+        self.blacklist.discard(worker_id)
+        self._offenders.pop(worker_id, None)
+
+    def _expire_bans(self):
+        # one sweep ages both bans and sub-ban strike records — a
+        # churning elastic fleet of ephemeral worker ids must not
+        # accumulate offender entries forever
+        now = time.time()
+        for wid, rec in list(self._offenders.items()):
+            stamp = rec["banned_at"] or rec["last_strike"]
+            if now - stamp >= self.blacklist_forgive:
+                if rec["banned_at"]:
+                    self.info("worker %s ban expired — forgiven", wid)
+                self.forgive(wid)
 
     def _timeout_threshold(self):
+        """mean + 3·stddev over the rolling duration window, floored at
+        ``job_timeout`` (ref: server.py:619-635)."""
         if len(self.job_durations) < 4:
             return self.job_timeout
         mean = sum(self.job_durations) / len(self.job_durations)
@@ -216,20 +341,42 @@ class Coordinator(Logger):
 
     async def _watchdog(self):
         while True:
-            await asyncio.sleep(1.0)
+            await asyncio.sleep(self.watchdog_interval)
+            self._expire_bans()
             thr = self._timeout_threshold()
             now = time.time()
             for w in list(self.workers.values()):
                 if w.state == "WORK" and w.job_started \
                         and now - w.job_started > thr:
-                    self.warning("worker %s exceeded job timeout %.1fs — "
-                                 "dropping + blacklisting", w.id, thr)
-                    self.blacklist.add(w.id)
+                    rec = self._offenders.setdefault(
+                        w.id, {"count": 0, "last_strike": now,
+                               "banned_at": None})
+                    rec["count"] += 1
+                    rec["last_strike"] = now
+                    n = rec["count"]
+                    if n >= self.blacklist_strikes:
+                        self.warning(
+                            "worker %s exceeded job timeout %.1fs "
+                            "(strike %d/%d) — dropping + blacklisting",
+                            w.id, thr, n, self.blacklist_strikes)
+                        self.blacklist.add(w.id)
+                        rec["banned_at"] = now
+                    else:
+                        self.warning(
+                            "worker %s exceeded job timeout %.1fs "
+                            "(strike %d/%d) — dropping, may rejoin",
+                            w.id, thr, n, self.blacklist_strikes)
                     try:
                         w.writer.close()
                     except Exception:
                         pass
                     self._drop(w, requeue=True)
+
+
+class RejectedError(ConnectionError):
+    """The coordinator actively refused this worker (blacklisted,
+    checksum mismatch, …) — retrying cannot help, unlike transport
+    failures."""
 
 
 class WorkerClient(Logger):
@@ -252,6 +399,10 @@ class WorkerClient(Logger):
             try:
                 await self._session()
                 return
+            except RejectedError:
+                # a protocol-level refusal is permanent — reconnecting
+                # would hammer the coordinator and mask the real reason
+                raise
             except (ConnectionError, asyncio.IncompleteReadError, OSError):
                 attempts += 1
                 self.warning("connection lost — reconnect %d/%d",
@@ -269,17 +420,22 @@ class WorkerClient(Logger):
             })
             reply = await recv_frame(reader)
             if "error" in reply:
-                raise ConnectionError(reply["error"])
+                raise RejectedError(reply["error"])
             self.worker_id = reply["id"]
             self.info("joined as worker %s", self.worker_id)
             while True:
                 await send_frame(writer, {"cmd": "job"})
                 msg = await recv_frame(reader)
                 cmd = msg.get("cmd")
+                while cmd == "wait":
+                    # park until the coordinator pushes resume/terminate
+                    # (no busy poll — the coordinator wakes us the moment
+                    # an update frees jobs or the run completes)
+                    msg = await recv_frame(reader)
+                    cmd = msg.get("cmd")
                 if cmd == "terminate":
                     return
-                if cmd == "wait":
-                    await asyncio.sleep(0.2)
+                if cmd == "resume":
                     continue
                 update = {}
 
