@@ -104,19 +104,27 @@ def trained_wf():
 
 
 @pytest.mark.parametrize("backend,ext", [
-    ("markdown", ".md"), ("html", ".html"), ("notebook", ".ipynb")])
+    ("markdown", ".md"), ("html", ".html"), ("notebook", ".ipynb"),
+    ("latex", (".tex", ".pdf")), ("confluence", ".xhtml")])
 def test_publisher_backends(trained_wf, tmp_path, backend, ext):
     from veles_tpu.publishing import Publisher
     pub = Publisher(trained_wf, backend=backend,
                     output_dir=str(tmp_path))
     pub.run()
     assert pub.destination.endswith(ext)
+    if pub.destination.endswith(".pdf"):
+        return  # a TeX engine compiled it; content is binary
     content = open(pub.destination).read()
     assert "MNIST" in content
     if backend == "markdown":
         assert "validation_error_pct" in content
     if backend == "notebook":
         json.loads(content)  # valid ipynb JSON
+    if backend == "latex":
+        assert content.startswith("\\documentclass")
+        assert "\\end{document}" in content
+    if backend == "confluence":
+        assert "<h2>Metrics</h2>" in content
 
 
 # -- forge --------------------------------------------------------------------
@@ -171,3 +179,114 @@ def test_compare_snapshots(trained_wf, tmp_path, capsys):
         pickle.dump(trained_wf, f)
     assert main([a, b]) == 1
     assert "diverged" in capsys.readouterr().out
+
+
+def test_forge_version_history(tmp_path):
+    """Retained history: two uploads of one name, ordered /versions with
+    uploader+checksum metadata, fetch-by-version, immutability (409),
+    and client-side checksum verification (forge_server.py:103-455
+    git-backed history surface)."""
+    import urllib.error
+    from veles_tpu.forge import ForgeServer, fetch, upload, versions
+    server = ForgeServer(str(tmp_path / "store")).start()
+    try:
+        pkgs = {}
+        for ver, payload in (("1.0", b"first"), ("2.0", b"second")):
+            pkg = tmp_path / ("model-%s.tar.gz" % ver)
+            pkg.write_bytes(payload)
+            pkgs[ver] = payload
+            meta = upload(server.url, "histnet", ver, str(pkg),
+                          "rev " + ver, uploader="builder")
+            assert meta["uploader"] == "builder"
+            assert len(meta["sha256"]) == 64
+        history = versions(server.url, "histnet")
+        assert [m["version"] for m in history] == ["1.0", "2.0"]
+        assert history[0]["uploaded"] <= history[1]["uploaded"]
+        # fetch-by-version returns the exact original bytes
+        path, got = fetch(server.url, "histnet", str(tmp_path),
+                          version="1.0")
+        assert got == "1.0"
+        with open(path, "rb") as f:
+            assert f.read() == pkgs["1.0"]
+        # latest still resolves to the newest upload
+        _, got = fetch(server.url, "histnet", str(tmp_path))
+        assert got == "2.0"
+        # history is immutable: re-uploading 1.0 is rejected with 409
+        clash = tmp_path / "clash.tar.gz"
+        clash.write_bytes(b"overwrite attempt")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            upload(server.url, "histnet", "1.0", str(clash))
+        assert ei.value.code == 409
+        # and the stored bytes are untouched
+        path, _ = fetch(server.url, "histnet", str(tmp_path),
+                        version="1.0")
+        with open(path, "rb") as f:
+            assert f.read() == pkgs["1.0"]
+    finally:
+        server.stop()
+
+
+def test_forge_fetch_detects_corruption(tmp_path):
+    from veles_tpu.forge import ForgeServer, fetch, upload
+    server = ForgeServer(str(tmp_path / "store")).start()
+    try:
+        pkg = tmp_path / "m.tar.gz"
+        pkg.write_bytes(b"payload")
+        upload(server.url, "cnet", "1.0", str(pkg))
+        # corrupt the stored blob behind the server's back
+        stored = tmp_path / "store" / "cnet" / "1.0" / "package.tar.gz"
+        stored.write_bytes(b"tampered")
+        with pytest.raises(Exception):
+            fetch(server.url, "cnet", str(tmp_path), version="1.0")
+    finally:
+        server.stop()
+
+
+def test_confluence_backend_posts_page(trained_wf, tmp_path):
+    """The Confluence backend pushes storage-format XHTML to the REST
+    content endpoint (ref: publishing/confluence_backend.py:60-81 —
+    page store + URL reporting, rebuilt against REST instead of
+    XML-RPC).  Verified against a fake local endpoint."""
+    import http.server
+    import threading
+    from veles_tpu.publishing import Publisher
+
+    captured = {}
+
+    class FakeConfluence(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            captured["path"] = self.path
+            captured["auth"] = self.headers.get("Authorization")
+            length = int(self.headers.get("Content-Length", 0))
+            captured["doc"] = json.loads(self.rfile.read(length))
+            blob = json.dumps({"id": "123", "_links": {
+                "base": "http://wiki.local",
+                "webui": "/display/ML/report"}}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), FakeConfluence)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        pub = Publisher(trained_wf, backend="confluence",
+                        output_dir=str(tmp_path), backend_config={
+                            "server": "http://127.0.0.1:%d"
+                                      % httpd.server_address[1],
+                            "space": "ML", "token": "s3cret",
+                            "page": "MNIST run", "parent": "42"})
+        pub.run()
+        assert captured["path"] == "/rest/api/content"
+        assert captured["auth"] == "Bearer s3cret"
+        doc = captured["doc"]
+        assert doc["space"] == {"key": "ML"}
+        assert doc["title"] == "MNIST run"
+        assert doc["ancestors"] == [{"id": "42"}]
+        assert doc["body"]["storage"]["representation"] == "storage"
+        assert "<h2>Metrics</h2>" in doc["body"]["storage"]["value"]
+    finally:
+        httpd.shutdown()

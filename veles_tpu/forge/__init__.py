@@ -2,5 +2,6 @@
 model packages (the package_export archive format) through a central
 server with versioning."""
 
-from veles_tpu.forge.client import fetch, list_packages, upload  # noqa: F401
+from veles_tpu.forge.client import (  # noqa: F401
+    fetch, list_packages, upload, versions)
 from veles_tpu.forge.server import ForgeServer, ForgeStore  # noqa: F401

@@ -3,10 +3,15 @@ veles/forge/forge_server.py:462).
 
 Stores uploaded model packages (the package_export tar.gz format)
 under ``<store>/<name>/<version>/`` with a metadata.json each; serves
-list/fetch/upload over HTTP (stdlib threading server — the reference
-used Tornado + a git-backed version store; versions here are explicit
-directory names with upload timestamps)."""
+list/versions/fetch/upload over HTTP (stdlib threading server — the
+reference used Tornado + a git-backed version store,
+forge_server.py:103-455).  Version-history semantics: every version is
+retained with uploader/timestamp/sha256 metadata, ``/versions?name=``
+returns the ordered history, an existing name+version cannot be
+silently overwritten (HTTP 409 — the git store's equivalent of
+history immutability), and fetches are checksum-verified end to end."""
 
+import hashlib
 import json
 import os
 import re
@@ -20,27 +25,43 @@ from veles_tpu.logger import Logger
 _NAME_RE = re.compile(r"^[A-Za-z0-9_.-]{1,64}$")
 
 
+class VersionExists(ValueError):
+    """Re-upload of an existing name+version (history is immutable)."""
+
+
 class ForgeStore:
-    """Filesystem package store."""
+    """Filesystem package store with retained version history."""
 
     def __init__(self, directory):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
+        # the HTTP front is threaded: the exists-check + blob/metadata
+        # writes must be atomic or two racing uploads of one
+        # name+version both pass the immutability check and can pair
+        # A's blob with B's checksum
+        self._write_lock = threading.Lock()
 
     def _dir(self, name, version):
         if not _NAME_RE.match(name) or not _NAME_RE.match(version):
             raise ValueError("invalid package name/version")
         return os.path.join(self.directory, name, version)
 
-    def save(self, name, version, blob, metadata):
+    def save(self, name, version, blob, metadata, overwrite=False):
         d = self._dir(name, version)
-        os.makedirs(d, exist_ok=True)
-        with open(os.path.join(d, "package.tar.gz"), "wb") as f:
-            f.write(blob)
-        metadata = dict(metadata, name=name, version=version,
-                        uploaded=time.time(), size=len(blob))
-        with open(os.path.join(d, "metadata.json"), "w") as f:
-            json.dump(metadata, f, indent=1)
+        with self._write_lock:
+            if os.path.isfile(os.path.join(d, "metadata.json")) \
+                    and not overwrite:
+                raise VersionExists(
+                    "%s==%s already exists — versions are retained "
+                    "history, pick a new version" % (name, version))
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "package.tar.gz"), "wb") as f:
+                f.write(blob)
+            metadata = dict(metadata, name=name, version=version,
+                            uploaded=time.time(), size=len(blob),
+                            sha256=hashlib.sha256(blob).hexdigest())
+            with open(os.path.join(d, "metadata.json"), "w") as f:
+                json.dump(metadata, f, indent=1)
         return metadata
 
     def list(self):
@@ -56,18 +77,31 @@ class ForgeStore:
                         out.append(json.load(f))
         return out
 
+    def versions(self, name):
+        """Ordered upload history for one package (oldest first)."""
+        history = [m for m in self.list() if m["name"] == name]
+        if not history:
+            raise KeyError(name)
+        return sorted(history, key=lambda m: m["uploaded"])
+
     def fetch(self, name, version=None):
         if version is None:  # latest by upload time
-            versions = [m for m in self.list() if m["name"] == name]
-            if not versions:
-                raise KeyError(name)
-            version = max(versions, key=lambda m: m["uploaded"])[
-                "version"]
-        path = os.path.join(self._dir(name, version), "package.tar.gz")
+            version = self.versions(name)[-1]["version"]
+        d = self._dir(name, version)
+        path = os.path.join(d, "package.tar.gz")
         if not os.path.isfile(path):
             raise KeyError("%s==%s" % (name, version))
         with open(path, "rb") as f:
-            return f.read(), version
+            blob = f.read()
+        digest = hashlib.sha256(blob).hexdigest()
+        meta_path = os.path.join(d, "metadata.json")
+        if os.path.isfile(meta_path):
+            with open(meta_path) as f:
+                stored = json.load(f).get("sha256")
+            if stored and stored != digest:
+                raise IOError("stored package %s==%s fails its checksum"
+                              % (name, version))
+        return blob, version, digest
 
 
 class ForgeServer(Logger):
@@ -96,13 +130,16 @@ class ForgeServer(Logger):
                 try:
                     if url.path == "/list":
                         self._json(server.store.list())
+                    elif url.path == "/versions":
+                        self._json(server.store.versions(q["name"]))
                     elif url.path == "/fetch":
-                        blob, version = server.store.fetch(
+                        blob, version, digest = server.store.fetch(
                             q["name"], q.get("version"))
                         self.send_response(200)
                         self.send_header("Content-Type",
                                          "application/gzip")
                         self.send_header("X-Forge-Version", version)
+                        self.send_header("X-Forge-Sha256", digest)
                         self.send_header("Content-Length",
                                          str(len(blob)))
                         self.end_headers()
@@ -125,8 +162,11 @@ class ForgeServer(Logger):
                     blob = self.rfile.read(length)
                     meta = server.store.save(
                         q["name"], q.get("version", "1.0"), blob,
-                        {"description": q.get("description", "")})
+                        {"description": q.get("description", ""),
+                         "uploader": q.get("uploader", "")})
                     self._json(meta)
+                except VersionExists as e:
+                    self._json({"error": str(e)}, 409)
                 except Exception as e:
                     self._json({"error": str(e)[:200]}, 400)
 

@@ -47,9 +47,15 @@ class All2All(ForwardBase):
                        self.bias_stddev or 0.0, fan_in, fan_out)
 
     def apply(self, params, x):
-        y = matmul(x.reshape(x.shape[0], -1), params["weights"])
+        # activations stay in the compute dtype (bf16) through the FC
+        # trunk — the 4096-wide AlexNet layers are HBM-bandwidth-bound
+        # like the convs, and the MXU still accumulates in f32 inside
+        # the matmul; the evaluator recasts to f32 for the loss
+        from veles_tpu import dtypes
+        y = matmul(x.reshape(x.shape[0], -1), params["weights"],
+                   out_dtype=dtypes.compute_dtype())
         if self.include_bias:
-            y = y + params["bias"]
+            y = y + params["bias"].astype(y.dtype)
         y = get_activation(self.activation)(y)
         return y.reshape((x.shape[0],) + self.output_sample_shape)
 
@@ -98,8 +104,13 @@ class All2AllSoftmax(All2All):
 
     def logits(self, params, x):
         """Pre-softmax scores — the trainer's softmax-CE loss composes
-        over these for numerical stability."""
-        return super(All2AllSoftmax, self).apply(params, x)
+        over these for numerical stability, so unlike the hidden FC
+        layers (bf16 activations) the head keeps the matmul's f32
+        accumulator output."""
+        z = matmul(x.reshape(x.shape[0], -1), params["weights"])
+        if self.include_bias:
+            z = z + params["bias"]
+        return z.reshape((x.shape[0],) + self.output_sample_shape)
 
     def apply(self, params, x):
         z = self.logits(params, x)

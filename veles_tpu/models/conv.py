@@ -78,6 +78,10 @@ class Conv(ForwardBase):
         # matching operand/cotangent dtypes — a bf16-in/f32-out mix is
         # rejected by lax.conv.  The MXU accumulates in f32 internally
         # regardless; the loss is computed in f32 at the evaluator.
+        # (A space-to-depth rewrite of the AlexNet 11x11/4 stem was
+        # measured on v5e — per-minibatch blocking AND a pre-blocked
+        # dataset both ran slower than XLA's native strided conv, so
+        # no stem special-case exists here.)
         cd = dtypes.compute_dtype()
         return jax.lax.conv_general_dilated(
             x.astype(cd), kernel.astype(cd),

@@ -41,27 +41,20 @@ class LRNormalizerForward(ForwardBase):
         return input_shape
 
     def apply(self, params, x):
-        import numpy
-        from veles_tpu import dtypes
+        # On TPU: plain-autodiff band-matmul LRN (veles_tpu/ops/lrn.py
+        # documents the measured formulation shootout).  Off-TPU the
+        # same math as shifted adds — cheap on CPU, no band constant.
+        if jax.default_backend() == "tpu":
+            from veles_tpu.ops.lrn import lrn
+            return lrn(x, self.alpha, self.beta, self.n, self.k)
         sq = x * x
         half = self.n // 2
         c = x.shape[-1]
-        # The channel window sum is a BANDED MATMUL: channels live on the
-        # TPU lane dimension, where a reduce_window would lower to n-1
-        # cross-lane shifts (measured: ~38% of the whole AlexNet step).
-        # ssum = sq @ band rides the MXU instead and its VJP is just the
-        # transposed band matmul.
-        # band[src, dst] = 1 iff channel src falls in dst's window
-        # [dst-half, dst+n-1-half] (same semantics as a reduce_window
-        # padded (half, n-1-half))
-        src = numpy.arange(c)[:, None]
-        dst = numpy.arange(c)[None, :]
-        band = ((dst - src) <= half) & ((src - dst) <= (self.n - 1 - half))
-        cd = dtypes.compute_dtype()
-        ssum = jax.lax.dot_general(
-            sq.astype(cd), jnp.asarray(band.astype(numpy.float32), cd),
-            (((x.ndim - 1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32).astype(x.dtype)
+        pad = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) +
+                      [(half, self.n - 1 - half)])
+        ssum = pad[..., 0:c]
+        for i in range(1, self.n):
+            ssum = ssum + pad[..., i:i + c]
         s = self.k + self.alpha * ssum
         if self.beta == 0.75:
             # s^-0.75 = rsqrt(s)·sqrt(rsqrt(s)): cheap VPU ops (lax.pow

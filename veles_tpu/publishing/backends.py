@@ -120,5 +120,188 @@ class NotebookBackend:
         return path
 
 
+class LaTeXBackend:
+    """LaTeX article + PDF when a TeX engine is on PATH (ref:
+    publishing/pdf_backend.py role — the reference shelled out to an
+    external renderer too).  Without TeX the ``.tex`` artifact is the
+    deliverable."""
+
+    NAME = "latex"
+    EXT = ".tex"
+
+    @staticmethod
+    def _esc(s):
+        out = []
+        for ch in str(s):
+            if ch in "&%$#_{}":
+                out.append("\\" + ch)
+            elif ch == "\\":
+                out.append(r"\textbackslash{}")
+            elif ch == "~":
+                out.append(r"\textasciitilde{}")
+            elif ch == "^":
+                out.append(r"\textasciicircum{}")
+            else:
+                out.append(ch)
+        return "".join(out)
+
+    def render(self, payload, out_dir):
+        e = self._esc
+        lines = [
+            r"\documentclass{article}",
+            r"\usepackage{booktabs}",
+            r"\usepackage{graphicx}",
+            r"\title{%s}" % e(payload["title"]),
+            r"\date{%s}" % e(payload["generated"]),
+            r"\begin{document}",
+            r"\maketitle",
+            r"\noindent workflow: \texttt{%s} (%s); checksum "
+            r"\texttt{%s}" % (e(payload["workflow"]),
+                              e(payload["workflow_class"]),
+                              e(payload["checksum"][:16])),
+            r"\section*{Metrics}",
+            r"\begin{tabular}{ll}", r"\toprule",
+            r"metric & value \\", r"\midrule",
+        ]
+        for k, v in _metrics_rows(payload["metrics"]):
+            lines.append(r"%s & %s \\" % (e(k), e(v)))
+        lines += [r"\bottomrule", r"\end{tabular}",
+                  r"\section*{Unit timings}",
+                  r"\begin{tabular}{llrr}", r"\toprule",
+                  r"unit & class & runs & seconds \\", r"\midrule"]
+        for u in payload["units"]:
+            lines.append(r"%s & %s & %d & %.4f \\"
+                         % (e(u["name"]), e(u["class"]), u["runs"],
+                            u["seconds"]))
+        lines += [r"\bottomrule", r"\end{tabular}"]
+        if payload.get("plots"):
+            lines += [r"\section*{Plots}", r"\begin{itemize}"]
+            lines += [r"\item \textbf{%s} (%s)"
+                      % (e(name), e(plot.get("kind")))
+                      for name, plot in sorted(payload["plots"].items())]
+            lines += [r"\end{itemize}"]
+        lines += [r"\end{document}", ""]
+        path = os.path.join(out_dir,
+                            _slug(payload["workflow"]) + "_report.tex")
+        with open(path, "w") as f:
+            f.write("\n".join(lines))
+        return self._try_pdf(path, out_dir) or path
+
+    @staticmethod
+    def _try_pdf(tex_path, out_dir):
+        import shutil
+        import subprocess
+        for engine in ("tectonic", "pdflatex", "xelatex"):
+            exe = shutil.which(engine)
+            if not exe:
+                continue
+            args = [exe, tex_path] if engine == "tectonic" else \
+                [exe, "-interaction=nonstopmode",
+                 "-output-directory", out_dir, tex_path]
+            try:
+                subprocess.run(args, cwd=out_dir, capture_output=True,
+                               timeout=120, check=True)
+            except Exception:
+                continue  # this engine failed; try the next one
+            pdf = os.path.splitext(tex_path)[0] + ".pdf"
+            if os.path.isfile(pdf):
+                return pdf
+        return None
+
+
+class ConfluenceBackend:
+    """Publish the report as a Confluence page (ref:
+    publishing/confluence_backend.py + confluence.py — the reference
+    logged in over XML-RPC and stored storage-format content; this
+    rebuild targets the REST API: POST /rest/api/content with
+    storage-format XHTML).  Configuration comes from the backend
+    kwargs/config: ``server``, ``space``, ``token`` (or
+    ``username``/``password``), optional ``page`` title and ``parent``
+    page id.  Also writes the page XHTML beside the snapshots so the
+    report survives an unreachable server."""
+
+    NAME = "confluence"
+    EXT = ".xhtml"
+
+    def __init__(self, server=None, space=None, token=None,
+                 username=None, password=None, page=None, parent=None,
+                 timeout=30):
+        from veles_tpu.config import root
+        cfg = root.common.publishing.confluence
+        self.server = server or cfg.get("server")
+        self.space = space or cfg.get("space")
+        self.token = token or cfg.get("token")
+        self.username = username or cfg.get("username")
+        self.password = password or cfg.get("password")
+        self.page = page or cfg.get("page")
+        self.parent = parent or cfg.get("parent")
+        self.timeout = timeout
+        self.url = None  # the published page URL, for callers/tests
+
+    @staticmethod
+    def _esc(s):
+        return (str(s).replace("&", "&amp;").replace("<", "&lt;")
+                .replace(">", "&gt;"))
+
+    def storage_xhtml(self, payload):
+        """Confluence storage-format body."""
+        e = self._esc
+        rows = "".join("<tr><td>%s</td><td>%s</td></tr>" % (e(k), e(v))
+                       for k, v in _metrics_rows(payload["metrics"]))
+        units = "".join(
+            "<tr><td>%s</td><td>%s</td><td>%d</td><td>%.4f</td></tr>"
+            % (e(u["name"]), e(u["class"]), u["runs"], u["seconds"])
+            for u in payload["units"])
+        return (
+            "<p>workflow <code>%s</code> (%s) — generated %s — checksum "
+            "<code>%s</code></p>"
+            "<h2>Metrics</h2><table><tbody>"
+            "<tr><th>metric</th><th>value</th></tr>%s</tbody></table>"
+            "<h2>Unit timings</h2><table><tbody>"
+            "<tr><th>unit</th><th>class</th><th>runs</th>"
+            "<th>seconds</th></tr>%s</tbody></table>"
+            % (e(payload["workflow"]), e(payload["workflow_class"]),
+               e(payload["generated"]), e(payload["checksum"][:16]),
+               rows, units))
+
+    def render(self, payload, out_dir):
+        import base64
+        import json as _json
+        import urllib.request
+        body = self.storage_xhtml(payload)
+        path = os.path.join(out_dir,
+                            _slug(payload["workflow"]) + "_report.xhtml")
+        with open(path, "w") as f:
+            f.write(body)
+        if not self.server or not self.space:
+            return path  # offline render only
+        doc = {
+            "type": "page",
+            "title": self.page or payload["title"],
+            "space": {"key": self.space},
+            "body": {"storage": {"value": body,
+                                 "representation": "storage"}},
+        }
+        if self.parent:
+            doc["ancestors"] = [{"id": self.parent}]
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = "Bearer %s" % self.token
+        elif self.username:
+            cred = "%s:%s" % (self.username, self.password or "")
+            headers["Authorization"] = "Basic %s" % base64.b64encode(
+                cred.encode()).decode()
+        req = urllib.request.Request(
+            self.server.rstrip("/") + "/rest/api/content",
+            data=_json.dumps(doc).encode(), headers=headers)
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            reply = _json.load(r)
+        base = reply.get("_links", {}).get("base", self.server)
+        webui = reply.get("_links", {}).get("webui", "")
+        self.url = base + webui
+        return path
+
+
 BACKENDS = {b.NAME: b for b in (MarkdownBackend, HTMLBackend,
-                                NotebookBackend)}
+                                NotebookBackend, LaTeXBackend,
+                                ConfluenceBackend)}

@@ -17,9 +17,10 @@ class Publisher(Unit):
     VIEW_GROUP = "SERVICE"
 
     def __init__(self, workflow, backend="markdown", output_dir=None,
-                 title=None, **kwargs):
+                 title=None, backend_config=None, **kwargs):
         super(Publisher, self).__init__(workflow, **kwargs)
         self.backend_name = backend
+        self.backend_config = dict(backend_config or {})
         self.output_dir = output_dir
         self.title = title
         self.destination = None
@@ -52,7 +53,9 @@ class Publisher(Unit):
 
     def run(self):
         from veles_tpu.publishing.backends import BACKENDS
-        backend = BACKENDS[self.backend_name]()
+        cls = BACKENDS[self.backend_name]
+        backend = cls(**self.backend_config) if self.backend_config \
+            else cls()
         out_dir = self.output_dir \
             or root.common.dirs.get("snapshots", ".")
         os.makedirs(out_dir, exist_ok=True)

@@ -98,10 +98,14 @@ class ImagenetLoader(FullBatchLoader):
 
         @jax.jit
         def synth(key, lab):
+            # stored bf16: images live in HBM only to be gathered into
+            # bf16 minibatches — f32 storage doubles the gather traffic
+            # and costs a whole-dataset cast every span (profiled)
             data = jax.random.uniform(key, (tot, side, side, 3),
                                       jnp.float32)
-            return data + (lab.astype(jnp.float32) / classes)[
+            data = data + (lab.astype(jnp.float32) / classes)[
                 :, None, None, None]
+            return data.astype(jnp.bfloat16)
 
         with jax.default_device(dev):
             self.original_data = synth(
