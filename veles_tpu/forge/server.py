@@ -46,11 +46,10 @@ class ForgeStore:
             raise ValueError("invalid package name/version")
         return os.path.join(self.directory, name, version)
 
-    def save(self, name, version, blob, metadata, overwrite=False):
+    def save(self, name, version, blob, metadata):
         d = self._dir(name, version)
         with self._write_lock:
-            if os.path.isfile(os.path.join(d, "metadata.json")) \
-                    and not overwrite:
+            if os.path.isfile(os.path.join(d, "metadata.json")):
                 raise VersionExists(
                     "%s==%s already exists — versions are retained "
                     "history, pick a new version" % (name, version))

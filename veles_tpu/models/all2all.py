@@ -110,6 +110,9 @@ class All2AllSoftmax(All2All):
         z = matmul(x.reshape(x.shape[0], -1), params["weights"])
         if self.include_bias:
             z = z + params["bias"]
+        # identity for the default "linear" head; kept for heads
+        # constructed with an explicit activation kwarg
+        z = get_activation(self.activation)(z)
         return z.reshape((x.shape[0],) + self.output_sample_shape)
 
     def apply(self, params, x):

@@ -1,0 +1,121 @@
+"""Mixture-of-Experts FFN — the layer behind the ``ep`` mesh axis.
+
+The reference has no MoE (SURVEY §2.3: every parallel strategy beyond
+elastic DP is absent there); this unit exists so expert parallelism is
+a first-class strategy like sp/pp, per the SURVEY "TPU mapping"
+mandate.  Design:
+
+- top-k gating: softmax over the k largest gate logits per sample,
+  re-normalized (standard switch/top-2 routing without capacity
+  limits);
+- **dense einsum dispatch**: every expert sees every token and the
+  combine weights zero out non-selected experts.  At framework scale
+  this trades FLOPs for zero all-to-all machinery — and it makes the
+  ``ep`` sharding story pure XLA: expert-major parameters are sharded
+  over ``ep`` (see ``parallel/sharding.py``), the expert einsums run
+  expert-local, and the final combine contracts the expert dimension,
+  which XLA lowers to a ``psum`` over ``ep`` on ICI.
+
+Trains through :class:`~veles_tpu.models.gd.GradientDescent` like any
+ForwardBase chain (the gate and experts get gradients from the task
+loss; no auxiliary load-balancing loss — dense dispatch has no
+capacity overflow to balance against).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.memory import Array
+from veles_tpu.models.activations import get_activation
+from veles_tpu.models.nn_units import ForwardBase
+
+
+class MoE(ForwardBase):
+    """Top-k gated mixture of expert FFNs over the last feature axis.
+
+    x: [batch, d] -> y: [batch, d]; experts are 2-layer FFNs
+    d -> hidden -> d.  Expert-major params (``expert_*``) shard over
+    the ``ep`` mesh axis.
+    """
+
+    PARAMS = ("gate", "expert_w1", "expert_b1", "expert_w2",
+              "expert_b2")
+    ACTIVATION = "strict_relu"  # true max(0,x) — znicz "relu" is softplus
+
+    def __init__(self, workflow, n_experts=4, top_k=2, hidden=None,
+                 activation=None, **kwargs):
+        super(MoE, self).__init__(workflow, **kwargs)
+        self.n_experts = int(n_experts)
+        self.top_k = int(top_k)
+        if self.top_k > self.n_experts:
+            raise ValueError("top_k %d > n_experts %d"
+                             % (self.top_k, self.n_experts))
+        self.hidden = hidden  # None -> 4*d at fill time
+        self.activation = activation or self.ACTIVATION
+        self.gate = Array()
+        self.expert_w1 = Array()
+        self.expert_b1 = Array()
+        self.expert_w2 = Array()
+        self.expert_b2 = Array()
+
+    def output_shape_for(self, input_shape):
+        return input_shape
+
+    def fill_params(self):
+        d = int(numpy.prod(self.input.shape[1:]))
+        h = int(self.hidden or 4 * d)
+        self.hidden = h
+        e = self.n_experts
+        self.gate.reset(numpy.zeros((d, e), numpy.float32))
+        self._fill(self.gate.mem, self.weights_filling,
+                   self.weights_stddev, d, e)
+        self.expert_w1.reset(numpy.zeros((e, d, h), numpy.float32))
+        self.expert_w2.reset(numpy.zeros((e, h, d), numpy.float32))
+        for w, fi, fo in ((self.expert_w1.mem, d, h),
+                          (self.expert_w2.mem, h, d)):
+            for i in range(e):
+                self._fill(w[i], self.weights_filling,
+                           self.weights_stddev, fi, fo)
+        self.expert_b1.reset(numpy.zeros((e, h), numpy.float32))
+        self.expert_b2.reset(numpy.zeros(
+            (e, d), numpy.float32))
+
+    def combine_weights(self, params, x):
+        """[batch, n_experts] combine coefficients: softmax over the
+        top-k gate logits, zero elsewhere."""
+        logits = x @ params["gate"].astype(x.dtype)
+        vals, idx = jax.lax.top_k(logits, self.top_k)
+        probs = jax.nn.softmax(vals, axis=-1)
+        onehot = jax.nn.one_hot(idx, self.n_experts, dtype=x.dtype)
+        return jnp.einsum("bk,bke->be", probs.astype(x.dtype), onehot)
+
+    def apply(self, params, x):
+        from veles_tpu import dtypes
+        cd = dtypes.compute_dtype() if jnp.issubdtype(
+            x.dtype, jnp.floating) else x.dtype
+        xf = x.reshape(x.shape[0], -1).astype(cd)
+        c = self.combine_weights(
+            {"gate": params["gate"]}, xf)  # [b, e]
+        act = get_activation(self.activation)
+        # dense dispatch: expert dim e is batch-like in the einsums, so
+        # ep-sharded expert params keep both matmuls expert-local...
+        h1 = jnp.einsum("bd,edh->ebh", xf,
+                        params["expert_w1"].astype(cd),
+                        preferred_element_type=jnp.float32)
+        h1 = act((h1 + params["expert_b1"].astype(
+            jnp.float32)[:, None, :]).astype(cd))
+        y = jnp.einsum("ebh,ehd->ebd", h1,
+                       params["expert_w2"].astype(cd),
+                       preferred_element_type=jnp.float32)
+        y = y + params["expert_b2"].astype(jnp.float32)[:, None, :]
+        # ...and the combine contracts e — the one collective (psum
+        # over ep) of the whole layer
+        out = jnp.einsum("be,ebd->bd", c.astype(jnp.float32),
+                         y)
+        return out.astype(x.dtype).reshape(x.shape[0], *x.shape[1:])
+
+    def export_config(self):
+        return {"n_experts": self.n_experts, "top_k": self.top_k,
+                "hidden": int(self.hidden),
+                "activation": self._export_activation()}

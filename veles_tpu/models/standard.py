@@ -18,6 +18,7 @@ overrides (extras item 13) — both znicz conventions.
 
 from veles_tpu.accelerated_units import AcceleratedWorkflow
 from veles_tpu.models.attention import MultiHeadAttention
+from veles_tpu.models.moe import MoE
 from veles_tpu.models.all2all import (
     All2All, All2AllRELU, All2AllSigmoid, All2AllSoftmax,
     All2AllStrictRELU, All2AllTanh)
@@ -49,6 +50,7 @@ LAYER_TYPES = {
     "dropout": DropoutForward,
     "norm": LRNormalizerForward,
     "attention": MultiHeadAttention,
+    "moe": MoE,
     "rnn": SimpleRNN,
     "lstm": LSTM,
     "last_timestep": LastTimestep,

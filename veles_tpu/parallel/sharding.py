@@ -15,6 +15,8 @@ The default policy:
   reduce-scatters the gradients (ZeRO-3 semantics via sharding
   propagation);
 - conv kernels [h, w, i, o]: ``tp`` over output channels;
+- expert-major MoE params (``expert_*``, [E, ...]): ``ep`` over the
+  expert dimension (models/moe.py);
 - solver state: same layout as its parameter (scalars replicated);
 - everything else replicated.
 """
@@ -75,8 +77,15 @@ def param_spec(mesh, name, shape):
     """Sharding spec for one parameter tensor by convention."""
     tp = _axis_size(mesh, "tp")
     fsdp = _axis_size(mesh, "fsdp")
+    ep = _axis_size(mesh, "ep")
     ndim = len(shape)
     spec = [None] * ndim
+    if name.startswith("expert_") and ep > 1 and ndim >= 2 \
+            and shape[0] % ep == 0:
+        # expert-major MoE parameters: the expert dimension lives on
+        # ``ep`` (models/moe.py — expert einsums run expert-local, the
+        # combine psums over ep)
+        spec[0] = "ep"
     if ndim >= 1 and tp > 1 and shape[-1] % tp == 0:
         spec[-1] = "tp"
     if fsdp > 1:

@@ -294,8 +294,18 @@ class ConfluenceBackend:
         req = urllib.request.Request(
             self.server.rstrip("/") + "/rest/api/content",
             data=_json.dumps(doc).encode(), headers=headers)
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            reply = _json.load(r)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                reply = _json.load(r)
+        except Exception as e:
+            # the offline .xhtml artifact above is the fallback — an
+            # unreachable/refusing server must not crash the workflow's
+            # end-of-train publishing step
+            import logging
+            logging.getLogger("ConfluenceBackend").warning(
+                "publish to %s failed (%s) — offline report kept at %s",
+                self.server, e, path)
+            return path
         base = reply.get("_links", {}).get("base", self.server)
         webui = reply.get("_links", {}).get("webui", "")
         self.url = base + webui
