@@ -33,19 +33,33 @@ inline std::map<std::string, std::vector<uint8_t>> ReadTarGz(
     if (h[0] == 0) break;  // two zero blocks terminate the archive
     char name[101] = {0};
     std::memcpy(name, h, 100);
+    // size field: strict octal only (no base-256/extended encodings —
+    // the exporter never writes them), validated against the remaining
+    // archive BEFORE the skip arithmetic so a crafted size can neither
+    // overflow pos nor silently end the walk early
     char size_s[13] = {0};
     std::memcpy(size_s, h + 124, 12);
+    if (size_s[0] & 0x80)
+      throw std::runtime_error("tar base-256 size unsupported: " +
+                               std::string(name));
+    for (const char* c = size_s; *c; ++c)
+      if ((*c < '0' || *c > '7') && *c != ' ')
+        throw std::runtime_error("non-octal tar size field: " +
+                                 std::string(name));
     size_t size = std::strtoul(size_s, nullptr, 8);
     char type = static_cast<char>(h[156]);
     pos += 512;
+    if (size > raw.size() - pos)
+      throw std::runtime_error("tar member overruns archive: " +
+                               std::string(name));
     if (type == '0' || type == 0) {
-      if (pos + size > raw.size())
-        throw std::runtime_error("truncated tar member: " +
-                                 std::string(name));
       files[name] = std::vector<uint8_t>(raw.begin() + pos,
                                          raw.begin() + pos + size);
     }
-    pos += (size + 511) / 512 * 512;
+    size_t padded = (size + 511) / 512 * 512;
+    if (padded < size || padded > raw.size() - pos)
+      break;  // final member's padding may legally run past the end
+    pos += padded;
   }
   return files;
 }

@@ -36,23 +36,32 @@ inline std::string ReadHeader(const uint8_t* buf, size_t len,
   return std::string(reinterpret_cast<const char*>(buf + hstart), hlen);
 }
 
-// pull "'key': value" fields out of the header's python-dict literal
+// pull "'key': value" fields out of the header's python-dict literal.
+// Every find() is bound-checked — a malformed header must raise, not
+// wrap npos+1 to 0 and silently parse unrelated text.
 inline std::string DictField(const std::string& h, const std::string& key) {
   size_t p = h.find("'" + key + "'");
   if (p == std::string::npos)
     throw std::runtime_error("npy header missing " + key);
   p = h.find(':', p);
+  if (p == std::string::npos)
+    throw std::runtime_error("malformed npy header at " + key);
   ++p;
   while (p < h.size() && (h[p] == ' ')) ++p;
+  if (p >= h.size())
+    throw std::runtime_error("malformed npy header at " + key);
   size_t end = p;
   if (h[p] == '\'') {
-    end = h.find('\'', p + 1) + 1;
+    end = h.find('\'', p + 1);
   } else if (h[p] == '(') {
-    end = h.find(')', p) + 1;
+    end = h.find(')', p);
   } else {
     while (end < h.size() && h[end] != ',' && h[end] != '}') ++end;
+    return h.substr(p, end - p);
   }
-  return h.substr(p, end - p);
+  if (end == std::string::npos)
+    throw std::runtime_error("malformed npy header at " + key);
+  return h.substr(p, end + 1 - p);
 }
 
 inline Tensor Load(const std::vector<uint8_t>& bytes) {
@@ -77,31 +86,42 @@ inline Tensor Load(const std::vector<uint8_t>& bytes) {
       ++p;
     }
   }
-  size_t n = t.count();
-  t.data.resize(n);
+  // overflow-safe element count: the shape product and the n*8 byte
+  // counts below must not wrap before the buffer-size validation —
+  // a crafted header could otherwise force a huge/miss-sized resize
   const uint8_t* d = bytes.data() + off;
   size_t avail = bytes.size() - off;
-  auto need = [&](size_t want) {
-    if (avail < want) throw std::runtime_error("npy data truncated");
+  size_t n = 1;
+  for (size_t dim : t.shape) {
+    if (dim != 0 && n > SIZE_MAX / dim)
+      throw std::runtime_error("npy shape product overflows size_t");
+    n *= dim;
+  }
+  if (n > avail)  // every supported dtype is >= 1 byte/element
+    throw std::runtime_error("npy data truncated");
+  t.data.resize(n);
+  auto need = [&](size_t bytes_per_elem) {
+    if (n != 0 && avail / bytes_per_elem < n)
+      throw std::runtime_error("npy data truncated");
   };
   if (descr.find("f4") != std::string::npos) {
-    need(n * 4);
+    need(4);
     std::memcpy(t.data.data(), d, n * 4);
   } else if (descr.find("f8") != std::string::npos) {
-    need(n * 8);
+    need(8);
     const double* src = reinterpret_cast<const double*>(d);
     for (size_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(src[i]);
   } else if (descr.find("i4") != std::string::npos) {
-    need(n * 4);
+    need(4);
     const int32_t* src = reinterpret_cast<const int32_t*>(d);
     for (size_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(src[i]);
   } else if (descr.find("i8") != std::string::npos) {
-    need(n * 8);
+    need(8);
     const int64_t* src = reinterpret_cast<const int64_t*>(d);
     for (size_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(src[i]);
   } else if (descr.find("u1") != std::string::npos ||
              descr.find("|b1") != std::string::npos) {
-    need(n);
+    need(1);
     for (size_t i = 0; i < n; ++i) t.data[i] = static_cast<float>(d[i]);
   } else {
     throw std::runtime_error("unsupported npy dtype: " + descr);

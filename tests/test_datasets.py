@@ -1,0 +1,99 @@
+"""Procedural quality-surrogate datasets (veles_tpu/datasets/)."""
+
+import numpy
+
+from veles_tpu.datasets import render_digits, render_scenes
+
+
+class TestGlyphs:
+    def test_shapes_and_range(self):
+        imgs, labels = render_digits(256, seed=3)
+        assert imgs.shape == (256, 28, 28)
+        assert imgs.dtype == numpy.float32
+        assert 0.0 <= imgs.min() and imgs.max() <= 1.0
+        assert set(numpy.unique(labels)) <= set(range(10))
+
+    def test_deterministic(self):
+        a, la = render_digits(64, seed=7)
+        b, lb = render_digits(64, seed=7)
+        assert numpy.array_equal(a, b) and numpy.array_equal(la, lb)
+        c, _ = render_digits(64, seed=8)
+        assert not numpy.array_equal(a, c)
+
+    def test_chunked_equals_metadata(self):
+        # chunked rendering must still produce balanced labels and
+        # stable stats across the chunk boundary
+        imgs, labels = render_digits(9000, seed=1, _chunk=4096)
+        assert len(imgs) == 9000
+        counts = numpy.bincount(labels, minlength=10)
+        assert counts.min() > 600  # roughly balanced
+
+    def test_learnable_but_not_trivial(self):
+        # a linear model must beat chance by a lot yet stay imperfect —
+        # the difficulty window that makes the benchmark meaningful
+        from sklearn.linear_model import LogisticRegression
+        imgs, labels = render_digits(3000, seed=2)
+        X = imgs.reshape(len(imgs), -1)
+        clf = LogisticRegression(max_iter=60).fit(X[:2500], labels[:2500])
+        err = 1 - clf.score(X[2500:], labels[2500:])
+        assert 0.02 < err < 0.35, err
+
+
+class TestScenes:
+    def test_shapes_and_range(self):
+        imgs, labels = render_scenes(256, seed=3)
+        assert imgs.shape == (256, 32, 32, 3)
+        assert 0.0 <= imgs.min() and imgs.max() <= 1.0
+
+    def test_deterministic(self):
+        a, la = render_scenes(64, seed=7)
+        b, lb = render_scenes(64, seed=7)
+        assert numpy.array_equal(a, b) and numpy.array_equal(la, lb)
+
+    def test_label_noise_rate(self):
+        _, clean = render_scenes(4000, seed=5, label_noise=0.0)
+        _, noisy = render_scenes(4000, seed=5, label_noise=0.115)
+        flipped = (clean != noisy).mean()
+        # 0.115 nominal, minus 1/10 self-flips
+        assert 0.07 < flipped < 0.14, flipped
+
+    def test_color_carries_no_label(self):
+        # per-image mean color must not predict the class (the CIFAR
+        # property the generator is built around)
+        from sklearn.linear_model import LogisticRegression
+        imgs, labels = render_scenes(4000, seed=2, label_noise=0.0)
+        feats = imgs.mean(axis=(1, 2))  # [n, 3]
+        clf = LogisticRegression(max_iter=200).fit(
+            feats[:3500], labels[:3500])
+        err = 1 - clf.score(feats[3500:], labels[3500:])
+        assert err > 0.8, err  # chance is 0.9
+
+
+def test_loaders_use_surrogates(tmp_path):
+    """synthetic_kind switches the sample loaders onto the quality
+    surrogates."""
+    from veles_tpu.config import root
+    from veles_tpu.samples.cifar import CifarLoader
+    from veles_tpu.samples.mnist import MnistLoader
+
+    root.mnist_tpu.update({"synthetic_kind": "glyphs",
+                           "synthetic_train": 256,
+                           "synthetic_valid": 64})
+    try:
+        loader = MnistLoader(None, minibatch_size=32)
+        loader.load_data()
+        assert loader.original_data.shape == (320, 784)
+        # glyph images are sparse strokes, unlike dense gaussian blobs
+        assert (numpy.asarray(loader.original_data) < 0.2).mean() > 0.5
+    finally:
+        root.mnist_tpu.synthetic_kind = "blobs"
+
+    root.cifar_tpu.update({"synthetic_kind": "scenes",
+                           "synthetic_train": 128,
+                           "synthetic_valid": 32})
+    try:
+        loader = CifarLoader(None, minibatch_size=32)
+        loader.load_data()
+        assert loader.original_data.shape == (160, 32, 32, 3)
+    finally:
+        root.cifar_tpu.synthetic_kind = "blobs"

@@ -290,3 +290,31 @@ def test_confluence_backend_posts_page(trained_wf, tmp_path):
         assert "<h2>Metrics</h2>" in doc["body"]["storage"]["value"]
     finally:
         httpd.shutdown()
+
+
+def test_safe_pickle_blocks_code_execution():
+    """ADVICE r2 (medium): network frames decode through a restricted
+    unpickler — a frame smuggling an executable constructor is
+    rejected, plain data round-trips."""
+    import pickle as _p
+    import numpy as _np
+    import pytest as _pytest
+    from veles_tpu.safe_pickle import safe_loads
+
+    data = {"x": _np.arange(6, dtype=_np.float32).reshape(2, 3),
+            "label": 3, "name": "batch", "nested": [(1, 2.5), b"raw"]}
+    out = safe_loads(_p.dumps(data, protocol=_p.HIGHEST_PROTOCOL))
+    assert _np.array_equal(out["x"], data["x"])
+    assert out["nested"] == data["nested"]
+
+    class Evil:
+        def __reduce__(self):
+            import os
+            return (os.system, ("echo pwned",))
+
+    with _pytest.raises(_p.UnpicklingError):
+        safe_loads(_p.dumps(Evil()))
+    # even a direct reference to a subprocess callable is refused
+    blob = _p.dumps(__import__("subprocess").getoutput)
+    with _pytest.raises(_p.UnpicklingError):
+        safe_loads(blob)

@@ -9,6 +9,8 @@ secondary workflow observe a primary's tensors without sharing memory.
 
 import pickle
 
+from veles_tpu.safe_pickle import safe_loads
+
 from veles_tpu.logger import Logger
 from veles_tpu.memory import Array
 from veles_tpu.units import Unit
@@ -36,12 +38,14 @@ class AvatarServer(Logger):
             self.port = self._sock.bind_to_random_port("tcp://" + host)
         self.endpoint = "tcp://%s:%d" % (host, self.port)
         self.info("avatar server on %s", self.endpoint)
+        from veles_tpu.safe_pickle import warn_if_public
+        warn_if_public(self.endpoint, self)
 
     def serve_once(self, timeout=5000):
         """Answer one request; returns False on timeout."""
         if not self._sock.poll(timeout):
             return False
-        names = pickle.loads(self._sock.recv())
+        names = safe_loads(self._sock.recv())
         payload = {}
         for name in names or self.arrays:
             arr = self.arrays.get(name)
@@ -87,7 +91,7 @@ class Avatar(Unit):
         self._sock_.send(pickle.dumps(self.names or None))
         if not self._sock_.poll(self.timeout * 1000):
             raise TimeoutError("avatar source %s silent" % self.endpoint)
-        payload = pickle.loads(self._sock_.recv())
+        payload = safe_loads(self._sock_.recv())
         for name, mem in payload.items():
             mirror = self.mirrors.get(name)
             if mirror is None:

@@ -1,0 +1,17 @@
+"""Procedurally generated quality-benchmark datasets.
+
+The build environment has zero egress, so the reference's published
+quality numbers (MNIST 1.48% / CIFAR-10 17.21% validation error,
+docs/source/manualrst_veles_algorithms.rst:31,51) cannot be reproduced
+on the real corpora here.  These generators are the documented
+surrogates of matched *task structure*: 10-way image classification
+where classes overlap through deformation and noise, so a model must
+learn shape — not color statistics — to win.  The quality harness
+(``quality.py`` at the repo root) trains the reference configs on them
+and records the results in ``QUALITY_r<N>.json``; when real IDX/pickle
+corpora are placed under ``root.common.dirs.datasets`` the same
+workflows train on the real thing instead.
+"""
+
+from veles_tpu.datasets.glyphs import render_digits  # noqa: F401
+from veles_tpu.datasets.scenes import render_scenes  # noqa: F401

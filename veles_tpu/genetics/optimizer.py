@@ -80,22 +80,36 @@ class GeneticsOptimizer:
         self.generations = generations
         self.history = []
 
+    def _evaluate_generation(self, gen):
+        """Fitness for every unevaluated individual — one at a time
+        locally, or as a whole generation of coordinator jobs when the
+        evaluator is fleet-backed (``evaluate_batch``, the reference's
+        distributed GA: individuals were slave jobs,
+        genetics/optimization_workflow.py:298)."""
+        pending = [(i, indiv) for i, indiv in
+                   enumerate(self.population.individuals)
+                   if indiv.fitness is None]
+        if hasattr(self.evaluate, "evaluate_batch"):
+            batch = [(indiv.overrides(self.tuneables),
+                      1000 + gen * 100 + i) for i, indiv in pending]
+            fits = self.evaluate.evaluate_batch(batch)
+        else:
+            fits = [self.evaluate(indiv.overrides(self.tuneables),
+                                  seed=1000 + gen * 100 + i)
+                    for i, indiv in pending]
+        for (i, indiv), fit in zip(pending, fits):
+            indiv.fitness = fit
+            log.info("gen %d individual %d: fitness %s  genes %s",
+                     gen, i, fit, indiv.genes)
+        return [f for f in fits if f is not None]
+
     def run(self):
         for gen in range(self.generations):
-            worst = None
-            for i, indiv in enumerate(self.population.individuals):
-                if indiv.fitness is not None:
-                    continue  # already evaluated (injected evaluators)
-                # note: elites are re-evaluated each generation — fitness
-                # from a short training run is noisy, and a lucky seed
-                # must not colonize the population forever
-                fit = self.evaluate(indiv.overrides(self.tuneables),
-                                    seed=1000 + gen * 100 + i)
-                indiv.fitness = fit
-                if fit is not None:
-                    worst = fit if worst is None else min(worst, fit)
-                log.info("gen %d individual %d: fitness %s  genes %s",
-                         gen, i, fit, indiv.genes)
+            # note: elites are re-evaluated each generation — fitness
+            # from a short training run is noisy, and a lucky seed
+            # must not colonize the population forever
+            evaluated = self._evaluate_generation(gen)
+            worst = min(evaluated) if evaluated else None
             fallback = (worst if worst is not None else 0.0) - 1.0
             for indiv in self.population.individuals:
                 if indiv.fitness is None:

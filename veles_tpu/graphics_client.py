@@ -14,6 +14,8 @@ import argparse
 import gzip
 import os
 import pickle
+
+from veles_tpu.safe_pickle import safe_loads
 import sys
 
 import numpy
@@ -96,7 +98,7 @@ def main(argv=None):
     while True:
         if not poller.poll(args.timeout * 1000):
             break
-        payload = pickle.loads(gzip.decompress(sock.recv()))
+        payload = safe_loads(gzip.decompress(sock.recv()))
         fig = render_payload(payload, figure=fig)
         path = os.path.join(
             args.out, "%s.png" % payload.get("name", "plot"))

@@ -21,6 +21,15 @@ Consumers:
   serialized program byte-for-byte as exported.
 - ``runtime/`` — the C++ inference runner parses the same archive with
   its own npy/json/tar readers and executes natively.
+
+**Format compatibility:** this is a deliberately NEW format, not the
+reference's.  libVeles archives use ``units[i].class.{name,uuid}``
+nesting, a ``links`` graph, ``@NNNN_shape`` array references and zip by
+default (veles/workflow.py:868-975); this exporter writes a flat
+unit list, ``u<i>_<name>.npy`` files and tar.gz, and adds the
+StableHLO program libVeles never had.  Reference libVeles tooling
+cannot load these archives (and vice versa) — the ``"veles_tpu"``
+``format`` key in contents.json marks the difference explicitly.
 """
 
 import io
@@ -79,6 +88,7 @@ def export_package(forwards, path, input_shape, input_dtype=numpy.float32,
     to it, the same static-shape discipline the framework uses on TPU.
     """
     manifest = {
+        "format": "veles_tpu",  # NOT libVeles-compatible (see module doc)
         "format_version": FORMAT_VERSION,
         "workflow": name,
         "checksum": checksum,

@@ -118,8 +118,20 @@ class Coordinator(Logger):
         self._server = await asyncio.start_server(
             self._on_connect, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        self._loop = asyncio.get_running_loop()
         self.info("coordinator listening on %s:%d", self.host, self.port)
         self._watchdog_task = asyncio.ensure_future(self._watchdog())
+
+    def notify_jobs(self):
+        """Thread-safe wake for parked workers after jobs arrive from
+        OUTSIDE the coordinator's own protocol flow (e.g. a genetics
+        fleet submitting the next generation from the optimizer
+        thread): without this the wait/resume push has no trigger and
+        every worker stays parked."""
+        loop = getattr(self, "_loop", None)
+        if loop is not None:
+            loop.call_soon_threadsafe(
+                lambda: asyncio.ensure_future(self._wake_idle()))
 
     async def wait_finished(self):
         await self._done.wait()

@@ -92,6 +92,13 @@ class RESTfulAPI(Unit):
 
             def do_POST(self):
                 if self.path.rstrip("/") == "/shutdown":
+                    # control-plane guard: when serving beyond loopback,
+                    # only loopback peers may stop the workflow — an
+                    # open /shutdown is a one-request denial of service
+                    peer = self.client_address[0]
+                    if peer not in ("127.0.0.1", "::1", "localhost"):
+                        self.send_error(403, "shutdown is loopback-only")
+                        return
                     blob = b'{"ok": true}'
                     self.send_response(200)
                     self.send_header("Content-Length", str(len(blob)))

@@ -46,19 +46,28 @@ class CifarLoader(FullBatchLoader):
             self.info("loaded real CIFAR-10 (%d train / %d validation)",
                       len(train), len(valid))
         else:
-            self.warning("CIFAR-10 not found under %s — generating a "
-                         "deterministic synthetic stand-in", base)
-            rng = numpy.random.default_rng(1234)
             n_train = int(root.cifar_tpu.get("synthetic_train", 4096))
             n_valid = int(root.cifar_tpu.get("synthetic_valid", 512))
+            kind = root.cifar_tpu.get("synthetic_kind", "blobs")
+            self.warning("CIFAR-10 not found under %s — generating a "
+                         "deterministic synthetic stand-in (%s)",
+                         base, kind)
             tot = n_train + n_valid
-            labels = rng.integers(0, 10, tot)
-            # class-dependent colour blobs so the task is learnable
-            centers = rng.normal(scale=0.6, size=(10, 1, 1, 3))
-            data = numpy.clip(
-                centers[labels]
-                + rng.normal(scale=0.25, size=(tot, 32, 32, 3)) + 0.5,
-                0, 1) * 255
+            if kind == "scenes":
+                # the quality surrogate: shape classes with label-free
+                # color statistics (veles_tpu/datasets/scenes.py)
+                from veles_tpu.datasets import render_scenes
+                data, labels = render_scenes(tot, seed=1234)
+                data = data * 255.0
+            else:
+                rng = numpy.random.default_rng(1234)
+                labels = rng.integers(0, 10, tot)
+                # class-dependent colour blobs so the task is learnable
+                centers = rng.normal(scale=0.6, size=(10, 1, 1, 3))
+                data = numpy.clip(
+                    centers[labels]
+                    + rng.normal(scale=0.25, size=(tot, 32, 32, 3)) + 0.5,
+                    0, 1) * 255
             valid, train = data[:n_valid], data[n_valid:]
             valid_l, train_l = (labels[:n_valid].tolist(),
                                 labels[n_valid:].tolist())
@@ -75,18 +84,22 @@ class CifarWorkflow(StandardWorkflow):
         cfg = root.cifar_tpu
         # caffe cifar10_quick shapes; Glorot-scaled uniform init (the
         # framework default) instead of caffe's fixed tiny gaussians —
-        # those need thousands of epochs to escape the dead zone
+        # those need thousands of epochs to escape the dead zone.
+        # Activations are caffe ReLU = max(0,x), i.e. the znicz STRICT
+        # relu units ("conv_relu"/"all2all_relu" are znicz softplus)
+        conv_t = cfg.get("conv_type", "conv_str")
+        fc_t = cfg.get("fc_type", "all2all_str")
         layers = layers or [
-            {"type": "conv_relu", "n_kernels": 32, "kx": 5, "ky": 5,
+            {"type": conv_t, "n_kernels": 32, "kx": 5, "ky": 5,
              "padding": 2},
             {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
-            {"type": "conv_relu", "n_kernels": 32, "kx": 5, "ky": 5,
+            {"type": conv_t, "n_kernels": 32, "kx": 5, "ky": 5,
              "padding": 2},
             {"type": "avg_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
-            {"type": "conv_relu", "n_kernels": 64, "kx": 5, "ky": 5,
+            {"type": conv_t, "n_kernels": 64, "kx": 5, "ky": 5,
              "padding": 2},
             {"type": "avg_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
-            {"type": "all2all_relu", "output_sample_shape": (64,)},
+            {"type": fc_t, "output_sample_shape": (64,)},
             {"type": "softmax", "output_sample_shape": (10,)},
         ]
         super(CifarWorkflow, self).__init__(
@@ -94,6 +107,10 @@ class CifarWorkflow(StandardWorkflow):
             loader_factory=CifarLoader,
             loader_config={
                 "minibatch_size": int(cfg.get("minibatch_size", 128)),
+                # caffe's cifar10_quick subtracts the mean image; the
+                # mean_disp normalizer is the znicz equivalent
+                "normalization_type": cfg.get("normalization",
+                                              "mean_disp"),
             },
             layers=layers,
             solver=cfg.get("solver", "adam"),

@@ -63,18 +63,28 @@ class MnistLoader(FullBatchLoader):
             self.info("loaded real MNIST (%d train / %d validation)",
                       len(train), len(valid))
         else:
-            self.warning("MNIST files not found under %s — generating a "
-                         "deterministic synthetic stand-in",
-                         root.common.dirs.get("datasets", "data"))
-            rng = numpy.random.default_rng(1234)
             n_train = int(root.mnist_tpu.get("synthetic_train", 8192))
             n_valid = int(root.mnist_tpu.get("synthetic_valid", 1024))
-            centers = rng.normal(scale=2.0, size=(10, 784))
-            tl_all = rng.integers(0, 10, n_train + n_valid)
-            data = (centers[tl_all]
-                    + rng.normal(size=(n_train + n_valid, 784)))
-            data = numpy.clip((data - data.min()) /
-                              (data.max() - data.min()) * 255, 0, 255)
+            kind = root.mnist_tpu.get("synthetic_kind", "blobs")
+            self.warning("MNIST files not found under %s — generating a "
+                         "deterministic synthetic stand-in (%s)",
+                         root.common.dirs.get("datasets", "data"), kind)
+            if kind == "glyphs":
+                # the quality surrogate: procedurally rendered digits of
+                # MNIST-matched difficulty (veles_tpu/datasets/glyphs.py)
+                from veles_tpu.datasets import render_digits
+                imgs, tl_all = render_digits(n_train + n_valid,
+                                             seed=1234)
+                data = imgs.reshape(len(imgs), 784) * 255.0
+            else:
+                # Gaussian class blobs: a fast mechanics-proof task
+                rng = numpy.random.default_rng(1234)
+                centers = rng.normal(scale=2.0, size=(10, 784))
+                tl_all = rng.integers(0, 10, n_train + n_valid)
+                data = (centers[tl_all]
+                        + rng.normal(size=(n_train + n_valid, 784)))
+                data = numpy.clip((data - data.min()) /
+                                  (data.max() - data.min()) * 255, 0, 255)
             train, valid = data[:n_train], data[n_train:]
             train_l, valid_l = tl_all[:n_train], tl_all[n_train:]
         self.class_lengths[:] = [0, len(valid), len(train)]

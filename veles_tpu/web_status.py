@@ -2,11 +2,13 @@
 launcher.py:852-885 status POSTs).
 
 A small tornado service: launchers POST their run status to ``/update``
-once a second; browsers read ``/`` (an auto-refreshing table of runs
-with per-worker state) and machines read ``/api/runs``.  The
-reference's MongoDB-backed log/event viewer maps onto the JSONL event
-stream (veles_tpu.logger) — the dashboard links the raw feed instead of
-embedding a Mongo browser.
+once a second — including the workflow's unit graph and the tail of the
+event-span ring; browsers read ``/`` (an auto-refreshing table of runs
+with per-worker state), ``/graph/<run>`` (the workflow graph rendered
+as layered SVG — the viz.js graph view of the reference's ``web/``,
+server-side and dependency-free) and ``/events/<run>`` (a browsable
+view of the JSONL event stream, filterable by unit/name/kind — the
+reference's Mongo-backed event viewer).  Machines read ``/api/runs``.
 
 Run standalone:  ``python -m veles_tpu.web_status --port 8090``
 """
@@ -41,6 +43,8 @@ _PAGE = """<!DOCTYPE html>
 
 
 def _render_runs(runs):
+    import html
+    e = html.escape  # EVERY update-supplied string is attacker input
     rows = []
     now = time.time()
     for rid, r in sorted(runs.items()):
@@ -48,19 +52,160 @@ def _render_runs(runs):
         cls = ' class="dead"' if age > 10 else ""
         workers = r.get("workers", [])
         wtable = "".join(
-            "<br>%s: %s (%.0f jobs)" % (w.get("id"), w.get("state"),
+            "<br>%s: %s (%.0f jobs)" % (e(str(w.get("id"))),
+                                        e(str(w.get("state"))),
                                         w.get("jobs", 0))
             for w in workers)
-        metrics = ", ".join("%s=%s" % (k, v)
-                            for k, v in (r.get("metrics") or {}).items())
+        metrics = ", ".join(
+            "%s=%s" % (e(str(k)), e(str(v)))
+            for k, v in (r.get("metrics") or {}).items())
+        q = html.escape(rid, quote=True)
+        links = ('<a href="/graph/%s">graph</a> '
+                 '<a href="/events/%s">events</a>' % (q, q))
         rows.append(
             "<tr%s><td>%s</td><td>%s</td><td>%s</td><td>%s</td>"
-            "<td>%s</td><td>%.0fs ago</td></tr>"
-            % (cls, rid, r.get("workflow", "?"), r.get("mode", "?"),
-               metrics, wtable or "-", age))
+            "<td>%s</td><td>%.0fs ago</td><td>%s</td></tr>"
+            % (cls, e(rid), e(str(r.get("workflow", "?"))),
+               e(str(r.get("mode", "?"))), metrics, wtable or "-", age,
+               links))
     return ("<table><tr><th>run</th><th>workflow</th><th>mode</th>"
-            "<th>metrics</th><th>workers</th><th>updated</th></tr>"
-            + "".join(rows) + "</table>")
+            "<th>metrics</th><th>workers</th><th>updated</th>"
+            "<th>views</th></tr>" + "".join(rows) + "</table>")
+
+
+_GROUP_FILL = {"PLUMBING": "#d9d9d9", "LOADER": "#c6dbef",
+               "WORKER": "#c7e9c0", "TRAINER": "#fdd0a2",
+               "EVALUATOR": "#fcbba1", "SERVICE": "#dadaeb"}
+
+
+def _graph_layers(graph):
+    """BFS depth from the roots; back edges (Repeater loops) simply
+    point upward in the drawing."""
+    nodes = graph.get("nodes", [])
+    edges = graph.get("edges", [])
+    succ = {}
+    indeg = {n["id"]: 0 for n in nodes}
+    for s, d in edges:
+        succ.setdefault(s, []).append(d)
+        indeg[d] = indeg.get(d, 0) + 1
+    roots = [i for i, d in indeg.items() if d == 0] or \
+        [nodes[0]["id"]] if nodes else []
+    layer = {}
+    frontier = list(roots)
+    depth = 0
+    while frontier:
+        nxt = []
+        for i in frontier:
+            if i not in layer:
+                layer[i] = depth
+                nxt.extend(succ.get(i, []))
+        frontier = nxt
+        depth += 1
+    for n in nodes:  # disconnected units go to the bottom
+        layer.setdefault(n["id"], depth)
+    return layer
+
+
+def render_graph_svg(graph):
+    """Layered SVG of a workflow graph dict (Workflow.graph_dict) —
+    dependency-free stand-in for the reference's viz.js DOT render."""
+    import html
+    nodes = graph.get("nodes", [])
+    edges = graph.get("edges", [])
+    layer = _graph_layers(graph)
+    by_layer = {}
+    for n in nodes:
+        by_layer.setdefault(layer[n["id"]], []).append(n)
+    bw, bh, hgap, vgap = 170, 46, 30, 60
+    pos = {}
+    width = 40 + max((len(v) for v in by_layer.values()), default=1) \
+        * (bw + hgap)
+    for ly, members in sorted(by_layer.items()):
+        for col, n in enumerate(members):
+            pos[n["id"]] = (40 + col * (bw + hgap),
+                            30 + ly * (bh + vgap))
+    height = 30 + (max(by_layer, default=0) + 1) * (bh + vgap)
+    parts = [
+        '<svg xmlns="http://www.w3.org/2000/svg" width="%d" height="%d"'
+        ' font-family="sans-serif" font-size="12">' % (width, height),
+        '<defs><marker id="arr" markerWidth="8" markerHeight="8" '
+        'refX="7" refY="3" orient="auto"><path d="M0,0 L7,3 L0,6 z" '
+        'fill="#555"/></marker></defs>']
+    for s, d in edges:
+        if s not in pos or d not in pos:
+            continue
+        x1, y1 = pos[s][0] + bw / 2, pos[s][1] + bh
+        x2, y2 = pos[d][0] + bw / 2, pos[d][1]
+        if layer[d] <= layer[s]:  # back edge: loop out the side
+            xa = min(pos[s][0], pos[d][0]) - 18
+            parts.append(
+                '<path d="M%g,%g C%g,%g %g,%g %g,%g" fill="none" '
+                'stroke="#b55" stroke-dasharray="4 2" '
+                'marker-end="url(#arr)"/>'
+                % (x1 - bw / 2, y1 - bh / 2, xa, y1 - bh / 2,
+                   xa, y2 + bh / 2, x2 - bw / 2, y2 + bh / 2))
+        else:
+            parts.append(
+                '<line x1="%g" y1="%g" x2="%g" y2="%g" stroke="#555" '
+                'marker-end="url(#arr)"/>' % (x1, y1, x2, y2))
+    for n in nodes:
+        x, y = pos[n["id"]]
+        fill = _GROUP_FILL.get(n.get("group"), "#ffffff")
+        parts.append(
+            '<g><rect x="%g" y="%g" width="%d" height="%d" rx="6" '
+            'fill="%s" stroke="#333"/>'
+            '<text x="%g" y="%g" text-anchor="middle">%s</text>'
+            '<text x="%g" y="%g" text-anchor="middle" fill="#666" '
+            'font-size="10">%s</text></g>'
+            % (x, y, bw, bh, fill,
+               x + bw / 2, y + 19, html.escape(str(n["label"])[:24]),
+               x + bw / 2, y + 35, html.escape(str(n["cls"])[:26])))
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def _render_events(run_id, events, unit=None, name=None, kind=None,
+                   limit=200):
+    """Filterable HTML view of a run's event-span tail (the reference's
+    Mongo event browser surface)."""
+    import html
+    out = []
+    for ev in reversed(events):
+        if unit and str(ev.get("unit", ev.get("cls", ""))) != unit:
+            continue
+        if name and name not in str(ev.get("name", "")):
+            continue
+        if kind and ev.get("kind") != kind:
+            continue
+        out.append(ev)
+        if len(out) >= limit:
+            break
+    rows = "".join(
+        "<tr><td>%s</td><td>%s</td><td>%s</td><td>%s</td><td>%s</td>"
+        "</tr>" % (
+            time.strftime("%H:%M:%S",
+                          time.localtime(ev.get("time", 0)))
+            + ".%03d" % (1000 * (ev.get("time", 0) % 1)),
+            html.escape(str(ev.get("name", ""))),
+            html.escape(str(ev.get("kind", ""))),
+            html.escape(str(ev.get("unit", ev.get("cls", "")))),
+            html.escape(", ".join(
+                "%s=%s" % (k, v) for k, v in sorted(ev.items())
+                if k not in ("name", "kind", "unit", "cls", "time",
+                             "pid"))))
+        for ev in out)
+    form = ('<form method="get">unit <input name="unit" value="%s"> '
+            'name <input name="name" value="%s"> kind '
+            '<select name="kind"><option value="">any</option>'
+            '%s</select> <button>filter</button></form>'
+            % (html.escape(unit or "", quote=True),
+               html.escape(name or "", quote=True),
+               "".join('<option%s>%s</option>'
+                       % (' selected' if kind == k else '', k)
+                       for k in ("begin", "end", "single"))))
+    return ("<h2>events — %s</h2>%s<table><tr><th>time</th><th>name"
+            "</th><th>kind</th><th>unit</th><th>attrs</th></tr>%s"
+            "</table>" % (html.escape(run_id), form, rows))
 
 
 class WebStatusServer(Logger):
@@ -90,8 +235,38 @@ class WebStatusServer(Logger):
             def get(self):
                 self.write({"runs": server.runs})
 
+        class Graph(tornado.web.RequestHandler):
+            def get(self, rid):
+                run = server.runs.get(rid)
+                if run is None or not run.get("graph"):
+                    self.send_error(404)
+                    return
+                import html as _html
+                self.set_header("Content-Type", "text/html")
+                self.write("<!DOCTYPE html><html><body><h2>%s — "
+                           "workflow graph</h2>%s</body></html>"
+                           % (_html.escape(str(run.get("workflow",
+                                                       rid))),
+                              render_graph_svg(run["graph"])))
+
+        class Events(tornado.web.RequestHandler):
+            def get(self, rid):
+                run = server.runs.get(rid)
+                if run is None:
+                    self.send_error(404)
+                    return
+                self.set_header("Content-Type", "text/html")
+                self.write(
+                    "<!DOCTYPE html><html><body>%s</body></html>"
+                    % _render_events(
+                        rid, run.get("events", []),
+                        unit=self.get_argument("unit", None),
+                        name=self.get_argument("name", None),
+                        kind=self.get_argument("kind", None)))
+
         self.app = tornado.web.Application([
-            (r"/update", Update), (r"/", Page), (r"/api/runs", Api)])
+            (r"/update", Update), (r"/", Page), (r"/api/runs", Api),
+            (r"/graph/(.+)", Graph), (r"/events/(.+)", Events)])
         self._loop = None
         self._thread = None
 
@@ -138,6 +313,7 @@ class StatusNotifier(Logger):
 
     def _status(self):
         import os
+        from veles_tpu.logger import events as event_sink
         launcher = self.launcher
         wf = launcher.workflow
         status = {
@@ -146,6 +322,10 @@ class StatusNotifier(Logger):
             "mode": launcher.mode,
             "metrics": wf.gather_results() if wf is not None else {},
         }
+        if wf is not None and hasattr(wf, "graph_dict"):
+            status["graph"] = wf.graph_dict()
+        # tail of the span ring — feeds the dashboard's event viewer
+        status["events"] = list(event_sink.ring)[-200:]
         coord = getattr(launcher, "coordinator", None)
         if coord is not None:
             status["workers"] = [

@@ -253,20 +253,30 @@ class Workflow(Unit):
         "EVALUATOR": "plum", "SERVICE": "white",
     }
 
+    def graph_dict(self):
+        """The unit graph as plain data — {nodes: [{id,label,cls,group}],
+        edges: [[src,dst]]} — consumed by the DOT export below and the
+        web dashboard's SVG renderer (ref: the viz.js graph view,
+        veles/web_status.py:66-112 + web/)."""
+        index = {u: i for i, u in enumerate(self.units)}
+        nodes = [{"id": i, "label": u.name, "cls": type(u).__name__,
+                  "group": u.view_group} for u, i in index.items()]
+        edges = [[index[u], index[dst]] for u in self.units
+                 for dst in u.links_to if dst in index]
+        return {"name": self.name, "nodes": nodes, "edges": edges}
+
     def generate_graph(self, filename=None):
         """Graphviz DOT export of the unit graph
         (ref: workflow.py:628)."""
+        g = self.graph_dict()
         lines = ["digraph %s {" % type(self).__name__.replace(" ", "_"),
                  "  rankdir=TB;"]
-        ids = {u: "u%d" % i for i, u in enumerate(self.units)}
-        for u, nid in ids.items():
-            color = self._GROUP_COLORS.get(u.view_group, "white")
-            lines.append('  %s [label="%s", style=filled, fillcolor=%s];'
-                         % (nid, u.name, color))
-        for u, nid in ids.items():
-            for dst in u.links_to:
-                if dst in ids:
-                    lines.append("  %s -> %s;" % (nid, ids[dst]))
+        for n in g["nodes"]:
+            color = self._GROUP_COLORS.get(n["group"], "white")
+            lines.append('  u%d [label="%s", style=filled, fillcolor=%s];'
+                         % (n["id"], n["label"], color))
+        for src, dst in g["edges"]:
+            lines.append("  u%d -> u%d;" % (src, dst))
         lines.append("}")
         dot = "\n".join(lines)
         if filename:

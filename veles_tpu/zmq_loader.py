@@ -6,6 +6,8 @@ reference's JVM/Hadoop bridge; here any pyzmq PUSH peer) and serves
 them as minibatches through the InteractiveLoader machinery."""
 
 import pickle
+
+from veles_tpu.safe_pickle import safe_loads
 import threading
 
 from veles_tpu.loader.interactive import InteractiveLoader
@@ -45,6 +47,8 @@ class ZeroMQLoader(InteractiveLoader):
             port = self._sock_.bind_to_random_port("tcp://127.0.0.1")
             self.endpoint = "tcp://127.0.0.1:%d" % port
         self.info("ZeroMQ ingestion on %s", self.endpoint)
+        from veles_tpu.safe_pickle import warn_if_public
+        warn_if_public(self.endpoint, self)
         self._recv_thread_ = threading.Thread(
             target=self._receive_loop, daemon=True, name="zmq-ingest")
         self._recv_thread_.start()
@@ -56,7 +60,7 @@ class ZeroMQLoader(InteractiveLoader):
             except zmq.ZMQError:  # pragma: no cover - socket closed
                 break
             try:
-                sample = pickle.loads(blob)
+                sample = safe_loads(blob)
                 if sample is None:
                     self.close()
                     break
