@@ -89,14 +89,25 @@ def run_one(name, spec, timeout=3000):
     record = {"command": " ".join(cmd[2:]), "seconds": round(elapsed, 1),
               "returncode": proc.returncode,
               "reference": REFERENCE[name], "target": spec["target"]}
-    if proc.returncode:
-        record["stderr_tail"] = proc.stderr.decode(
-            errors="replace")[-800:]
+    try:
+        if proc.returncode:
+            record["stderr_tail"] = proc.stderr.decode(
+                errors="replace")[-800:]
+            return record
+        try:
+            with open(result_file) as f:
+                record["metrics"] = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            # a run that exited 0 without a readable result file is a
+            # failure of THAT run, not of the whole sweep
+            record["returncode"] = -1
+            record["error"] = "no result file: %s" % e
         return record
-    with open(result_file) as f:
-        record["metrics"] = json.load(f)
-    os.unlink(result_file)
-    return record
+    finally:
+        try:
+            os.unlink(result_file)
+        except OSError:
+            pass
 
 
 def main(argv=None):
@@ -117,7 +128,9 @@ def main(argv=None):
     with open(os.path.join(REPO, args.out), "w") as f:
         json.dump(out, f, indent=1)
     print("-> %s" % args.out)
-    return 0
+    # a failed run is a failed sweep — callers checking $? must see it
+    return 1 if any(r.get("returncode") for r in out["runs"].values()) \
+        else 0
 
 
 if __name__ == "__main__":

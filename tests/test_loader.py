@@ -268,3 +268,31 @@ class TestSaverLoader:
         # rows keep their identity feature
         idx_feature = replay.minibatch_data.mem[:replay.minibatch_size, 0]
         assert ((0 <= idx_feature) & (idx_feature < 100)).all()
+
+
+def test_image_pipeline_rotation():
+    """Rotation augmentation (ref: veles/loader/image.py rotate
+    support): fixed angle always applies; ranged angles apply only
+    under augment=True."""
+    import numpy
+    pytest.importorskip("PIL")
+    from veles_tpu import prng
+    from veles_tpu.loader.image import ImagePipeline
+
+    # an L-shaped uint8 image so rotation visibly moves mass
+    arr = numpy.zeros((16, 16, 1), numpy.uint8)
+    arr[2:14, 3:6] = 255
+    arr[11:14, 3:12] = 255
+
+    p90 = ImagePipeline(color_space="GRAY", rotation=90)
+    out = p90(arr)
+    ref = numpy.rot90(arr.astype(numpy.float32) / 255.0, 1)
+    assert numpy.allclose(out, ref, atol=0.02)
+
+    gen = prng.get("rot-test")
+    gen.seed(3)
+    pr = ImagePipeline(color_space="GRAY", rotation=(-30, 30), prng=gen)
+    base = pr(arr, augment=False)   # eval path: no random rotation
+    assert numpy.allclose(base, arr.astype(numpy.float32) / 255.0)
+    rotated = [pr(arr, augment=True) for _ in range(8)]
+    assert any(not numpy.allclose(r, base) for r in rotated)

@@ -318,3 +318,78 @@ def test_safe_pickle_blocks_code_execution():
     blob = _p.dumps(__import__("subprocess").getoutput)
     with _pytest.raises(_p.UnpicklingError):
         safe_loads(blob)
+
+
+# -- scripts: bboxer + update_forge (ref: veles/scripts/) ---------------------
+
+def test_bboxer_label_roundtrip(tmp_path):
+    """The labeling tool serves the image tree and persists box
+    selections (ref: veles/scripts/bboxer.py surface)."""
+    import threading
+    import urllib.request as rq
+    from PIL import Image
+    import numpy as np
+    from veles_tpu.scripts.bboxer import BBoxStore, make_server
+
+    d = tmp_path / "imgs" / "sub"
+    d.mkdir(parents=True)
+    for name in ("a.png", "b.png"):
+        Image.fromarray(np.zeros((8, 8, 3), np.uint8)).save(d / name)
+    store = BBoxStore(str(tmp_path / "boxes.json"))
+    server = make_server(str(tmp_path / "imgs"), store, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    url = "http://127.0.0.1:%d" % server.server_address[1]
+    try:
+        page = rq.urlopen(url + "/", timeout=5).read().decode()
+        assert "canvas" in page
+        imgs = json.load(rq.urlopen(url + "/api/images", timeout=5))
+        assert imgs == ["sub/a.png", "sub/b.png"]
+        blob = rq.urlopen(url + "/image/sub/a.png", timeout=5).read()
+        assert blob[:4] == b"\x89PNG"
+        boxes = [{"x": 0.1, "y": 0.2, "w": 0.3, "h": 0.4,
+                  "label": "cat"}]
+        req = rq.Request(url + "/api/boxes?path=sub/a.png",
+                         data=json.dumps(boxes).encode())
+        assert json.load(rq.urlopen(req, timeout=5))["ok"]
+        got = json.load(rq.urlopen(url + "/api/boxes?path=sub/a.png",
+                                   timeout=5))
+        assert got == boxes
+        # persisted on disk in loader-consumable form
+        saved = json.load(open(tmp_path / "boxes.json"))
+        assert saved["sub/a.png"][0]["label"] == "cat"
+        # path escapes are refused
+        bad = rq.urlopen(url + "/api/boxes?path=../../etc/passwd",
+                         timeout=5)
+        assert json.load(bad) == []
+    finally:
+        server.shutdown()
+
+
+def test_update_forge_uploads_manifests(tmp_path):
+    """update_forge walks the tree, uploads each forge.json's package,
+    and skips versions the immutable store already has (ref:
+    veles/scripts/update_forge.py)."""
+    from veles_tpu.forge import ForgeServer, list_packages
+    from veles_tpu.scripts.update_forge import main as update_main
+
+    wf_dir = tmp_path / "samples" / "mnist"
+    wf_dir.mkdir(parents=True)
+    (wf_dir / "model.tar.gz").write_bytes(b"package-bytes")
+    (wf_dir / "forge.json").write_text(json.dumps({
+        "name": "mnist-mlp", "version": "2.0",
+        "description": "digit mlp", "package": "model.tar.gz"}))
+    server = ForgeServer(str(tmp_path / "store")).start()
+    try:
+        rc = update_main(["--server", server.url,
+                          "--root", str(tmp_path)])
+        assert rc == 0
+        listing = list_packages(server.url)
+        assert [(m["name"], m["version"]) for m in listing] == \
+            [("mnist-mlp", "2.0")]
+        # idempotent: second run skips the existing version cleanly
+        rc = update_main(["--server", server.url,
+                          "--root", str(tmp_path)])
+        assert rc == 0
+        assert len(list_packages(server.url)) == 1
+    finally:
+        server.stop()

@@ -290,45 +290,50 @@ class AcceleratedWorkflow(Workflow):
             self.fuse()
 
     def fuse(self):
-        """Find maximal linear chains u1→u2→…→uN of accelerated units
-        (single successor / single predecessor edges) and compile each
-        into a :class:`FusedSegment`."""
+        """Find maximal SINGLE-ENTRY convex regions of accelerated units
+        and compile each into a :class:`FusedSegment`.
+
+        A segment grows from an entry unit by repeatedly absorbing any
+        fusable unit ALL of whose predecessors are already members —
+        this admits fan-out and fan-in (InputJoiner diamonds) inside
+        the segment, not just linear chains, while keeping execution
+        correct: only the entry has edges from outside, so when the
+        scheduler releases the entry every member's inputs exist, and
+        the grow order is a topological order of the region (each
+        member was added after all its predecessors)."""
         self._segments_ = []
 
         def fusable(u):
             return isinstance(u, AcceleratedUnit) and u.FUSABLE
 
         accel = [u for u in self.units if fusable(u)]
-        in_chain = set()
+        accel_set = set(accel)
+        in_segment = set()
 
-        def chain_next(u):
-            if len(u.links_to) != 1:
-                return None
-            (nxt,) = u.links_to
-            if (fusable(nxt) and nxt not in in_chain
-                    and len(nxt.links_from) == 1):
-                return nxt
-            return None
-
-        for u in accel:
-            if u in in_chain:
+        for entry in accel:
+            if entry in in_segment:
                 continue
-            # only start a chain at a unit with no fusable single-pred
-            prev_ok = (len(u.links_from) == 1 and
-                       fusable(next(iter(u.links_from)))
-                       and len(next(iter(u.links_from)).links_to) == 1)
-            if prev_ok:
-                continue
-            chain = [u]
-            in_chain.add(u)
-            nxt = chain_next(u)
-            while nxt is not None:
-                chain.append(nxt)
-                in_chain.add(nxt)
-                nxt = chain_next(nxt)
-            if len(chain) > 1:
-                seg = FusedSegment(chain)
-                for member in chain:
+            members = [entry]
+            member_set = {entry}
+            grown = True
+            while grown:
+                grown = False
+                # scan the frontier: successors of members whose every
+                # predecessor is already inside
+                for m in list(members):
+                    for v in m.links_to:
+                        if (v in accel_set and v not in member_set
+                                and v not in in_segment
+                                and v.links_from
+                                and all(p in member_set
+                                        for p in v.links_from)):
+                            members.append(v)
+                            member_set.add(v)
+                            grown = True
+            if len(members) > 1:
+                in_segment |= member_set
+                seg = FusedSegment(members)
+                for member in members:
                     member._segment_ = seg
                 self._segments_.append(seg)
         if self._segments_:

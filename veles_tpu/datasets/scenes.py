@@ -141,9 +141,7 @@ def render_scenes(n, seed=0, size=32, noise=0.07, contrast_min=0.4,
         sel = labels == cls
         k = int(sel.sum())
         if k:
-            mask[sel] = _shape_mask(cls, gx[:1].repeat(k, 0) * 0 + gxx,
-                                    gy[:1].repeat(k, 0) * 0 + gyy,
-                                    rng, k)
+            mask[sel] = _shape_mask(cls, gx, gy, rng, k)
     img = img + mask[..., None] * (obj[:, None, None, :] - img)
 
     # occluding bar (random thin stripe of a third color)

@@ -161,12 +161,7 @@ class CoordinatorEvaluator(Logger):
         """End the optimization: workers get terminate, the coordinator
         drains and stops."""
         self.source.finish()
-        if self._loop is not None:
-            # nudge the done event from inside the loop
-            def _set():
-                self._coord._done.set()
-                asyncio.ensure_future(self._coord._broadcast_terminate())
-            self._loop.call_soon_threadsafe(_set)
+        self._coord.request_stop()
         self._thread.join(15)
 
 

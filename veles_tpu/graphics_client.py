@@ -13,12 +13,11 @@ Run:  ``python -m veles_tpu.graphics_client tcp://127.0.0.1:PORT
 import argparse
 import gzip
 import os
-import pickle
-
-from veles_tpu.safe_pickle import safe_loads
 import sys
 
 import numpy
+
+from veles_tpu.safe_pickle import safe_loads
 
 
 def render_payload(payload, figure=None):

@@ -47,7 +47,8 @@ class ImagePipeline(object):
 
     def __init__(self, color_space="RGB", scale=None,
                  scale_maintain_aspect_ratio=False, crop=None,
-                 mirror=False, add_sobel=False, prng=None):
+                 mirror=False, rotation=None, add_sobel=False,
+                 prng=None):
         #: "RGB" | "GRAY" — PIL mode conversion target
         self.color_space = color_space
         #: (width, height) to scale to, or a float ratio, or None
@@ -57,6 +58,10 @@ class ImagePipeline(object):
         self.crop = crop
         #: False | True (always flip) | "random"
         self.mirror = mirror
+        #: rotation augmentation (ref: veles/loader/image.py rotate
+        #: support): a fixed angle in degrees, or (lo, hi) sampled per
+        #: train image, or None
+        self.rotation = rotation
         #: append a Sobel gradient-magnitude channel (ref: image.py
         #: add_sobel — the reference used OpenCV; 2 numpy convolutions
         #: suffice)
@@ -132,6 +137,38 @@ class ImagePipeline(object):
             y0, x0 = (h - ch) // 2, (w - cw) // 2
         return arr[y0:y0 + ch, x0:x0 + cw]
 
+    def _rotate(self, arr, random):
+        if self.rotation is None:
+            return arr
+        if isinstance(self.rotation, (tuple, list)):
+            if not random or self.prng is None:
+                return arr  # ranged rotation is a train-time augment
+            lo, hi = self.rotation
+            angle = float(lo) + float(self.prng.rand()) * \
+                (float(hi) - float(lo))
+        else:
+            angle = float(self.rotation)
+        if not angle:
+            return arr
+        if HAS_PIL and arr.dtype == numpy.uint8:
+            squeeze = arr.shape[2] == 1
+            img = Image.fromarray(arr.squeeze() if squeeze else arr)
+            out = numpy.asarray(img.rotate(
+                angle, resample=Image.BILINEAR))
+            if out.ndim == 2:
+                out = out[:, :, None]
+            return out
+        # float/npy fallback: right-angle steps only (arbitrary-angle
+        # float interpolation isn't worth hand-rolling here) — a
+        # configured angle that can't be honored must fail loudly, not
+        # silently round
+        if angle % 90.0:
+            raise ValueError(
+                "rotation=%s needs PIL + uint8 input; float/npy "
+                "sources support multiples of 90 only" % angle)
+        k = int(angle / 90.0) % 4
+        return numpy.rot90(arr, k) if k else arr
+
     def _mirror(self, arr, random):
         if not self.mirror:
             return arr
@@ -160,6 +197,7 @@ class ImagePipeline(object):
         """Full pipeline; ``augment`` enables the random crop/mirror
         variants (train class only)."""
         arr = self._scale(arr)
+        arr = self._rotate(arr, augment)
         arr = self._crop(arr, augment)
         arr = self._mirror(arr, augment)
         arr = self._sobel(arr)

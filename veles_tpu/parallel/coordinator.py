@@ -133,6 +133,21 @@ class Coordinator(Logger):
             loop.call_soon_threadsafe(
                 lambda: asyncio.ensure_future(self._wake_idle()))
 
+    def request_stop(self):
+        """Thread-safe run termination: marks the run finished and
+        pushes terminate to every connected worker.  ``wait_finished``
+        returns and the owner's ``stop()`` drains as usual."""
+        loop = getattr(self, "_loop", None)
+        if loop is None:
+            self._done.set()
+            return
+
+        def _finish():
+            self._done.set()
+            asyncio.ensure_future(self._broadcast_terminate())
+
+        loop.call_soon_threadsafe(_finish)
+
     async def wait_finished(self):
         await self._done.wait()
 

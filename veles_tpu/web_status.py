@@ -242,12 +242,19 @@ class WebStatusServer(Logger):
                     self.send_error(404)
                     return
                 import html as _html
+                try:
+                    svg = render_graph_svg(run["graph"])
+                except Exception:
+                    # /update payloads are untrusted: a malformed graph
+                    # must not 500 the dashboard
+                    self.send_error(400, reason="malformed graph")
+                    return
                 self.set_header("Content-Type", "text/html")
                 self.write("<!DOCTYPE html><html><body><h2>%s — "
                            "workflow graph</h2>%s</body></html>"
                            % (_html.escape(str(run.get("workflow",
                                                        rid))),
-                              render_graph_svg(run["graph"])))
+                              svg))
 
         class Events(tornado.web.RequestHandler):
             def get(self, rid):
@@ -255,14 +262,18 @@ class WebStatusServer(Logger):
                 if run is None:
                     self.send_error(404)
                     return
-                self.set_header("Content-Type", "text/html")
-                self.write(
-                    "<!DOCTYPE html><html><body>%s</body></html>"
-                    % _render_events(
+                try:
+                    body = _render_events(
                         rid, run.get("events", []),
                         unit=self.get_argument("unit", None),
                         name=self.get_argument("name", None),
-                        kind=self.get_argument("kind", None)))
+                        kind=self.get_argument("kind", None))
+                except Exception:
+                    self.send_error(400, reason="malformed events")
+                    return
+                self.set_header("Content-Type", "text/html")
+                self.write("<!DOCTYPE html><html><body>%s</body></html>"
+                           % body)
 
         self.app = tornado.web.Application([
             (r"/update", Update), (r"/", Page), (r"/api/runs", Api),
