@@ -214,9 +214,14 @@ ALEXNET_GRAD_SHAPES = (
 )
 
 
-def bench_allreduce(reps_per_dispatch=40, dispatches=10):
-    """Gradient all-reduce latency: p50/p95 over >=100 psum executions
-    of an AlexNet-gradient-sized pytree across every available device.
+def bench_allreduce(short=10, long=110, dispatches=10):
+    """Gradient all-reduce latency: p50/p95 of ONE psum of the
+    AlexNet-gradient pytree across every available device, measured
+    **differentially** — each sample is (t_long − t_short) / (long −
+    short) over two scan chains of psums, which cancels the
+    per-dispatch overhead exactly (the axon tunnel's dispatch+readback
+    cost swamps any absolute single-dispatch timing; see
+    .claude/skills/verify/SKILL.md).
 
     On one chip the mesh is trivial and the number is the
     dispatch+donation floor (substrate "single_chip"); on a pod the
@@ -244,31 +249,42 @@ def bench_allreduce(reps_per_dispatch=40, dispatches=10):
     nbytes = sum(int(numpy.prod(s)) * 4 for s in ALEXNET_GRAD_SHAPES)
 
     # the explicit psum over dp — on one device it degenerates to the
-    # donated-buffer floor, on a pod it is the ICI ring all-reduce.
-    # `reps_per_dispatch` dependent psums run in one program: dividing
-    # the span time by the count removes the per-dispatch tunnel
-    # latency that would otherwise swamp a single psum.
-    def chain(gs):
-        def body(c, _):
-            c = jax.tree.map(
-                lambda g: jax.lax.psum(g, "dp") / jnp.float32(n), c)
-            return c, ()
-        c, _ = jax.lax.scan(body, gs, None, length=reps_per_dispatch)
-        return c
+    # donated-buffer floor, on a pod it is the ICI ring all-reduce
+    def make_chain(length):
+        def chain(gs):
+            def body(c, _):
+                c = jax.tree.map(
+                    lambda g: jax.lax.psum(g, "dp") / jnp.float32(n), c)
+                return c, ()
+            c, _ = jax.lax.scan(body, gs, None, length=length)
+            return c
+        specs = jax.tree.map(lambda _: P(), grads)
+        return jax.jit(shard_map(
+            chain, mesh=mesh, in_specs=(specs,), out_specs=specs))
 
-    specs = jax.tree.map(lambda _: P(), grads)
-    allreduce_chain = jax.jit(shard_map(
-        chain, mesh=mesh, in_specs=(specs,), out_specs=specs))
+    run_short = make_chain(short)
+    run_long = make_chain(long)
 
-    out = allreduce_chain(grads)  # compile
-    jax.block_until_ready(out)
-    samples = []
-    for _ in range(dispatches):
+    def timed(fn):
         t0 = time.perf_counter()
-        out = allreduce_chain(grads)
-        jax.block_until_ready(out)
-        dt = time.perf_counter() - t0
-        samples.append(dt / reps_per_dispatch * 1e6)  # us per psum
+        out = fn(grads)
+        # host readback delimits the span (block_until_ready through
+        # the tunnel is unreliable for timing — verify skill)
+        float(jnp.sum(out[1]))
+        return time.perf_counter() - t0
+
+    timed(run_short)  # compile both
+    timed(run_long)
+    samples = []
+    attempts = 0
+    while len(samples) < dispatches and attempts < dispatches * 3:
+        attempts += 1
+        ts = timed(run_short)
+        tl = timed(run_long)
+        if tl > ts:  # a tunnel stall during the short chain inverts
+            samples.append((tl - ts) / (long - short) * 1e6)
+    if not samples:
+        samples = [float("nan")]  # noise swamped every differential
     samples.sort()
     p50 = samples[len(samples) // 2]
     p95 = samples[min(len(samples) - 1, int(len(samples) * 0.95))]
@@ -278,7 +294,10 @@ def bench_allreduce(reps_per_dispatch=40, dispatches=10):
         "allreduce_substrate": substrate,
         "allreduce_devices": n,
         "allreduce_bytes": nbytes,
-        "allreduce_reps": reps_per_dispatch * dispatches,
+        "allreduce_reps": (short + long) * dispatches,
+        "allreduce_methodology":
+            "differential: (t_chain%d - t_chain%d)/%d per sample"
+            % (long, short, long - short),
     }
 
 

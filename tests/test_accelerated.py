@@ -327,3 +327,27 @@ class TestDagFusion:
         assert numpy.allclose(
             tail.output[...],
             numpy.concatenate([numpy.ones(4) * 2, numpy.ones(4) * 5]))
+
+
+def test_fuse_order_independent(device):
+    """Fusion must not depend on unit insertion order: a chain whose
+    middle unit was created first still fuses whole (review finding —
+    the old algorithm stranded a predecessor created later)."""
+    wf = AcceleratedWorkflow(None, name="ooo")
+    # create B before A
+    b = Scale(wf, factor=3.0, name="B")
+    a = Scale(wf, factor=2.0, name="A")
+    c = Scale(wf, factor=5.0, name="C")
+    a.input = Array(numpy.arange(4, dtype=numpy.float32))
+    b.link_attrs(a, ("input", "output"))
+    c.link_attrs(b, ("input", "output"))
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(b)
+    wf.end_point.link_from(c)
+    wf.initialize(device=device)
+    assert len(wf._segments_) == 1
+    assert set(wf._segments_[0].units) == {a, b, c}
+    assert wf._segments_[0].units[0] is a  # entry = true head
+    wf.run()
+    assert numpy.allclose(c.output[...], numpy.arange(4) * 30.0)

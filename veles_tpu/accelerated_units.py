@@ -308,9 +308,29 @@ class AcceleratedWorkflow(Workflow):
 
         accel = [u for u in self.units if fusable(u)]
         accel_set = set(accel)
+        # visit candidate entries in TOPOLOGICAL order of the fusable
+        # subgraph — unit insertion order is not reliable (a unit
+        # linked before its predecessor was created would otherwise
+        # become an entry and strand that predecessor unfused).  Kahn;
+        # cycle remainders (only possible via gated loops) keep
+        # insertion order.
+        indeg = {u: sum(1 for p in u.links_from if p in accel_set)
+                 for u in accel}
+        ready = [u for u in accel if indeg[u] == 0]
+        topo = []
+        while ready:
+            u = ready.pop(0)
+            topo.append(u)
+            for v in u.links_to:
+                if v in indeg:
+                    indeg[v] -= 1
+                    if indeg[v] == 0:
+                        ready.append(v)
+        done = set(topo)
+        topo += [u for u in accel if u not in done]
         in_segment = set()
 
-        for entry in accel:
+        for entry in topo:
             if entry in in_segment:
                 continue
             members = [entry]

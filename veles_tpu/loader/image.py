@@ -61,6 +61,10 @@ class ImagePipeline(object):
         #: rotation augmentation (ref: veles/loader/image.py rotate
         #: support): a fixed angle in degrees, or (lo, hi) sampled per
         #: train image, or None
+        if isinstance(rotation, (tuple, list)) and prng is None:
+            # silently skipping a configured augmentation would be a
+            # lie — ranged rotation needs the sampler
+            raise ValueError("ranged rotation requires a prng")
         self.rotation = rotation
         #: append a Sobel gradient-magnitude channel (ref: image.py
         #: add_sobel — the reference used OpenCV; 2 numpy convolutions
@@ -141,7 +145,7 @@ class ImagePipeline(object):
         if self.rotation is None:
             return arr
         if isinstance(self.rotation, (tuple, list)):
-            if not random or self.prng is None:
+            if not random:
                 return arr  # ranged rotation is a train-time augment
             lo, hi = self.rotation
             angle = float(lo) + float(self.prng.rand()) * \
@@ -267,14 +271,15 @@ class FileImageLoader(FileImageLoaderBase, Loader):
 
     def __init__(self, workflow, color_space="RGB", scale=None,
                  scale_maintain_aspect_ratio=False, crop=None, mirror=False,
-                 add_sobel=False, **kwargs):
+                 rotation=None, add_sobel=False, **kwargs):
         # path kwargs are consumed by the FileImageLoaderBase mixin, the
         # rest by Loader
         super(FileImageLoader, self).__init__(workflow, **kwargs)
         self.pipeline = ImagePipeline(
             color_space=color_space, scale=scale,
             scale_maintain_aspect_ratio=scale_maintain_aspect_ratio,
-            crop=crop, mirror=mirror, add_sobel=add_sobel, prng=self.prng)
+            crop=crop, mirror=mirror, rotation=rotation,
+            add_sobel=add_sobel, prng=self.prng)
 
     def load_data(self):
         self.scan_files()
@@ -329,12 +334,13 @@ class FullBatchImageLoader(FullBatchLoader):
 
     def __init__(self, workflow, color_space="RGB", scale=None,
                  scale_maintain_aspect_ratio=False, crop=None, mirror=False,
-                 add_sobel=False, **kwargs):
+                 rotation=None, add_sobel=False, **kwargs):
         super(FullBatchImageLoader, self).__init__(workflow, **kwargs)
         self.pipeline = ImagePipeline(
             color_space=color_space, scale=scale,
             scale_maintain_aspect_ratio=scale_maintain_aspect_ratio,
-            crop=crop, mirror=mirror, add_sobel=add_sobel, prng=self.prng)
+            crop=crop, mirror=mirror, rotation=rotation,
+            add_sobel=add_sobel, prng=self.prng)
 
     def load_images(self):
         """Yield (class_index, image_array, label) triples."""

@@ -40,10 +40,15 @@ def upload_manifest(server, manifest_path):
     """Upload one manifest's package; returns "uploaded" | "exists" |
     "error"."""
     from veles_tpu.forge.client import upload
-    with open(manifest_path) as f:
-        manifest = json.load(f)
-    package = os.path.join(os.path.dirname(manifest_path),
-                           manifest["package"])
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        package = os.path.join(os.path.dirname(manifest_path),
+                               manifest["package"])
+    except (OSError, json.JSONDecodeError, KeyError, TypeError) as e:
+        # one broken manifest must not abort the rest of the sweep
+        log.error("%s: unreadable manifest: %s", manifest_path, e)
+        return "error"
     if not os.path.isfile(package):
         log.error("%s: package %s missing", manifest_path, package)
         return "error"
