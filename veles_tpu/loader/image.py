@@ -61,11 +61,16 @@ class ImagePipeline(object):
         #: rotation augmentation (ref: veles/loader/image.py rotate
         #: support): a fixed angle in degrees, or (lo, hi) sampled per
         #: train image, or None
-        if isinstance(rotation, (tuple, list)) and prng is None:
-            # silently skipping a configured augmentation would be a
-            # lie — ranged rotation needs the sampler
-            raise ValueError("ranged rotation requires a prng")
         self.rotation = rotation
+        # silently skipping a configured RANDOM augmentation would be a
+        # lie — every sampling transform needs the sampler.  (crop
+        # without a prng is fine: center crop is its defined
+        # deterministic/eval semantic.)
+        if prng is None:
+            if isinstance(rotation, (tuple, list)):
+                raise ValueError("ranged rotation requires a prng")
+            if mirror == "random":
+                raise ValueError('mirror="random" requires a prng')
         #: append a Sobel gradient-magnitude channel (ref: image.py
         #: add_sobel — the reference used OpenCV; 2 numpy convolutions
         #: suffice)
