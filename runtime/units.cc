@@ -430,6 +430,104 @@ void LRN::Execute(const Tensor& in, Tensor* out, ThreadPool* pool) const {
   });
 }
 
+// -- MoE ----------------------------------------------------------------------
+
+MoE::MoE(const Json& config) {
+  n_experts_ = static_cast<int>(config.at("n_experts").number);
+  top_k_ = static_cast<int>(config.at("top_k").number);
+  hidden_ = static_cast<int>(config.at("hidden").number);
+  act_ = config.has("activation")
+             ? ActivationFromName(config.at("activation").str)
+             : Activation::kStrictRelu;
+}
+
+void MoE::SetParam(const std::string& name, Tensor t) {
+  if (name == "gate")
+    gate_ = std::move(t);
+  else if (name == "expert_w1")
+    w1_ = std::move(t);
+  else if (name == "expert_b1")
+    b1_ = std::move(t);
+  else if (name == "expert_w2")
+    w2_ = std::move(t);
+  else if (name == "expert_b2")
+    b2_ = std::move(t);
+}
+
+std::vector<size_t> MoE::OutShape(const std::vector<size_t>& in) const {
+  return in;
+}
+
+void MoE::Execute(const Tensor& in, Tensor* out, ThreadPool* pool) const {
+  size_t batch = in.dim(0);
+  size_t d = in.count() / batch;
+  size_t e = static_cast<size_t>(n_experts_);
+  size_t h = static_cast<size_t>(hidden_);
+  // full validation before any pointer arithmetic: a truncated or
+  // hand-edited package must throw, not read past a buffer (and
+  // top_k > n_experts would hand partial_sort an out-of-range middle)
+  if (top_k_ < 1 || static_cast<size_t>(top_k_) > e)
+    throw std::runtime_error("MoE top_k out of range");
+  if (gate_.dim(0) != d || gate_.dim(1) != e ||
+      w1_.dim(0) != e || w1_.dim(1) != d || w1_.dim(2) != h ||
+      b1_.dim(0) != e || b1_.count() != e * h ||
+      w2_.dim(0) != e || w2_.dim(1) != h || w2_.dim(2) != d ||
+      b2_.dim(0) != e || b2_.count() != e * d)
+    throw std::runtime_error("MoE parameter shape mismatch");
+  out->reshape(in.shape);
+  pool->ParallelFor(batch, [&](size_t r0, size_t r1) {
+    std::vector<float> logits(e), hid(h);
+    std::vector<size_t> order(e);
+    for (size_t r = r0; r < r1; ++r) {
+      const float* x = in.ptr() + r * d;
+      float* y = out->ptr() + r * d;
+      std::memset(y, 0, d * sizeof(float));
+      // gate logits: x @ gate [d, e]
+      std::fill(logits.begin(), logits.end(), 0.0f);
+      for (size_t kk = 0; kk < d; ++kk) {
+        float xv = x[kk];
+        if (xv == 0.0f) continue;
+        const float* g = gate_.ptr() + kk * e;
+        for (size_t j = 0; j < e; ++j) logits[j] += xv * g[j];
+      }
+      // top-k selection + softmax over the selected logits
+      for (size_t j = 0; j < e; ++j) order[j] = j;
+      std::partial_sort(order.begin(), order.begin() + top_k_,
+                        order.end(), [&](size_t a, size_t b) {
+                          return logits[a] > logits[b];
+                        });
+      float mx = logits[order[0]];
+      float denom = 0.0f;
+      for (int t = 0; t < top_k_; ++t)
+        denom += std::exp(logits[order[t]] - mx);
+      // sparse dispatch: only the selected experts execute
+      for (int t = 0; t < top_k_; ++t) {
+        size_t ex = order[t];
+        float weight = std::exp(logits[ex] - mx) / denom;
+        const float* ew1 = w1_.ptr() + ex * d * h;
+        const float* eb1 = b1_.ptr() + ex * h;
+        const float* ew2 = w2_.ptr() + ex * h * d;
+        const float* eb2 = b2_.ptr() + ex * d;
+        std::memcpy(hid.data(), eb1, h * sizeof(float));
+        for (size_t kk = 0; kk < d; ++kk) {
+          float xv = x[kk];
+          if (xv == 0.0f) continue;
+          const float* wr = ew1 + kk * h;
+          for (size_t j = 0; j < h; ++j) hid[j] += xv * wr[j];
+        }
+        ApplyActivation(act_, hid.data(), h);
+        for (size_t kk = 0; kk < h; ++kk) {
+          float hv = hid[kk];
+          if (hv == 0.0f) continue;
+          const float* wr = ew2 + kk * d;
+          for (size_t j = 0; j < d; ++j) y[j] += weight * hv * wr[j];
+        }
+        for (size_t j = 0; j < d; ++j) y[j] += weight * eb2[j];
+      }
+    }
+  });
+}
+
 // -- factory ------------------------------------------------------------------
 
 std::unique_ptr<Unit> CreateUnit(const std::string& cls, const Json& config) {
@@ -458,6 +556,7 @@ std::unique_ptr<Unit> CreateUnit(const std::string& cls, const Json& config) {
     return std::unique_ptr<Unit>(new Pooling(config, false));
   if (cls == "LRNormalizerForward")
     return std::unique_ptr<Unit>(new LRN(config));
+  if (cls == "MoE") return std::unique_ptr<Unit>(new MoE(config));
   if (cls == "DropoutForward")
     return std::unique_ptr<Unit>(new Identity());
   throw std::runtime_error("unit factory: unknown class " + cls);

@@ -74,6 +74,24 @@ class Conv2D : public Unit {
   Tensor weights_, bias_;
 };
 
+// top-k gated mixture of expert FFNs, matching
+// veles_tpu.models.moe.MoE semantics — but TRUE sparse dispatch at
+// inference: only the selected experts run per sample (the training
+// path's dense-dispatch einsums exist for ep-sharding, not for CPUs).
+class MoE : public Unit {
+ public:
+  explicit MoE(const Json& config);
+  std::vector<size_t> OutShape(const std::vector<size_t>& in) const override;
+  void Execute(const Tensor& in, Tensor* out,
+               ThreadPool* pool) const override;
+  void SetParam(const std::string& name, Tensor t) override;
+
+ private:
+  int n_experts_, top_k_, hidden_;
+  Activation act_;
+  Tensor gate_, w1_, b1_, w2_, b2_;
+};
+
 // transposed convolution, matching jax.lax.conv_transpose with HWOI
 // kernels ([ky, kx, out, in]) and "same"/"valid" padding
 class Deconv2D : public Unit {

@@ -265,3 +265,42 @@ def test_cpp_runner_mini_alexnet(runner_binary, tmp_path):
     assert y.shape == (2, 7)
     numpy.testing.assert_allclose(y, y_ref, atol=2e-2)
     assert numpy.all(abs(y.sum(axis=1) - 1.0) < 1e-3)
+
+
+def test_cpp_runner_moe(runner_binary, tmp_path):
+    """Native MoE (true sparse top-k dispatch) agrees with the JAX
+    dense-dispatch forward (models/moe.py)."""
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.config import root
+    from veles_tpu.memory import Array
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.package_export import export_package, load_package
+
+    # f32 compute: the parity reference must not carry bf16 rounding
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    try:
+        wf = AcceleratedWorkflow(None, name="moe-pkg")
+        rng = numpy.random.default_rng(11)
+        x = rng.normal(size=(6, 10)).astype(numpy.float32)
+        units = make_forwards(wf, Array(x), [
+            {"type": "moe", "n_experts": 4, "top_k": 2, "hidden": 12},
+            {"type": "softmax", "output_sample_shape": (5,)},
+        ])
+        dev = Device(backend="numpy")
+        for u in units:
+            u.initialize(device=dev)
+        path = str(tmp_path / "moe.tar.gz")
+        export_package(units, path, (6, 10), name="moe")
+        y_ref = load_package(path).run(x, mode="python")
+        numpy.save(tmp_path / "in.npy", x)
+        r = subprocess.run(
+            [runner_binary, path, str(tmp_path / "in.npy"),
+             str(tmp_path / "out.npy")],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        y = numpy.load(tmp_path / "out.npy")
+        assert y.shape == y_ref.shape
+        numpy.testing.assert_allclose(y, y_ref, atol=2e-3)
+    finally:
+        root.common.precision.compute_dtype = saved
