@@ -459,8 +459,10 @@ std::vector<size_t> MoE::OutShape(const std::vector<size_t>& in) const {
 }
 
 void MoE::Execute(const Tensor& in, Tensor* out, ThreadPool* pool) const {
-  size_t batch = in.dim(0);
-  size_t d = in.count() / batch;
+  // last-dim semantics, matching veles_tpu.models.moe.moe_apply:
+  // every leading dim (batch, sequence, spatial) is batch-like
+  size_t d = in.shape.back();
+  size_t batch = in.count() / d;
   size_t e = static_cast<size_t>(n_experts_);
   size_t h = static_cast<size_t>(hidden_);
   // full validation before any pointer arithmetic: a truncated or

@@ -1,0 +1,101 @@
+"""Transformer sequence stack (embedding/transformer_block/mean-pool):
+the long-context showcase — no reference analogue (Znicz sequence units
+were never tested, manualrst_veles_algorithms.rst:115-140)."""
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.config import root
+
+
+@pytest.fixture
+def device():
+    return Device(backend="numpy")
+
+
+def _make_wf(device, **cfg):
+    from veles_tpu.samples.transformer import TransformerWorkflow
+    # COMPLETE defaults (incl. n_experts/top_k/causal): root.* is a
+    # process-global tree, so every key must be pinned or one test's
+    # config leaks into the next
+    root.transformer_tpu.update(dict({
+        "synthetic_train": 8192, "synthetic_valid": 512,
+        "vocab": 12, "seq": 16, "dim": 64, "blocks": 2, "heads": 4,
+        "n_experts": 0, "top_k": 2, "causal": False,
+        "minibatch_size": 128, "max_epochs": 40, "learning_rate": 3e-3,
+        "fail_iterations": 40, "snapshot_time_interval": 1e9,
+    }, **cfg))
+    wf = TransformerWorkflow(None)
+    wf.snapshotter.interval = 10**9
+    wf.snapshotter.time_interval = 10**9
+    wf.initialize(device=device)
+    return wf
+
+
+def test_block_forward_shapes_and_finite(device):
+    import jax.numpy as jnp
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.models.transformer import TransformerBlock
+
+    class _Arr:
+        shape = (4, 8, 32)
+    wf = AcceleratedWorkflow(None, name="tb")
+    blk = TransformerBlock(wf, heads=4, name="blk")
+    blk.input = _Arr()
+    blk.fill_params()
+    params = {n: jnp.asarray(getattr(blk, n).mem) for n in blk.PARAMS}
+    x = jnp.asarray(numpy.random.default_rng(0).normal(
+        size=(4, 8, 32)).astype(numpy.float32))
+    y = numpy.asarray(blk.apply(params, x))
+    assert y.shape == (4, 8, 32)
+    assert numpy.isfinite(y).all()
+    # causal masking: truncating the tail must not change the head
+    y_half = numpy.asarray(blk.apply(params, x[:, :4]))
+    assert numpy.allclose(y[:, :4], y_half, atol=2e-2)
+
+
+@pytest.mark.slow
+def test_induction_task_learned(device):
+    """The attention stack solves the marker-lookup task well below
+    chance (a bag-of-tokens model cannot)."""
+    wf = _make_wf(device)
+    wf.run()
+    err = wf.decision.epoch_metrics["validation_error_pct"]
+    assert err < 15.0, err  # chance is ~91%
+
+
+def test_moe_ffn_variant_trains(device):
+    wf = _make_wf(device, n_experts=4, blocks=1, max_epochs=6,
+                  synthetic_train=1024, synthetic_valid=128,
+                  dim=32)
+    wf.run()
+    err = wf.decision.epoch_metrics["validation_error_pct"]
+    assert err < 85.0, err  # moving off chance is enough for mechanics
+
+
+def test_trains_on_dp_tp_mesh(device):
+    """The same stack shards over dp×tp (and ep for the expert FFN)."""
+    from veles_tpu.parallel import build_mesh
+    from veles_tpu.samples.transformer import TransformerWorkflow
+    root.transformer_tpu.update({
+        "synthetic_train": 512, "synthetic_valid": 128,
+        "vocab": 12, "seq": 16, "dim": 32, "blocks": 1, "heads": 4,
+        "n_experts": 4, "minibatch_size": 64, "max_epochs": 2,
+        "fail_iterations": 5, "snapshot_time_interval": 1e9,
+    })
+    mesh = build_mesh({"dp": 2, "ep": 2, "tp": 2},
+                      devices=device.jax_devices)
+    wf = TransformerWorkflow(None, mesh=mesh)
+    wf.snapshotter.interval = 10**9
+    wf.snapshotter.time_interval = 10**9
+    wf.initialize(device=device)
+    wf.run()
+    assert numpy.isfinite(
+        wf.decision.epoch_metrics["validation_loss"])
+    # expert weights provably sharded over ep
+    blk = wf.forwards[1]
+    shards = {s.data.shape
+              for s in blk.expert_w1.devmem.addressable_shards}
+    (shape,) = shards
+    assert shape[0] * 2 == blk.expert_w1.shape[0], shards

@@ -1,0 +1,70 @@
+"""Token embedding — the sequence-model input unit (no reference
+analogue: sequence models existed only as untested Znicz units,
+manualrst_veles_algorithms.rst:115-140; the TPU rebuild makes the
+sequence stack first-class per the driver's long-context mandate).
+"""
+
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.models.nn_units import ForwardBase
+
+
+class Embedding(ForwardBase):
+    """[batch, seq] int tokens -> [batch, seq, dim] vectors.
+
+    The gather rides HBM (``jnp.take``); the table is a plain
+    parameter so tp/fsdp sharding conventions apply to it like any
+    weight matrix."""
+
+    PARAMS = ("weights", "positions")
+
+    def __init__(self, workflow, vocab=None, dim=None,
+                 learned_positions=True, **kwargs):
+        from veles_tpu.memory import Array
+        super(Embedding, self).__init__(workflow, include_bias=False,
+                                        **kwargs)
+        if not vocab or not dim:
+            raise ValueError("vocab and dim are required")
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        #: add a learned positional table (sequence tasks are almost
+        #: always position-relative; attention alone is permutation-
+        #: equivariant without it)
+        self.learned_positions = bool(learned_positions)
+        self.positions = Array()
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape) + (self.dim,)
+
+    def fill_params(self):
+        self.weights.reset(numpy.zeros((self.vocab, self.dim),
+                                       numpy.float32))
+        self._fill(self.weights.mem, self.weights_filling,
+                   self.weights_stddev or 0.02, self.vocab, self.dim)
+        if self.learned_positions:
+            seq = int(self.input.shape[1])
+            self.positions.reset(numpy.zeros((seq, self.dim),
+                                             numpy.float32))
+            self._fill(self.positions.mem, self.weights_filling,
+                       self.weights_stddev or 0.02, seq, self.dim)
+
+    def param_arrays(self):
+        arrs = super(Embedding, self).param_arrays()
+        if not self.learned_positions:
+            arrs.pop("positions", None)
+        return arrs
+
+    def apply(self, params, x):
+        from veles_tpu import dtypes
+        cd = dtypes.compute_dtype()
+        y = jnp.take(params["weights"].astype(cd),
+                     x.astype(jnp.int32), axis=0)
+        if self.learned_positions:
+            y = y + params["positions"].astype(cd)[
+                None, :y.shape[1], :]
+        return y
+
+    def export_config(self):
+        return {"vocab": self.vocab, "dim": self.dim,
+                "learned_positions": self.learned_positions}

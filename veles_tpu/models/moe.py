@@ -31,6 +31,39 @@ from veles_tpu.models.activations import get_activation
 from veles_tpu.models.nn_units import ForwardBase
 
 
+def moe_apply(params, x, top_k, activation):
+    """The MoE forward over the LAST axis of ``x`` (any rank: leading
+    dims are all batch-like).  Shared by the MoE unit and the
+    TransformerBlock's expert FFN; ``params`` carries ``gate`` [d, E]
+    and the expert-major ``expert_*`` tensors."""
+    from veles_tpu import dtypes
+    cd = dtypes.compute_dtype() if jnp.issubdtype(
+        x.dtype, jnp.floating) else x.dtype
+    d = x.shape[-1]
+    n_experts = params["expert_w1"].shape[0]
+    xf = x.reshape(-1, d).astype(cd)
+    # top-k gating: softmax over the k largest logits, zero elsewhere
+    logits = xf @ params["gate"].astype(xf.dtype)
+    vals, idx = jax.lax.top_k(logits, top_k)
+    probs = jax.nn.softmax(vals, axis=-1)
+    onehot = jax.nn.one_hot(idx, n_experts, dtype=xf.dtype)
+    c = jnp.einsum("bk,bke->be", probs.astype(xf.dtype), onehot)
+    act = get_activation(activation)
+    # dense dispatch: expert dim e is batch-like in the einsums, so
+    # ep-sharded expert params keep both matmuls expert-local...
+    h1 = jnp.einsum("bd,edh->ebh", xf, params["expert_w1"].astype(cd),
+                    preferred_element_type=jnp.float32)
+    h1 = act((h1 + params["expert_b1"].astype(
+        jnp.float32)[:, None, :]).astype(cd))
+    y = jnp.einsum("ebh,ehd->ebd", h1, params["expert_w2"].astype(cd),
+                   preferred_element_type=jnp.float32)
+    y = y + params["expert_b2"].astype(jnp.float32)[:, None, :]
+    # ...and the combine contracts e — the one collective (psum over
+    # ep) of the whole layer
+    out = jnp.einsum("be,ebd->bd", c.astype(jnp.float32), y)
+    return out.astype(x.dtype).reshape(x.shape)
+
+
 class MoE(ForwardBase):
     """Top-k gated mixture of expert FFNs over the last feature axis.
 
@@ -63,7 +96,9 @@ class MoE(ForwardBase):
         return input_shape
 
     def fill_params(self):
-        d = int(numpy.prod(self.input.shape[1:]))
+        # last-dim semantics: leading dims (batch, sequence, …) are all
+        # batch-like, matching moe_apply
+        d = int(self.input.shape[-1])
         h = int(self.hidden or 4 * d)
         self.hidden = h
         e = self.n_experts
@@ -81,39 +116,8 @@ class MoE(ForwardBase):
         self.expert_b2.reset(numpy.zeros(
             (e, d), numpy.float32))
 
-    def combine_weights(self, params, x):
-        """[batch, n_experts] combine coefficients: softmax over the
-        top-k gate logits, zero elsewhere."""
-        logits = x @ params["gate"].astype(x.dtype)
-        vals, idx = jax.lax.top_k(logits, self.top_k)
-        probs = jax.nn.softmax(vals, axis=-1)
-        onehot = jax.nn.one_hot(idx, self.n_experts, dtype=x.dtype)
-        return jnp.einsum("bk,bke->be", probs.astype(x.dtype), onehot)
-
     def apply(self, params, x):
-        from veles_tpu import dtypes
-        cd = dtypes.compute_dtype() if jnp.issubdtype(
-            x.dtype, jnp.floating) else x.dtype
-        xf = x.reshape(x.shape[0], -1).astype(cd)
-        c = self.combine_weights(
-            {"gate": params["gate"]}, xf)  # [b, e]
-        act = get_activation(self.activation)
-        # dense dispatch: expert dim e is batch-like in the einsums, so
-        # ep-sharded expert params keep both matmuls expert-local...
-        h1 = jnp.einsum("bd,edh->ebh", xf,
-                        params["expert_w1"].astype(cd),
-                        preferred_element_type=jnp.float32)
-        h1 = act((h1 + params["expert_b1"].astype(
-            jnp.float32)[:, None, :]).astype(cd))
-        y = jnp.einsum("ebh,ehd->ebd", h1,
-                       params["expert_w2"].astype(cd),
-                       preferred_element_type=jnp.float32)
-        y = y + params["expert_b2"].astype(jnp.float32)[:, None, :]
-        # ...and the combine contracts e — the one collective (psum
-        # over ep) of the whole layer
-        out = jnp.einsum("be,ebd->bd", c.astype(jnp.float32),
-                         y)
-        return out.astype(x.dtype).reshape(x.shape[0], *x.shape[1:])
+        return moe_apply(params, x, self.top_k, self.activation)
 
     def export_config(self):
         return {"n_experts": self.n_experts, "top_k": self.top_k,

@@ -18,7 +18,9 @@ overrides (extras item 13) — both znicz conventions.
 
 from veles_tpu.accelerated_units import AcceleratedWorkflow
 from veles_tpu.models.attention import MultiHeadAttention
+from veles_tpu.models.embedding import Embedding
 from veles_tpu.models.moe import MoE
+from veles_tpu.models.transformer import MeanPoolSeq, TransformerBlock
 from veles_tpu.models.all2all import (
     All2All, All2AllRELU, All2AllSigmoid, All2AllSoftmax,
     All2AllStrictRELU, All2AllTanh)
@@ -51,6 +53,9 @@ LAYER_TYPES = {
     "norm": LRNormalizerForward,
     "attention": MultiHeadAttention,
     "moe": MoE,
+    "embedding": Embedding,
+    "transformer_block": TransformerBlock,
+    "mean_pool_seq": MeanPoolSeq,
     "rnn": SimpleRNN,
     "lstm": LSTM,
     "last_timestep": LastTimestep,

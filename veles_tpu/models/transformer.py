@@ -1,0 +1,168 @@
+"""Transformer block — pre-LN causal attention + FFN with residuals,
+as ONE forward unit (the trainer composes forwards linearly, so the
+block keeps its residual adds internal; the unit graph stays
+embedding → block × N → pool → head).
+
+No reference analogue (sequence models never left the untested Znicz
+submodule); this is the long-context-first-class stack the TPU rebuild
+adds: the attention core is `ops.attention` (same math the
+ring-attention sp path computes chip-locally), and the FFN can be a
+top-k mixture of experts whose ``expert_*`` parameters shard over the
+``ep`` mesh axis by the standard naming convention
+(parallel/sharding.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.memory import Array
+from veles_tpu.models.nn_units import ForwardBase
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+class TransformerBlock(ForwardBase):
+    """x -> x + MHA(LN(x)) -> + FFN(LN(.)), x: [batch, seq, d].
+
+    ``n_experts`` switches the FFN to a top-k MoE (dense einsum
+    dispatch, expert-major params on the ``ep`` axis)."""
+
+    BASE_PARAMS = ("ln1_scale", "ln1_bias", "wq", "wk", "wv", "wo",
+                   "ln2_scale", "ln2_bias")
+
+    def __init__(self, workflow, heads=4, hidden=None, causal=True,
+                 n_experts=0, top_k=2, **kwargs):
+        super(TransformerBlock, self).__init__(workflow,
+                                               include_bias=True,
+                                               **kwargs)
+        self.heads = int(heads)
+        self.hidden = hidden  # None -> 4*d at fill time
+        self.causal = bool(causal)
+        self.n_experts = int(n_experts)
+        self.top_k = int(top_k)
+        if self.n_experts and self.top_k > self.n_experts:
+            raise ValueError("top_k %d > n_experts %d"
+                             % (self.top_k, self.n_experts))
+        if self.n_experts:
+            self.PARAMS = self.BASE_PARAMS + (
+                "gate", "expert_w1", "expert_b1", "expert_w2",
+                "expert_b2")
+        else:
+            self.PARAMS = self.BASE_PARAMS + (
+                "ffn_w1", "ffn_b1", "ffn_w2", "ffn_b2")
+        for p in self.PARAMS:
+            setattr(self, p, Array())
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def fill_params(self):
+        d = self.input.shape[-1]
+        if d % self.heads:
+            raise ValueError("model dim %d not divisible by %d heads"
+                             % (d, self.heads))
+        h = int(self.hidden or 4 * d)
+        self.hidden = h
+        for name in ("ln1_scale", "ln2_scale"):
+            getattr(self, name).reset(numpy.ones((d,), numpy.float32))
+        for name in ("ln1_bias", "ln2_bias"):
+            getattr(self, name).reset(numpy.zeros((d,), numpy.float32))
+        for name in ("wq", "wk", "wv", "wo"):
+            arr = getattr(self, name)
+            arr.reset(numpy.zeros((d, d), numpy.float32))
+            self._fill(arr.mem, self.weights_filling,
+                       self.weights_stddev, d, d)
+        if self.n_experts:
+            e = self.n_experts
+            self.gate.reset(numpy.zeros((d, e), numpy.float32))
+            self._fill(self.gate.mem, self.weights_filling,
+                       self.weights_stddev, d, e)
+            self.expert_w1.reset(numpy.zeros((e, d, h), numpy.float32))
+            self.expert_w2.reset(numpy.zeros((e, h, d), numpy.float32))
+            for w, fi, fo in ((self.expert_w1.mem, d, h),
+                              (self.expert_w2.mem, h, d)):
+                for i in range(e):
+                    self._fill(w[i], self.weights_filling,
+                               self.weights_stddev, fi, fo)
+            self.expert_b1.reset(numpy.zeros((e, h), numpy.float32))
+            self.expert_b2.reset(numpy.zeros((e, d), numpy.float32))
+        else:
+            self.ffn_w1.reset(numpy.zeros((d, h), numpy.float32))
+            self._fill(self.ffn_w1.mem, self.weights_filling,
+                       self.weights_stddev, d, h)
+            self.ffn_b1.reset(numpy.zeros((h,), numpy.float32))
+            self.ffn_w2.reset(numpy.zeros((h, d), numpy.float32))
+            self._fill(self.ffn_w2.mem, self.weights_filling,
+                       self.weights_stddev, h, d)
+            self.ffn_b2.reset(numpy.zeros((d,), numpy.float32))
+
+    def _mha(self, params, x):
+        from veles_tpu import dtypes
+        from veles_tpu.ops.attention import attention
+        cd = dtypes.compute_dtype()
+        b, s, d = x.shape
+        hd = d // self.heads
+
+        def proj(w):
+            y = jnp.einsum("bsd,de->bse", x.astype(cd), w.astype(cd),
+                           preferred_element_type=jnp.float32)
+            return y.astype(cd).reshape(b, s, self.heads, hd)
+
+        o = attention(proj(params["wq"]), proj(params["wk"]),
+                      proj(params["wv"]), causal=self.causal)
+        return jnp.einsum("bsd,de->bse", o.reshape(b, s, d).astype(cd),
+                          params["wo"].astype(cd),
+                          preferred_element_type=jnp.float32).astype(
+                              x.dtype)
+
+    def _ffn(self, params, x):
+        from veles_tpu import dtypes
+        cd = dtypes.compute_dtype()
+        if self.n_experts:
+            from veles_tpu.models.moe import moe_apply
+            return moe_apply(params, x, self.top_k, "strict_relu")
+        h1 = jnp.einsum("bsd,dh->bsh", x.astype(cd),
+                        params["ffn_w1"].astype(cd),
+                        preferred_element_type=jnp.float32)
+        h1 = jnp.maximum(
+            h1 + params["ffn_b1"].astype(jnp.float32), 0.0).astype(cd)
+        y = jnp.einsum("bsh,hd->bsd", h1, params["ffn_w2"].astype(cd),
+                       preferred_element_type=jnp.float32)
+        return (y + params["ffn_b2"].astype(jnp.float32)).astype(x.dtype)
+
+    def apply(self, params, x):
+        h = x + self._mha(params, _layer_norm(
+            x, params["ln1_scale"], params["ln1_bias"]))
+        return h + self._ffn(params, _layer_norm(
+            h, params["ln2_scale"], params["ln2_bias"]))
+
+    def export_config(self):
+        return {"heads": self.heads, "hidden": int(self.hidden),
+                "causal": self.causal, "n_experts": self.n_experts,
+                "top_k": self.top_k}
+
+
+class MeanPoolSeq(ForwardBase):
+    """[batch, seq, d] -> [batch, d] mean over the sequence axis."""
+
+    PARAMS = ()
+
+    def fill_params(self):
+        pass
+
+    def output_shape_for(self, input_shape):
+        return (input_shape[0], input_shape[-1])
+
+    def apply(self, params, x):
+        return x.mean(axis=1)
+
+    def export_config(self):
+        return {}

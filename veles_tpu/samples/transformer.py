@@ -1,0 +1,97 @@
+"""Transformer sequence-classification workflow — the long-context
+showcase of the sequence stack (Embedding → TransformerBlock × N →
+mean-pool → softmax head).
+
+No reference analogue: sequence models never left the untested Znicz
+submodule (manualrst_veles_algorithms.rst:115-140); this sample exists
+because long-context is first-class in the TPU rebuild — the same
+blocks scale over the ``sp`` (ring attention), ``tp`` and ``ep`` mesh
+axes.
+
+Task (synthetic, attention-hard): every sequence contains exactly one
+MARKER token; the label is the token that immediately FOLLOWS the
+marker (the classic induction pattern).  Position-independent lookup —
+a bag-of-tokens model is at chance, an attention head solves it.
+
+Run: ``python -m veles_tpu veles_tpu/samples/transformer.py \\
+-c "root.transformer_tpu.update({'max_epochs': 20})"``
+"""
+
+import numpy
+
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.standard import StandardWorkflow
+
+MARKER = 0  # token reserved as the lookup marker
+
+
+class InductionLoader(FullBatchLoader):
+    """Sequences [N, seq] over a vocab; label = token after the single
+    MARKER occurrence."""
+
+    def load_data(self):
+        cfg = root.transformer_tpu
+        vocab = int(cfg.get("vocab", 16))
+        seq = int(cfg.get("seq", 32))
+        n_train = int(cfg.get("synthetic_train", 8192))
+        n_valid = int(cfg.get("synthetic_valid", 1024))
+        tot = n_train + n_valid
+        rng = numpy.random.default_rng(int(cfg.get("seed", 99)))
+        # tokens 1..vocab-1; MARKER inserted at a random position with
+        # a random payload token after it
+        data = rng.integers(1, vocab, (tot, seq))
+        pos = rng.integers(0, seq - 1, tot)
+        payload = rng.integers(1, vocab, tot)
+        data[numpy.arange(tot), pos] = MARKER
+        data[numpy.arange(tot), pos + 1] = payload
+        self.class_lengths[:] = [0, n_valid, n_train]
+        self.original_data = data.astype(numpy.int32)
+        self.original_labels = payload.tolist()
+
+
+class TransformerWorkflow(StandardWorkflow):
+    """Embedding → blocks → mean-pool → softmax over the vocab."""
+
+    def __init__(self, workflow, **kwargs):
+        cfg = root.transformer_tpu
+        vocab = int(cfg.get("vocab", 16))
+        dim = int(cfg.get("dim", 64))
+        blocks = int(cfg.get("blocks", 2))
+        heads = int(cfg.get("heads", 4))
+        n_experts = int(cfg.get("n_experts", 0))
+        spec = [{"type": "embedding", "vocab": vocab, "dim": dim}]
+        spec += [{"type": "transformer_block", "heads": heads,
+                  "causal": bool(cfg.get("causal", False)),
+                  "n_experts": n_experts,
+                  "top_k": int(cfg.get("top_k", 2))}
+                 for _ in range(blocks)]
+        spec += [{"type": "mean_pool_seq"},
+                 {"type": "softmax", "output_sample_shape": (vocab,)}]
+        super(TransformerWorkflow, self).__init__(
+            workflow, name="Transformer",
+            loader_factory=InductionLoader,
+            loader_config={
+                "minibatch_size": int(cfg.get("minibatch_size", 128)),
+                "normalization_type": "none",
+            },
+            layers=spec,
+            solver=cfg.get("solver", "adam"),
+            learning_rate=float(cfg.get("learning_rate", 1e-3)),
+            gradient_moment=float(cfg.get("gradient_moment", 0.9)),
+            weights_decay=float(cfg.get("weights_decay", 0.0)),
+            decision_config={
+                "fail_iterations": int(cfg.get("fail_iterations", 15)),
+                "max_epochs": cfg.get("max_epochs"),
+            },
+            snapshotter_config={
+                "prefix": cfg.get("snapshot_prefix", "transformer"),
+                "time_interval":
+                    float(cfg.get("snapshot_time_interval", 1e9)),
+            },
+            **kwargs)
+
+
+def run(load, main):
+    load(TransformerWorkflow)
+    main()
