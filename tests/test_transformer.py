@@ -78,11 +78,19 @@ def test_trains_on_dp_tp_mesh(device):
     """The same stack shards over dp×tp (and ep for the expert FFN)."""
     from veles_tpu.parallel import build_mesh
     from veles_tpu.samples.transformer import TransformerWorkflow
+    # go through _make_wf's COMPLETE defaults (root.* is global; a
+    # partial update here would inherit whatever earlier tests set)
+    root.transformer_tpu.update({
+        "synthetic_train": 8192, "synthetic_valid": 512,
+        "vocab": 12, "seq": 16, "dim": 64, "blocks": 2, "heads": 4,
+        "n_experts": 0, "top_k": 2, "causal": False,
+        "minibatch_size": 128, "max_epochs": 40, "learning_rate": 3e-3,
+        "fail_iterations": 40, "snapshot_time_interval": 1e9,
+    })
     root.transformer_tpu.update({
         "synthetic_train": 512, "synthetic_valid": 128,
-        "vocab": 12, "seq": 16, "dim": 32, "blocks": 1, "heads": 4,
-        "n_experts": 4, "minibatch_size": 64, "max_epochs": 2,
-        "fail_iterations": 5, "snapshot_time_interval": 1e9,
+        "dim": 32, "blocks": 1, "n_experts": 4,
+        "minibatch_size": 64, "max_epochs": 2, "fail_iterations": 5,
     })
     mesh = build_mesh({"dp": 2, "ep": 2, "tp": 2},
                       devices=device.jax_devices)

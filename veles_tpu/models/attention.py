@@ -11,7 +11,32 @@ not something sharding propagation derives from this op."""
 import numpy
 
 from veles_tpu.models.nn_units import ForwardBase
-from veles_tpu.ops.gemm import matmul
+
+
+def mha_apply(params, x, heads, causal):
+    """Multi-head attention forward over [batch, seq, d] — the ONE
+    implementation shared by the MultiHeadAttention unit and
+    TransformerBlock (params: wq/wk/wv/wo, each [d, d]).  Projections
+    run in the compute dtype (bf16 trunk policy); the attention core
+    is ops.attention."""
+    import jax.numpy as jnp
+
+    from veles_tpu import dtypes
+    from veles_tpu.ops.attention import attention
+    cd = dtypes.compute_dtype()
+    b, s, d = x.shape
+    hd = d // heads
+
+    def proj(w):
+        y = jnp.einsum("bsd,de->bse", x.astype(cd), w.astype(cd),
+                       preferred_element_type=jnp.float32)
+        return y.astype(cd).reshape(b, s, heads, hd)
+
+    o = attention(proj(params["wq"]), proj(params["wk"]),
+                  proj(params["wv"]), causal=causal)
+    return jnp.einsum("bsd,de->bse", o.reshape(b, s, d).astype(cd),
+                      params["wo"].astype(cd),
+                      preferred_element_type=jnp.float32).astype(x.dtype)
 
 
 class MultiHeadAttention(ForwardBase):
@@ -46,17 +71,5 @@ class MultiHeadAttention(ForwardBase):
     def export_config(self):
         return {"heads": self.heads, "causal": self.causal}
 
-    def _project(self, w, x):
-        b, s, d = x.shape
-        y = matmul(x.reshape(b * s, d), w, out_dtype=x.dtype)
-        return y.reshape(b, s, self.heads, d // self.heads)
-
     def apply(self, params, x):
-        from veles_tpu.ops.attention import attention
-        q = self._project(params["wq"], x)
-        k = self._project(params["wk"], x)
-        v = self._project(params["wv"], x)
-        o = attention(q, k, v, causal=self.causal)
-        b, s, d = x.shape
-        return matmul(o.reshape(b * s, d), params["wo"],
-                      out_dtype=x.dtype).reshape(b, s, d)
+        return mha_apply(params, x, self.heads, self.causal)

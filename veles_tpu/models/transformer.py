@@ -105,23 +105,10 @@ class TransformerBlock(ForwardBase):
             self.ffn_b2.reset(numpy.zeros((d,), numpy.float32))
 
     def _mha(self, params, x):
-        from veles_tpu import dtypes
-        from veles_tpu.ops.attention import attention
-        cd = dtypes.compute_dtype()
-        b, s, d = x.shape
-        hd = d // self.heads
-
-        def proj(w):
-            y = jnp.einsum("bsd,de->bse", x.astype(cd), w.astype(cd),
-                           preferred_element_type=jnp.float32)
-            return y.astype(cd).reshape(b, s, self.heads, hd)
-
-        o = attention(proj(params["wq"]), proj(params["wk"]),
-                      proj(params["wv"]), causal=self.causal)
-        return jnp.einsum("bsd,de->bse", o.reshape(b, s, d).astype(cd),
-                          params["wo"].astype(cd),
-                          preferred_element_type=jnp.float32).astype(
-                              x.dtype)
+        from veles_tpu.models.attention import mha_apply
+        return mha_apply(
+            {k: params[k] for k in ("wq", "wk", "wv", "wo")}, x,
+            self.heads, self.causal)
 
     def _ffn(self, params, x):
         from veles_tpu import dtypes
