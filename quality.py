@@ -32,6 +32,8 @@ REFERENCE = {
                    "source": "manualrst_veles_algorithms.rst:51"},
     "mnist_ae": {"metric": "validation_rmse", "value": 0.5478,
                  "source": "manualrst_veles_algorithms.rst:70"},
+    "stl10_conv": {"metric": "validation_error_pct", "value": 35.10,
+                   "source": "manualrst_veles_algorithms.rst:52"},
 }
 
 RUNS = {
@@ -58,6 +60,20 @@ RUNS = {
             "'fail_iterations': 30, 'max_epochs': 150,"
             "'snapshot_time_interval': 1e9})"),
         "target": "validation_error_pct toward the 17.21 band",
+    },
+    "stl10_conv": {
+        "workflow": "veles_tpu/samples/cifar.py",
+        "config": "veles_tpu/samples/cifar_config.py",
+        "overrides": (
+            "root.cifar_tpu.update({"
+            "'synthetic_kind': 'scenes', 'synthetic_size': 96,"
+            "'synthetic_train': 5000, 'synthetic_valid': 8000,"
+            "'minibatch_size': 100,"  # STL-10's low-data regime
+            "'fail_iterations': 25, 'max_epochs': 120,"
+            "'snapshot_time_interval': 1e9})"),
+        "target": "validation_error_pct toward the 35.10 band "
+                  "(difficulty comes from 5k labeled samples, like "
+                  "real STL-10)",
     },
     "mnist_ae": {
         "workflow": "veles_tpu/samples/mnist_ae.py",

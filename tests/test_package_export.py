@@ -304,3 +304,38 @@ def test_cpp_runner_moe(runner_binary, tmp_path):
         numpy.testing.assert_allclose(y, y_ref, atol=2e-3)
     finally:
         root.common.precision.compute_dtype = saved
+
+
+def test_transformer_package_roundtrip(tmp_path):
+    """The sequence stack (embedding/transformer_block/mean-pool/head)
+    exports and reloads through the UUID factory with identical
+    outputs."""
+    import jax.numpy as jnp
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.memory import Array
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.package_export import export_package, load_package
+
+    wf = AcceleratedWorkflow(None, name="tr")
+    rng = numpy.random.default_rng(0)
+    x = rng.integers(0, 12, (4, 16)).astype(numpy.int32)
+    units = make_forwards(wf, Array(x), [
+        {"type": "embedding", "vocab": 12, "dim": 32},
+        {"type": "transformer_block", "heads": 4, "n_experts": 2,
+         "top_k": 1},
+        {"type": "mean_pool_seq"},
+        {"type": "softmax", "output_sample_shape": (12,)}])
+    dev = Device(backend="numpy")
+    for u in units:
+        u.initialize(device=dev)
+    # direct forward reference
+    h = jnp.asarray(x)
+    for u in units:
+        params = {n: jnp.asarray(a.mem)
+                  for n, a in u.param_arrays().items()}
+        h = u.apply(params, h)
+    y_ref = numpy.asarray(h)
+    path = str(tmp_path / "tr.tar.gz")
+    export_package(units, path, (4, 16), name="tr")
+    y = load_package(path).run(x, mode="python")
+    numpy.testing.assert_allclose(y, y_ref, atol=1e-5)

@@ -14,19 +14,22 @@ def device():
     return Device(backend="numpy")
 
 
-def _make_wf(device, **cfg):
+#: COMPLETE defaults (incl. n_experts/top_k/causal): root.* is a
+#: process-global tree, so every key must be pinned by every test or
+#: one test's config leaks into the next — ALL tests go through this
+DEFAULTS = {
+    "synthetic_train": 8192, "synthetic_valid": 512,
+    "vocab": 12, "seq": 16, "dim": 64, "blocks": 2, "heads": 4,
+    "n_experts": 0, "top_k": 2, "causal": False,
+    "minibatch_size": 128, "max_epochs": 40, "learning_rate": 3e-3,
+    "fail_iterations": 40, "snapshot_time_interval": 1e9,
+}
+
+
+def _make_wf(device, mesh=None, **cfg):
     from veles_tpu.samples.transformer import TransformerWorkflow
-    # COMPLETE defaults (incl. n_experts/top_k/causal): root.* is a
-    # process-global tree, so every key must be pinned or one test's
-    # config leaks into the next
-    root.transformer_tpu.update(dict({
-        "synthetic_train": 8192, "synthetic_valid": 512,
-        "vocab": 12, "seq": 16, "dim": 64, "blocks": 2, "heads": 4,
-        "n_experts": 0, "top_k": 2, "causal": False,
-        "minibatch_size": 128, "max_epochs": 40, "learning_rate": 3e-3,
-        "fail_iterations": 40, "snapshot_time_interval": 1e9,
-    }, **cfg))
-    wf = TransformerWorkflow(None)
+    root.transformer_tpu.update(dict(DEFAULTS, **cfg))
+    wf = TransformerWorkflow(None, mesh=mesh)
     wf.snapshotter.interval = 10**9
     wf.snapshotter.time_interval = 10**9
     wf.initialize(device=device)
@@ -77,27 +80,12 @@ def test_moe_ffn_variant_trains(device):
 def test_trains_on_dp_tp_mesh(device):
     """The same stack shards over dp×tp (and ep for the expert FFN)."""
     from veles_tpu.parallel import build_mesh
-    from veles_tpu.samples.transformer import TransformerWorkflow
-    # go through _make_wf's COMPLETE defaults (root.* is global; a
-    # partial update here would inherit whatever earlier tests set)
-    root.transformer_tpu.update({
-        "synthetic_train": 8192, "synthetic_valid": 512,
-        "vocab": 12, "seq": 16, "dim": 64, "blocks": 2, "heads": 4,
-        "n_experts": 0, "top_k": 2, "causal": False,
-        "minibatch_size": 128, "max_epochs": 40, "learning_rate": 3e-3,
-        "fail_iterations": 40, "snapshot_time_interval": 1e9,
-    })
-    root.transformer_tpu.update({
-        "synthetic_train": 512, "synthetic_valid": 128,
-        "dim": 32, "blocks": 1, "n_experts": 4,
-        "minibatch_size": 64, "max_epochs": 2, "fail_iterations": 5,
-    })
     mesh = build_mesh({"dp": 2, "ep": 2, "tp": 2},
                       devices=device.jax_devices)
-    wf = TransformerWorkflow(None, mesh=mesh)
-    wf.snapshotter.interval = 10**9
-    wf.snapshotter.time_interval = 10**9
-    wf.initialize(device=device)
+    wf = _make_wf(device, mesh=mesh,
+                  synthetic_train=512, synthetic_valid=128,
+                  dim=32, blocks=1, n_experts=4,
+                  minibatch_size=64, max_epochs=2, fail_iterations=5)
     wf.run()
     assert numpy.isfinite(
         wf.decision.epoch_metrics["validation_loss"])

@@ -24,19 +24,22 @@ def mha_apply(params, x, heads, causal):
     from veles_tpu import dtypes
     from veles_tpu.ops.attention import attention
     cd = dtypes.compute_dtype()
+    ad = dtypes.accum_dtype()
+    prec = dtypes.matmul_precision()
     b, s, d = x.shape
     hd = d // heads
 
     def proj(w):
         y = jnp.einsum("bsd,de->bse", x.astype(cd), w.astype(cd),
-                       preferred_element_type=jnp.float32)
+                       precision=prec, preferred_element_type=ad)
         return y.astype(cd).reshape(b, s, heads, hd)
 
     o = attention(proj(params["wq"]), proj(params["wk"]),
                   proj(params["wv"]), causal=causal)
     return jnp.einsum("bsd,de->bse", o.reshape(b, s, d).astype(cd),
                       params["wo"].astype(cd),
-                      preferred_element_type=jnp.float32).astype(x.dtype)
+                      precision=prec,
+                      preferred_element_type=ad).astype(x.dtype)
 
 
 class MultiHeadAttention(ForwardBase):

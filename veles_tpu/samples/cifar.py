@@ -55,9 +55,12 @@ class CifarLoader(FullBatchLoader):
             tot = n_train + n_valid
             if kind == "scenes":
                 # the quality surrogate: shape classes with label-free
-                # color statistics (veles_tpu/datasets/scenes.py)
+                # color statistics (veles_tpu/datasets/scenes.py);
+                # synthetic_size=96 gives the STL-shaped variant
                 from veles_tpu.datasets import render_scenes
-                data, labels = render_scenes(tot, seed=1234)
+                data, labels = render_scenes(
+                    tot, seed=1234,
+                    size=int(root.cifar_tpu.get("synthetic_size", 32)))
                 data = data * 255.0
             else:
                 rng = numpy.random.default_rng(1234)
