@@ -15,7 +15,9 @@ PackagedWorkflow PackagedWorkflow::Load(const std::string& path) {
     throw std::runtime_error("package has no contents.json");
   Json manifest = Json::Parse(
       std::string(it->second.begin(), it->second.end()));
-  if (manifest.at("format_version").as_int() > 1)
+  // v2 added attention streaming config keys (block_size /
+  // attn_block_size); the units this runner implements are unaffected
+  if (manifest.at("format_version").as_int() > 2)
     throw std::runtime_error("package format too new for this runtime");
 
   PackagedWorkflow wf;

@@ -13,7 +13,7 @@ import numpy
 from veles_tpu.models.nn_units import ForwardBase
 
 
-def mha_apply(params, x, heads, causal):
+def mha_apply(params, x, heads, causal, block_size=None):
     """Multi-head attention forward over [batch, seq, d] — the ONE
     implementation shared by the MultiHeadAttention unit and
     TransformerBlock (params: wq/wk/wv/wo, each [d, d]).  Projections
@@ -34,8 +34,14 @@ def mha_apply(params, x, heads, causal):
                        precision=prec, preferred_element_type=ad)
         return y.astype(cd).reshape(b, s, heads, hd)
 
-    o = attention(proj(params["wq"]), proj(params["wk"]),
-                  proj(params["wv"]), causal=causal)
+    if block_size:
+        from veles_tpu.ops.attention import blockwise_attention
+        o = blockwise_attention(proj(params["wq"]), proj(params["wk"]),
+                                proj(params["wv"]), block_size,
+                                causal=causal)
+    else:
+        o = attention(proj(params["wq"]), proj(params["wk"]),
+                      proj(params["wv"]), causal=causal)
     return jnp.einsum("bsd,de->bse", o.reshape(b, s, d).astype(cd),
                       params["wo"].astype(cd),
                       precision=prec,
@@ -49,11 +55,15 @@ class MultiHeadAttention(ForwardBase):
 
     PARAMS = ("wq", "wk", "wv", "wo")
 
-    def __init__(self, workflow, heads=4, causal=False, **kwargs):
+    def __init__(self, workflow, heads=4, causal=False,
+                 block_size=None, **kwargs):
         from veles_tpu.memory import Array
         super(MultiHeadAttention, self).__init__(workflow, **kwargs)
         self.heads = int(heads)
         self.causal = causal
+        #: stream K/V in blocks of this many tokens (long sequences:
+        #: avoids the [seq, seq] score matrix; ops/attention.py)
+        self.block_size = block_size
         for p in self.PARAMS:
             setattr(self, p, Array())
 
@@ -72,7 +82,9 @@ class MultiHeadAttention(ForwardBase):
                        self.weights_stddev, d, d)
 
     def export_config(self):
-        return {"heads": self.heads, "causal": self.causal}
+        return {"heads": self.heads, "causal": self.causal,
+                "block_size": self.block_size}
 
     def apply(self, params, x):
-        return mha_apply(params, x, self.heads, self.causal)
+        return mha_apply(params, x, self.heads, self.causal,
+                         self.block_size)

@@ -39,13 +39,15 @@ class TransformerBlock(ForwardBase):
                    "ln2_scale", "ln2_bias")
 
     def __init__(self, workflow, heads=4, hidden=None, causal=True,
-                 n_experts=0, top_k=2, **kwargs):
+                 n_experts=0, top_k=2, attn_block_size=None, **kwargs):
         super(TransformerBlock, self).__init__(workflow,
                                                include_bias=True,
                                                **kwargs)
         self.heads = int(heads)
         self.hidden = hidden  # None -> 4*d at fill time
         self.causal = bool(causal)
+        #: stream K/V blockwise for long sequences (ops/attention.py)
+        self.attn_block_size = attn_block_size
         self.n_experts = int(n_experts)
         self.top_k = int(top_k)
         if self.n_experts and self.top_k > self.n_experts:
@@ -108,7 +110,7 @@ class TransformerBlock(ForwardBase):
         from veles_tpu.models.attention import mha_apply
         return mha_apply(
             {k: params[k] for k in ("wq", "wk", "wv", "wo")}, x,
-            self.heads, self.causal)
+            self.heads, self.causal, self.attn_block_size)
 
     def _ffn(self, params, x):
         from veles_tpu import dtypes
@@ -134,7 +136,8 @@ class TransformerBlock(ForwardBase):
     def export_config(self):
         return {"heads": self.heads, "hidden": int(self.hidden),
                 "causal": self.causal, "n_experts": self.n_experts,
-                "top_k": self.top_k}
+                "top_k": self.top_k,
+                "attn_block_size": self.attn_block_size}
 
 
 class MeanPoolSeq(ForwardBase):

@@ -72,6 +72,69 @@ def _online_merge(acc, new):
     return m, s, o
 
 
+def blockwise_attention(q, k, v, block_size=512, causal=False,
+                        scale=None):
+    """Exact attention WITHOUT materializing the [seq_q, seq_k] score
+    matrix: a ``lax.scan`` over K/V blocks with the same online-softmax
+    accumulator the ring uses — the single-chip half of the long-context
+    story (the ring shards across chips; this streams within one).
+
+    q/k/v: [..., seq, heads, dim].  Peak memory is O(seq_q ·
+    block_size) per head instead of O(seq_q · seq_k).  K/V sequence
+    lengths that don't divide ``block_size`` are zero-padded and
+    masked.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d)
+    seq_q = q.shape[-3]
+    seq_k = k.shape[-3]
+    bs = min(block_size, seq_k)
+    pad = (-seq_k) % bs
+    if pad:
+        widths = [(0, 0)] * k.ndim
+        widths[-3] = (0, pad)
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+    blocks = (seq_k + pad) // bs
+    # [..., seq, h, d] -> [blocks, ..., bs, h, d] (scan axis leads)
+    kb = jnp.moveaxis(
+        k.reshape(k.shape[:-3] + (blocks, bs) + k.shape[-2:]), -4, 0)
+    vb = jnp.moveaxis(
+        v.reshape(v.shape[:-3] + (blocks, bs) + v.shape[-2:]), -4, 0)
+    q_pos = jnp.arange(seq_q)
+
+    def body(acc, blk):
+        k_blk, v_blk, idx = blk
+        if causal:
+            k_pos = idx * bs + jnp.arange(bs)
+            mask = (k_pos < seq_k)[None, None, :] & (
+                k_pos[None, None, :] <=
+                q_pos[None, :, None] + (seq_k - seq_q))
+        elif pad:
+            k_pos = idx * bs + jnp.arange(bs)
+            mask = jnp.broadcast_to((k_pos < seq_k)[None, None, :],
+                                    (1, seq_q, bs))
+        else:
+            mask = None  # unmasked hot path: no where/select traffic
+        contrib = _block_contrib(q, k_blk, v_blk, scale, mask)
+        # the running sum accumulates up to seq_k exp terms — carry it
+        # in f32 even when activations are bf16 (the compounding merge
+        # error would otherwise grow with sequence length)
+        contrib = tuple(t.astype(jnp.float32) for t in contrib)
+        return _online_merge(acc, contrib), None
+
+    heads = q.shape[-2]
+    batchish = q.shape[:-3]
+    acc0 = (jnp.full(batchish + (heads, seq_q), -jnp.inf, jnp.float32),
+            jnp.zeros(batchish + (heads, seq_q), jnp.float32),
+            jnp.zeros(q.shape, jnp.float32))
+    acc, _ = jax.lax.scan(body, acc0,
+                          (kb, vb, jnp.arange(blocks)))
+    m, s, o = acc
+    denom = jnp.moveaxis(jnp.maximum(s, 1e-30), -2, -1)[..., None]
+    return (o / denom).astype(q.dtype)
+
+
 def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     """Attention with K/V sharded over the ``axis_name`` mesh axis.
 
@@ -100,6 +163,8 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
         k_blk, v_blk = kv
         contrib = _block_contrib(q, k_blk, v_blk, scale,
                                  mask_for(kv_idx))
+        # f32 accumulator: see blockwise_attention
+        contrib = tuple(t.astype(jnp.float32) for t in contrib)
         acc = _online_merge(acc, contrib)
         kv = jax.lax.ppermute(kv, axis_name, perm)
         kv_idx = jax.lax.ppermute(kv_idx, axis_name, perm)
@@ -107,9 +172,9 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
 
     heads = q.shape[-2]
     batchish = q.shape[:-3]
-    m0 = jnp.full(batchish + (heads, seq_q), -jnp.inf, q.dtype)
-    s0 = jnp.zeros(batchish + (heads, seq_q), q.dtype)
-    o0 = jnp.zeros(q.shape, q.dtype)
+    m0 = jnp.full(batchish + (heads, seq_q), -jnp.inf, jnp.float32)
+    s0 = jnp.zeros(batchish + (heads, seq_q), jnp.float32)
+    o0 = jnp.zeros(q.shape, jnp.float32)
     # freshly-created carries are axis-invariant constants; the scan
     # outputs vary over the ring axis — align the types up front
     m0, s0, o0 = (_pvary(t, axis_name) for t in (m0, s0, o0))
@@ -117,7 +182,7 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
         body, ((m0, s0, o0), (k, v), my_idx), None, length=n)
     m, s, o = acc
     denom = jnp.moveaxis(jnp.maximum(s, 1e-30), -2, -1)[..., None]
-    return o / denom
+    return (o / denom).astype(q.dtype)
 
 
 def ring_attention_sharded(mesh, q, k, v, axis="sp", causal=False):

@@ -39,7 +39,9 @@ import tarfile
 
 import numpy
 
-FORMAT_VERSION = 1
+#: bump when unit configs gain keys older runtimes reject
+#: (v2: attention block_size / attn_block_size streaming)
+FORMAT_VERSION = 2
 
 
 def _unit_entry(i, unit):
