@@ -339,3 +339,31 @@ def test_transformer_package_roundtrip(tmp_path):
     export_package(units, path, (4, 16), name="tr")
     y = load_package(path).run(x, mode="python")
     numpy.testing.assert_allclose(y, y_ref, atol=1e-5)
+
+
+def test_plain_packages_stay_v1(mlp_package, tmp_path):
+    """Packages without v2 features (attention streaming keys) are
+    stamped format_version 1, loadable by older deployments; a package
+    that USES them is stamped 2."""
+    import tarfile as _tar
+
+    def version_of(path):
+        with _tar.open(path) as t:
+            return json.loads(t.extractfile("contents.json").read())[
+                "format_version"]
+
+    assert version_of(mlp_package[0]) == 1
+
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.memory import Array
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.package_export import export_package
+    wf = AcceleratedWorkflow(None, name="v2")
+    x = numpy.zeros((2, 8, 16), numpy.float32)
+    units = make_forwards(wf, Array(x), [
+        {"type": "attention", "heads": 2, "block_size": 4}])
+    for u in units:
+        u.initialize(device=Device(backend="numpy"))
+    p2 = str(tmp_path / "v2.tar.gz")
+    export_package(units, p2, (2, 8, 16), name="v2")
+    assert version_of(p2) == 2

@@ -82,8 +82,10 @@ class MultiHeadAttention(ForwardBase):
                        self.weights_stddev, d, d)
 
     def export_config(self):
-        return {"heads": self.heads, "causal": self.causal,
-                "block_size": self.block_size}
+        cfg = {"heads": self.heads, "causal": self.causal}
+        if self.block_size:  # v2 key — omit when unused so plain
+            cfg["block_size"] = int(self.block_size)  # packages stay v1
+        return cfg
 
     def apply(self, params, x):
         return mha_apply(params, x, self.heads, self.causal,

@@ -134,10 +134,12 @@ class TransformerBlock(ForwardBase):
             h, params["ln2_scale"], params["ln2_bias"]))
 
     def export_config(self):
-        return {"heads": self.heads, "hidden": int(self.hidden),
-                "causal": self.causal, "n_experts": self.n_experts,
-                "top_k": self.top_k,
-                "attn_block_size": self.attn_block_size}
+        cfg = {"heads": self.heads, "hidden": int(self.hidden),
+               "causal": self.causal, "n_experts": self.n_experts,
+               "top_k": self.top_k}
+        if self.attn_block_size:  # v2 key — omit when unused
+            cfg["attn_block_size"] = int(self.attn_block_size)
+        return cfg
 
 
 class MeanPoolSeq(ForwardBase):

@@ -125,9 +125,10 @@ def blockwise_attention(q, k, v, block_size=512, causal=False,
 
     heads = q.shape[-2]
     batchish = q.shape[:-3]
+    # the output inherits v's value dim (may differ from q/k's key dim)
     acc0 = (jnp.full(batchish + (heads, seq_q), -jnp.inf, jnp.float32),
             jnp.zeros(batchish + (heads, seq_q), jnp.float32),
-            jnp.zeros(q.shape, jnp.float32))
+            jnp.zeros(q.shape[:-1] + (v.shape[-1],), jnp.float32))
     acc, _ = jax.lax.scan(body, acc0,
                           (kb, vb, jnp.arange(blocks)))
     m, s, o = acc

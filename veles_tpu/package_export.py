@@ -39,9 +39,12 @@ import tarfile
 
 import numpy
 
-#: bump when unit configs gain keys older runtimes reject
-#: (v2: attention block_size / attn_block_size streaming)
+#: the highest format this runtime understands.  Writers stamp the
+#: LOWEST version whose features a package actually uses (V2_KEYS),
+#: so plain packages stay loadable by older deployments.
 FORMAT_VERSION = 2
+#: unit-config keys that require a v2 reader
+V2_KEYS = ("block_size", "attn_block_size")
 
 
 def _unit_entry(i, unit):
@@ -91,7 +94,7 @@ def export_package(forwards, path, input_shape, input_dtype=numpy.float32,
     """
     manifest = {
         "format": "veles_tpu",  # NOT libVeles-compatible (see module doc)
-        "format_version": FORMAT_VERSION,
+        "format_version": 1,  # raised below if v2 features are present
         "workflow": name,
         "checksum": checksum,
         "input": {"shape": list(input_shape),
@@ -104,6 +107,8 @@ def export_package(forwards, path, input_shape, input_dtype=numpy.float32,
         entry, params = _unit_entry(i, u)
         manifest["units"].append(entry)
         blobs.update(params)
+        if any(k in entry["config"] for k in V2_KEYS):
+            manifest["format_version"] = 2
     try:
         shlo = _export_stablehlo(forwards, input_shape, input_dtype)
     except Exception as e:  # pragma: no cover - jax.export availability

@@ -64,7 +64,12 @@ class TransformerWorkflow(StandardWorkflow):
         spec += [{"type": "transformer_block", "heads": heads,
                   "causal": bool(cfg.get("causal", False)),
                   "n_experts": n_experts,
-                  "top_k": int(cfg.get("top_k", 2))}
+                  "top_k": int(cfg.get("top_k", 2)),
+                  # long sequences: stream K/V in blocks instead of
+                  # materializing [seq, seq] scores (ops/attention.py)
+                  "attn_block_size": (
+                      int(cfg.get("attn_block_size"))
+                      if cfg.get("attn_block_size") else None)}
                  for _ in range(blocks)]
         spec += [{"type": "mean_pool_seq"},
                  {"type": "softmax", "output_sample_shape": (vocab,)}]
