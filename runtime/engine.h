@@ -6,6 +6,7 @@
 #pragma once
 
 #include <condition_variable>
+#include <exception>
 #include <functional>
 #include <mutex>
 #include <thread>
@@ -37,12 +38,25 @@ class ThreadPool {
     std::vector<std::thread> threads;
     threads.reserve(w);
     size_t chunk = (n + w - 1) / w;
+    // a kernel throw (bad token id, malformed package shapes…) must
+    // surface as the unit's runtime_error, not std::terminate from a
+    // thread entry point — capture the first and rethrow after join
+    std::exception_ptr err;
+    std::mutex err_mu;
     for (size_t i = 0; i < w; ++i) {
       size_t b = i * chunk, e = std::min(n, b + chunk);
       if (b >= e) break;
-      threads.emplace_back([&fn, b, e] { fn(b, e); });
+      threads.emplace_back([&fn, &err, &err_mu, b, e] {
+        try {
+          fn(b, e);
+        } catch (...) {
+          std::lock_guard<std::mutex> lock(err_mu);
+          if (!err) err = std::current_exception();
+        }
+      });
     }
     for (auto& t : threads) t.join();
+    if (err) std::rethrow_exception(err);
   }
 
  private:

@@ -4,6 +4,7 @@
 #include <cmath>
 #include <cstring>
 #include <limits>
+#include <memory>
 #include <stdexcept>
 
 namespace veles_rt {
@@ -530,6 +531,248 @@ void MoE::Execute(const Tensor& in, Tensor* out, ThreadPool* pool) const {
   });
 }
 
+// -- Embedding ----------------------------------------------------------------
+
+EmbeddingU::EmbeddingU(const Json& config) {
+  vocab_ = static_cast<int>(config.at("vocab").number);
+  dim_ = static_cast<int>(config.at("dim").number);
+  learned_positions_ = !config.has("learned_positions") ||
+                       config.at("learned_positions").boolean;
+}
+
+void EmbeddingU::SetParam(const std::string& name, Tensor t) {
+  if (name == "weights")
+    weights_ = std::move(t);
+  else if (name == "positions")
+    positions_ = std::move(t);
+}
+
+std::vector<size_t> EmbeddingU::OutShape(
+    const std::vector<size_t>& in) const {
+  return {in[0], in[1], static_cast<size_t>(dim_)};
+}
+
+void EmbeddingU::Execute(const Tensor& in, Tensor* out,
+                         ThreadPool* pool) const {
+  size_t batch = in.dim(0), seq = in.dim(1);
+  size_t d = static_cast<size_t>(dim_);
+  if (weights_.dim(0) != static_cast<size_t>(vocab_) ||
+      weights_.dim(1) != d ||
+      (learned_positions_ &&
+       (positions_.dim(0) < seq || positions_.dim(1) != d)))
+    throw std::runtime_error("Embedding parameter shape mismatch");
+  out->reshape(OutShape(in.shape));
+  pool->ParallelFor(batch, [&](size_t n0, size_t n1) {
+    for (size_t n = n0; n < n1; ++n) {
+      for (size_t s = 0; s < seq; ++s) {
+        long tok = static_cast<long>(in.ptr()[n * seq + s]);
+        if (tok < 0 || tok >= vocab_)
+          throw std::runtime_error("Embedding token id out of range");
+        float* y = out->ptr() + (n * seq + s) * d;
+        std::memcpy(y, weights_.ptr() + tok * d, d * sizeof(float));
+        if (learned_positions_) {
+          const float* pos = positions_.ptr() + s * d;
+          for (size_t j = 0; j < d; ++j) y[j] += pos[j];
+        }
+      }
+    }
+  });
+}
+
+// -- TransformerBlock ---------------------------------------------------------
+
+namespace {
+
+void LayerNormRow(const float* x, const float* scale, const float* bias,
+                  float* y, size_t d) {
+  float mean = 0;
+  for (size_t j = 0; j < d; ++j) mean += x[j];
+  mean /= d;
+  float var = 0;
+  for (size_t j = 0; j < d; ++j) {
+    float c = x[j] - mean;
+    var += c * c;
+  }
+  var /= d;
+  float r = 1.0f / std::sqrt(var + 1e-5f);
+  for (size_t j = 0; j < d; ++j)
+    y[j] = (x[j] - mean) * r * scale[j] + bias[j];
+}
+
+// y[s,:] += x[s,:] @ W [d_in, d_out]
+void MatVecRows(const float* x, const float* w, float* y, size_t rows,
+                size_t d_in, size_t d_out) {
+  for (size_t s = 0; s < rows; ++s) {
+    const float* xr = x + s * d_in;
+    float* yr = y + s * d_out;
+    for (size_t kk = 0; kk < d_in; ++kk) {
+      float xv = xr[kk];
+      if (xv == 0.0f) continue;
+      const float* wr = w + kk * d_out;
+      for (size_t j = 0; j < d_out; ++j) yr[j] += xv * wr[j];
+    }
+  }
+}
+
+}  // namespace
+
+TransformerBlockU::TransformerBlockU(const Json& config) {
+  heads_ = static_cast<int>(config.at("heads").number);
+  hidden_ = static_cast<int>(config.at("hidden").number);
+  causal_ = config.at("causal").boolean;
+  n_experts_ = config.has("n_experts")
+                   ? static_cast<int>(config.at("n_experts").number)
+                   : 0;
+  top_k_ = config.has("top_k")
+               ? static_cast<int>(config.at("top_k").number)
+               : 2;
+}
+
+void TransformerBlockU::SetParam(const std::string& name, Tensor t) {
+  p_[name] = std::move(t);
+}
+
+std::vector<size_t> TransformerBlockU::OutShape(
+    const std::vector<size_t>& in) const {
+  return in;
+}
+
+void TransformerBlockU::Execute(const Tensor& in, Tensor* out,
+                                ThreadPool* pool) const {
+  size_t batch = in.dim(0), seq = in.dim(1), d = in.dim(2);
+  size_t h = static_cast<size_t>(heads_);
+  if (d % h)
+    throw std::runtime_error("TransformerBlock dim/heads mismatch");
+  size_t hd = d / h;
+  for (const char* name : {"ln1_scale", "ln1_bias", "wq", "wk", "wv",
+                           "wo", "ln2_scale", "ln2_bias"})
+    if (!p_.count(name))
+      throw std::runtime_error(
+          std::string("TransformerBlock missing param ") + name);
+  if (!n_experts_)
+    for (const char* name : {"ffn_w1", "ffn_b1", "ffn_w2", "ffn_b2"})
+      if (!p_.count(name))
+        throw std::runtime_error(
+            std::string("TransformerBlock missing param ") + name);
+  out->reshape(in.shape);
+  float scale = 1.0f / std::sqrt(static_cast<float>(hd));
+
+  // expert FFN: the MoE sub-unit is built ONCE (lazily, on the caller
+  // thread — a served model must not re-copy every expert weight per
+  // request); MoE::Execute is const and scratch-local, so rows share it
+  if (n_experts_ && !moe_) {
+    Json cfg = Json::Parse(
+        "{\"n_experts\": " + std::to_string(n_experts_) +
+        ", \"top_k\": " + std::to_string(top_k_) +
+        ", \"hidden\": " + std::to_string(hidden_) + "}");
+    moe_.reset(new MoE(cfg));
+    for (const char* name : {"gate", "expert_w1", "expert_b1",
+                             "expert_w2", "expert_b2"}) {
+      if (!p_.count(name))
+        throw std::runtime_error(
+            std::string("TransformerBlock missing param ") + name);
+      Tensor copy = p_.at(name);
+      moe_->SetParam(name, std::move(copy));
+    }
+  }
+  const MoE* moe = moe_.get();
+
+  pool->ParallelFor(batch, [&](size_t n0, size_t n1) {
+    std::vector<float> ln(seq * d), q(seq * d), k(seq * d), v(seq * d),
+        attn(seq * d), logits(seq), hid;
+    for (size_t n = n0; n < n1; ++n) {
+      const float* x = in.ptr() + n * seq * d;
+      float* y = out->ptr() + n * seq * d;
+      // ---- attention half: y = x + Wo·attn(LN1(x))
+      for (size_t s = 0; s < seq; ++s)
+        LayerNormRow(x + s * d, p_.at("ln1_scale").ptr(),
+                     p_.at("ln1_bias").ptr(), ln.data() + s * d, d);
+      std::fill(q.begin(), q.end(), 0.0f);
+      std::fill(k.begin(), k.end(), 0.0f);
+      std::fill(v.begin(), v.end(), 0.0f);
+      MatVecRows(ln.data(), p_.at("wq").ptr(), q.data(), seq, d, d);
+      MatVecRows(ln.data(), p_.at("wk").ptr(), k.data(), seq, d, d);
+      MatVecRows(ln.data(), p_.at("wv").ptr(), v.data(), seq, d, d);
+      std::fill(attn.begin(), attn.end(), 0.0f);
+      for (size_t hh = 0; hh < h; ++hh) {
+        size_t off = hh * hd;
+        for (size_t sq = 0; sq < seq; ++sq) {
+          size_t limit = causal_ ? sq + 1 : seq;
+          float mx = -std::numeric_limits<float>::infinity();
+          for (size_t sk = 0; sk < limit; ++sk) {
+            float dot = 0;
+            for (size_t j = 0; j < hd; ++j)
+              dot += q[sq * d + off + j] * k[sk * d + off + j];
+            logits[sk] = dot * scale;
+            mx = std::fmax(mx, logits[sk]);
+          }
+          float denom = 0;
+          for (size_t sk = 0; sk < limit; ++sk) {
+            logits[sk] = std::exp(logits[sk] - mx);
+            denom += logits[sk];
+          }
+          float* arow = attn.data() + sq * d + off;
+          for (size_t sk = 0; sk < limit; ++sk) {
+            float wgt = logits[sk] / denom;
+            const float* vrow = v.data() + sk * d + off;
+            for (size_t j = 0; j < hd; ++j) arow[j] += wgt * vrow[j];
+          }
+        }
+      }
+      std::memcpy(y, x, seq * d * sizeof(float));
+      MatVecRows(attn.data(), p_.at("wo").ptr(), y, seq, d, d);
+      // ---- FFN half: y += FFN(LN2(y))
+      for (size_t s = 0; s < seq; ++s)
+        LayerNormRow(y + s * d, p_.at("ln2_scale").ptr(),
+                     p_.at("ln2_bias").ptr(), ln.data() + s * d, d);
+      if (n_experts_) {
+        // per-token sparse top-k MoE (same math as the MoE unit)
+        Tensor lnt({seq, d});
+        std::memcpy(lnt.ptr(), ln.data(), seq * d * sizeof(float));
+        Tensor ffn_out;
+        ThreadPool serial(1);  // already inside the batch ParallelFor
+        moe->Execute(lnt, &ffn_out, &serial);
+        for (size_t j = 0; j < seq * d; ++j) y[j] += ffn_out.ptr()[j];
+      } else {
+        size_t hdim = static_cast<size_t>(hidden_);
+        hid.assign(seq * hdim, 0.0f);
+        for (size_t s = 0; s < seq; ++s)
+          std::memcpy(hid.data() + s * hdim, p_.at("ffn_b1").ptr(),
+                      hdim * sizeof(float));
+        MatVecRows(ln.data(), p_.at("ffn_w1").ptr(), hid.data(), seq,
+                   d, hdim);
+        for (auto& t : hid) t = std::fmax(t, 0.0f);
+        std::vector<float> f2(seq * d);
+        for (size_t s = 0; s < seq; ++s)
+          std::memcpy(f2.data() + s * d, p_.at("ffn_b2").ptr(),
+                      d * sizeof(float));
+        MatVecRows(hid.data(), p_.at("ffn_w2").ptr(), f2.data(), seq,
+                   hdim, d);
+        for (size_t j = 0; j < seq * d; ++j) y[j] += f2[j];
+      }
+    }
+  });
+}
+
+// -- MeanPoolSeq --------------------------------------------------------------
+
+void MeanPoolSeqU::Execute(const Tensor& in, Tensor* out,
+                           ThreadPool* pool) const {
+  size_t batch = in.dim(0), seq = in.dim(1), d = in.dim(2);
+  out->reshape({batch, d});
+  pool->ParallelFor(batch, [&](size_t n0, size_t n1) {
+    for (size_t n = n0; n < n1; ++n) {
+      float* y = out->ptr() + n * d;
+      std::memset(y, 0, d * sizeof(float));
+      for (size_t s = 0; s < seq; ++s) {
+        const float* x = in.ptr() + (n * seq + s) * d;
+        for (size_t j = 0; j < d; ++j) y[j] += x[j];
+      }
+      for (size_t j = 0; j < d; ++j) y[j] /= seq;
+    }
+  });
+}
+
 // -- factory ------------------------------------------------------------------
 
 std::unique_ptr<Unit> CreateUnit(const std::string& cls, const Json& config) {
@@ -559,6 +802,12 @@ std::unique_ptr<Unit> CreateUnit(const std::string& cls, const Json& config) {
   if (cls == "LRNormalizerForward")
     return std::unique_ptr<Unit>(new LRN(config));
   if (cls == "MoE") return std::unique_ptr<Unit>(new MoE(config));
+  if (cls == "Embedding")
+    return std::unique_ptr<Unit>(new EmbeddingU(config));
+  if (cls == "TransformerBlock")
+    return std::unique_ptr<Unit>(new TransformerBlockU(config));
+  if (cls == "MeanPoolSeq")
+    return std::unique_ptr<Unit>(new MeanPoolSeqU());
   if (cls == "DropoutForward")
     return std::unique_ptr<Unit>(new Identity());
   throw std::runtime_error("unit factory: unknown class " + cls);

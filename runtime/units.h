@@ -136,6 +136,49 @@ class LRN : public Unit {
   int n_;
 };
 
+// token embedding with optional learned positions:
+// [batch, seq] (float-encoded ids) -> [batch, seq, dim]
+class EmbeddingU : public Unit {
+ public:
+  explicit EmbeddingU(const Json& config);
+  std::vector<size_t> OutShape(const std::vector<size_t>& in) const override;
+  void Execute(const Tensor& in, Tensor* out,
+               ThreadPool* pool) const override;
+  void SetParam(const std::string& name, Tensor t) override;
+
+ private:
+  int vocab_, dim_;
+  bool learned_positions_;
+  Tensor weights_, positions_;
+};
+
+// pre-LN transformer block matching veles_tpu.models.transformer:
+// x + MHA(LN1(x)), then + FFN(LN2(.)) — dense or top-k-MoE FFN
+class TransformerBlockU : public Unit {
+ public:
+  explicit TransformerBlockU(const Json& config);
+  std::vector<size_t> OutShape(const std::vector<size_t>& in) const override;
+  void Execute(const Tensor& in, Tensor* out,
+               ThreadPool* pool) const override;
+  void SetParam(const std::string& name, Tensor t) override;
+
+ private:
+  int heads_, hidden_, n_experts_, top_k_;
+  bool causal_;
+  std::map<std::string, Tensor> p_;
+  //: lazily-built expert FFN (Execute is const; built once)
+  mutable std::unique_ptr<MoE> moe_;
+};
+
+class MeanPoolSeqU : public Unit {  // [b, s, d] -> [b, d]
+ public:
+  std::vector<size_t> OutShape(const std::vector<size_t>& in) const override {
+    return {in[0], in[2]};
+  }
+  void Execute(const Tensor& in, Tensor* out,
+               ThreadPool* pool) const override;
+};
+
 class Identity : public Unit {  // dropout at inference
  public:
   std::vector<size_t> OutShape(const std::vector<size_t>& in) const override {

@@ -367,3 +367,48 @@ def test_plain_packages_stay_v1(mlp_package, tmp_path):
     p2 = str(tmp_path / "v2.tar.gz")
     export_package(units, p2, (2, 8, 16), name="v2")
     assert version_of(p2) == 2
+
+
+def test_cpp_runner_transformer(runner_binary, tmp_path):
+    """Native transformer inference (embedding + pre-LN MHA block,
+    dense AND MoE FFN variants + mean-pool + softmax) agrees with the
+    JAX forward — sequence models run in the C++ runner too."""
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.config import root
+    from veles_tpu.memory import Array
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.package_export import export_package, load_package
+
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    try:
+        for n_experts in (0, 3):
+            wf = AcceleratedWorkflow(None, name="trx%d" % n_experts)
+            rng = numpy.random.default_rng(21)
+            x = rng.integers(0, 11, (3, 10)).astype(numpy.float32)
+            units = make_forwards(wf, Array(x.astype(numpy.int32)), [
+                {"type": "embedding", "vocab": 11, "dim": 16},
+                {"type": "transformer_block", "heads": 2,
+                 "hidden": 24, "causal": True,
+                 "n_experts": n_experts, "top_k": min(2, n_experts or 2)},
+                {"type": "mean_pool_seq"},
+                {"type": "softmax", "output_sample_shape": (5,)},
+            ])
+            dev = Device(backend="numpy")
+            for u in units:
+                u.initialize(device=dev)
+            path = str(tmp_path / ("trx%d.tar.gz" % n_experts))
+            export_package(units, path, (3, 10), name="trx")
+            y_ref = load_package(path).run(x, mode="python")
+            numpy.save(tmp_path / "in.npy", x)
+            r = subprocess.run(
+                [runner_binary, path, str(tmp_path / "in.npy"),
+                 str(tmp_path / "out.npy")],
+                capture_output=True, text=True)
+            assert r.returncode == 0, (n_experts, r.stderr)
+            y = numpy.load(tmp_path / "out.npy")
+            assert y.shape == y_ref.shape
+            numpy.testing.assert_allclose(y, y_ref, atol=2e-3,
+                                          err_msg=str(n_experts))
+    finally:
+        root.common.precision.compute_dtype = saved
