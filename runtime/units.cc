@@ -654,6 +654,26 @@ void TransformerBlockU::Execute(const Tensor& in, Tensor* out,
       if (!p_.count(name))
         throw std::runtime_error(
             std::string("TransformerBlock missing param ") + name);
+  // full shape validation before any pointer arithmetic (same
+  // invariant as MoE/Embedding/Dense/Conv): a truncated package must
+  // throw, not read out of bounds
+  for (const char* name : {"ln1_scale", "ln1_bias", "ln2_scale",
+                           "ln2_bias"})
+    if (p_.at(name).count() != d)
+      throw std::runtime_error(
+          std::string("TransformerBlock bad shape for ") + name);
+  for (const char* name : {"wq", "wk", "wv", "wo"})
+    if (p_.at(name).count() != d * d)
+      throw std::runtime_error(
+          std::string("TransformerBlock bad shape for ") + name);
+  if (!n_experts_) {
+    size_t hdim = static_cast<size_t>(hidden_);
+    if (p_.at("ffn_w1").count() != d * hdim ||
+        p_.at("ffn_b1").count() != hdim ||
+        p_.at("ffn_w2").count() != hdim * d ||
+        p_.at("ffn_b2").count() != d)
+      throw std::runtime_error("TransformerBlock bad FFN shapes");
+  }
   out->reshape(in.shape);
   float scale = 1.0f / std::sqrt(static_cast<float>(hd));
 
@@ -668,11 +688,15 @@ void TransformerBlockU::Execute(const Tensor& in, Tensor* out,
     moe_.reset(new MoE(cfg));
     for (const char* name : {"gate", "expert_w1", "expert_b1",
                              "expert_w2", "expert_b2"}) {
-      if (!p_.count(name))
+      auto it = p_.find(name);
+      if (it == p_.end())
         throw std::runtime_error(
             std::string("TransformerBlock missing param ") + name);
-      Tensor copy = p_.at(name);
-      moe_->SetParam(name, std::move(copy));
+      // MOVE the expert tensors out of p_: they are the block's
+      // largest parameters and keeping both copies alive would double
+      // the runner's weight footprint
+      moe_->SetParam(name, std::move(it->second));
+      p_.erase(it);
     }
   }
   const MoE* moe = moe_.get();

@@ -165,7 +165,8 @@ class TransformerBlockU : public Unit {
  private:
   int heads_, hidden_, n_experts_, top_k_;
   bool causal_;
-  std::map<std::string, Tensor> p_;
+  //: mutable: the lazy MoE build MOVES the expert tensors out of p_
+  mutable std::map<std::string, Tensor> p_;
   //: lazily-built expert FFN (Execute is const; built once)
   mutable std::unique_ptr<MoE> moe_;
 };

@@ -227,6 +227,25 @@ class Coordinator(Logger):
             with contextlib.suppress(Exception):
                 await writer.wait_closed()
 
+    async def _finish_session(self, worker, reader):
+        """Send terminate and wait (bounded) for the WORKER to close
+        first: returning immediately would close a socket that may hold
+        an unread frame (the worker's next "job" racing our terminate),
+        and close-with-unread-data sends TCP RST — discarding the very
+        terminate we buffered (the same race stop()'s drain handles)."""
+        await send_frame(worker.writer, {"cmd": "terminate"})
+        self._drop(worker, requeue=False)
+        try:
+            async def drain():
+                while True:
+                    data = await reader.read(65536)
+                    if not data:
+                        return
+            await asyncio.wait_for(drain(), 5.0)
+        except (asyncio.TimeoutError, TimeoutError, ConnectionError,
+                OSError):
+            pass
+
     async def _serve_worker(self, worker, reader):
         while True:
             msg = await recv_frame(reader)
@@ -238,8 +257,7 @@ class Coordinator(Logger):
                     # worker registered under the same id)
                     return
                 if self._done.is_set() or self._stopping:
-                    await send_frame(worker.writer, {"cmd": "terminate"})
-                    self._drop(worker, requeue=False)
+                    await self._finish_session(worker, reader)
                     return
                 if self._has_more_jobs():
                     job = self.workflow.generate_data_for_slave(worker.id)
@@ -261,8 +279,7 @@ class Coordinator(Logger):
                     # run already complete — the straggler's update is
                     # redundant; release it cleanly
                     worker.state = "WAIT"
-                    await send_frame(worker.writer, {"cmd": "terminate"})
-                    self._drop(worker, requeue=False)
+                    await self._finish_session(worker, reader)
                     return
                 if self.workers.get(worker.id) is not worker:
                     # this session was dropped (watchdog timeout or a
