@@ -99,12 +99,27 @@ def run_one(name, spec, timeout=3000):
         cmd.append(spec["config"])
     cmd += ["-c", spec["overrides"], "--result-file", result_file]
     t0 = time.time()
-    proc = subprocess.run(cmd, cwd=REPO, capture_output=True,
-                          timeout=timeout)
-    elapsed = time.time() - t0
-    record = {"command": " ".join(cmd[2:]), "seconds": round(elapsed, 1),
-              "returncode": proc.returncode,
+    record = {"command": " ".join(cmd[2:]),
               "reference": REFERENCE[name], "target": spec["target"]}
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        # one hung run is a failure of THAT run, not of the whole
+        # sweep — record it (with whatever the child said) and let the
+        # remaining families measure
+        try:
+            os.unlink(result_file)
+        except OSError:
+            pass
+        record.update(seconds=round(time.time() - t0, 1), returncode=-1,
+                      error="timeout after %ds" % timeout)
+        if e.stderr:
+            record["stderr_tail"] = e.stderr.decode(
+                errors="replace")[-800:]
+        return record
+    record.update(seconds=round(time.time() - t0, 1),
+                  returncode=proc.returncode)
     try:
         if proc.returncode:
             record["stderr_tail"] = proc.stderr.decode(

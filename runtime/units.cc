@@ -626,6 +626,14 @@ TransformerBlockU::TransformerBlockU(const Json& config) {
   top_k_ = config.has("top_k")
                ? static_cast<int>(config.at("top_k").number)
                : 2;
+  // a hand-edited package with heads=0 would otherwise reach d % h
+  // (SIGFPE) instead of the runtime_error malformed packages promise
+  if (heads_ < 1)
+    throw std::runtime_error("TransformerBlock: heads must be >= 1");
+  if (hidden_ < 1)
+    throw std::runtime_error("TransformerBlock: hidden must be >= 1");
+  if (n_experts_ < 0 || (n_experts_ && top_k_ < 1))
+    throw std::runtime_error("TransformerBlock: bad MoE config");
 }
 
 void TransformerBlockU::SetParam(const std::string& name, Tensor t) {
@@ -637,6 +645,26 @@ std::vector<size_t> TransformerBlockU::OutShape(
   return in;
 }
 
+void TransformerBlockU::BuildMoE() const {
+  Json cfg = Json::Parse(
+      "{\"n_experts\": " + std::to_string(n_experts_) +
+      ", \"top_k\": " + std::to_string(top_k_) +
+      ", \"hidden\": " + std::to_string(hidden_) + "}");
+  moe_.reset(new MoE(cfg));
+  for (const char* name : {"gate", "expert_w1", "expert_b1",
+                           "expert_w2", "expert_b2"}) {
+    auto it = p_.find(name);
+    if (it == p_.end())
+      throw std::runtime_error(
+          std::string("TransformerBlock missing param ") + name);
+    // MOVE the expert tensors out of p_: they are the block's
+    // largest parameters and keeping both copies alive would double
+    // the runner's weight footprint
+    moe_->SetParam(name, std::move(it->second));
+    p_.erase(it);
+  }
+}
+
 void TransformerBlockU::Execute(const Tensor& in, Tensor* out,
                                 ThreadPool* pool) const {
   size_t batch = in.dim(0), seq = in.dim(1), d = in.dim(2);
@@ -644,6 +672,10 @@ void TransformerBlockU::Execute(const Tensor& in, Tensor* out,
   if (d % h)
     throw std::runtime_error("TransformerBlock dim/heads mismatch");
   size_t hd = d / h;
+  // build the MoE sub-unit FIRST: it mutates p_ (moves the expert
+  // tensors out), so every Execute thread must pass this barrier
+  // before any p_ access below
+  if (n_experts_) std::call_once(moe_once_, [this] { BuildMoE(); });
   for (const char* name : {"ln1_scale", "ln1_bias", "wq", "wk", "wv",
                            "wo", "ln2_scale", "ln2_bias"})
     if (!p_.count(name))
@@ -677,28 +709,6 @@ void TransformerBlockU::Execute(const Tensor& in, Tensor* out,
   out->reshape(in.shape);
   float scale = 1.0f / std::sqrt(static_cast<float>(hd));
 
-  // expert FFN: the MoE sub-unit is built ONCE (lazily, on the caller
-  // thread — a served model must not re-copy every expert weight per
-  // request); MoE::Execute is const and scratch-local, so rows share it
-  if (n_experts_ && !moe_) {
-    Json cfg = Json::Parse(
-        "{\"n_experts\": " + std::to_string(n_experts_) +
-        ", \"top_k\": " + std::to_string(top_k_) +
-        ", \"hidden\": " + std::to_string(hidden_) + "}");
-    moe_.reset(new MoE(cfg));
-    for (const char* name : {"gate", "expert_w1", "expert_b1",
-                             "expert_w2", "expert_b2"}) {
-      auto it = p_.find(name);
-      if (it == p_.end())
-        throw std::runtime_error(
-            std::string("TransformerBlock missing param ") + name);
-      // MOVE the expert tensors out of p_: they are the block's
-      // largest parameters and keeping both copies alive would double
-      // the runner's weight footprint
-      moe_->SetParam(name, std::move(it->second));
-      p_.erase(it);
-    }
-  }
   const MoE* moe = moe_.get();
 
   pool->ParallelFor(batch, [&](size_t n0, size_t n1) {

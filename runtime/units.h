@@ -7,6 +7,7 @@
 
 #include <map>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -163,12 +164,17 @@ class TransformerBlockU : public Unit {
   void SetParam(const std::string& name, Tensor t) override;
 
  private:
+  void BuildMoE() const;
+
   int heads_, hidden_, n_experts_, top_k_;
   bool causal_;
   //: mutable: the lazy MoE build MOVES the expert tensors out of p_
   mutable std::map<std::string, Tensor> p_;
-  //: lazily-built expert FFN (Execute is const; built once)
+  //: lazily-built expert FFN (Execute is const; built once); the
+  //: once_flag serializes the build against concurrent Execute calls
+  //: (a served model handles parallel requests on one unit)
   mutable std::unique_ptr<MoE> moe_;
+  mutable std::once_flag moe_once_;
 };
 
 class MeanPoolSeqU : public Unit {  // [b, s, d] -> [b, d]

@@ -39,6 +39,23 @@ class TestRingAttention:
         numpy.testing.assert_allclose(numpy.asarray(out),
                                       numpy.asarray(ref), atol=2e-5)
 
+    def test_dv_neq_dqk(self):
+        """The ring's output accumulator follows v's value dim, which
+        may differ from q/k's key dim (the blockwise op got this fix in
+        r3; the ring inherits it)."""
+        from veles_tpu.ops.attention import (
+            attention, ring_attention_sharded)
+        rng = numpy.random.default_rng(7)
+        q, k = (jnp.asarray(rng.normal(size=(32, 2, 8)), jnp.float32)
+                for _ in range(2))
+        v = jnp.asarray(rng.normal(size=(32, 2, 6)), jnp.float32)
+        mesh = build_mesh({"sp": 4}, devices=jax.devices()[:4])
+        out = ring_attention_sharded(mesh, q, k, v, causal=True)
+        assert out.shape == (32, 2, 6)
+        numpy.testing.assert_allclose(
+            numpy.asarray(out),
+            numpy.asarray(attention(q, k, v, causal=True)), atol=2e-5)
+
     def test_long_context_memory_shape(self):
         """Each chip only ever holds seq/sp of K/V (the point of the
         ring): verified structurally via the sharded input layout."""

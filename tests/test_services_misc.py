@@ -320,6 +320,22 @@ def test_safe_pickle_blocks_code_execution():
         safe_loads(blob)
 
 
+def test_safe_pickle_bf16_roundtrip():
+    """ADVICE r3: bf16-typed host mirrors (the bf16 trunk policy) must
+    survive the restricted unpickler — their pickle references the
+    ml_dtypes scalar type."""
+    import pickle as _p
+    import numpy as _np
+    import ml_dtypes
+    from veles_tpu.safe_pickle import safe_loads
+
+    a = _np.arange(6, dtype=ml_dtypes.bfloat16).reshape(2, 3)
+    out = safe_loads(_p.dumps(a, protocol=_p.HIGHEST_PROTOCOL))
+    assert out.dtype == ml_dtypes.bfloat16
+    assert _np.array_equal(out.astype(_np.float32),
+                           a.astype(_np.float32))
+
+
 # -- scripts: bboxer + update_forge (ref: veles/scripts/) ---------------------
 
 def test_bboxer_label_roundtrip(tmp_path):

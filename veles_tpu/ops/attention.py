@@ -175,7 +175,8 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     batchish = q.shape[:-3]
     m0 = jnp.full(batchish + (heads, seq_q), -jnp.inf, jnp.float32)
     s0 = jnp.zeros(batchish + (heads, seq_q), jnp.float32)
-    o0 = jnp.zeros(q.shape, jnp.float32)
+    # the output inherits v's value dim (may differ from q/k's key dim)
+    o0 = jnp.zeros(q.shape[:-1] + (v.shape[-1],), jnp.float32)
     # freshly-created carries are axis-invariant constants; the scan
     # outputs vary over the ring axis — align the types up front
     m0, s0, o0 = (_pvary(t, axis_name) for t in (m0, s0, o0))

@@ -30,6 +30,9 @@ _ALLOWED = {
     },
     "collections": {"OrderedDict", "deque", "defaultdict", "Counter"},
     "numpy": {"ndarray", "dtype", "matrix"},
+    # bf16-typed host mirrors (the bf16 trunk policy) pickle a
+    # reference to the ml_dtypes scalar type — data-only constructors
+    "ml_dtypes": {"bfloat16", "float8_e4m3fn", "float8_e5m2"},
     "numpy.core.multiarray": {"_reconstruct", "scalar"},
     "numpy._core.multiarray": {"_reconstruct", "scalar"},  # numpy >= 2
     "numpy.core.numeric": {"_frombuffer"},
