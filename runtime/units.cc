@@ -646,17 +646,21 @@ std::vector<size_t> TransformerBlockU::OutShape(
 }
 
 void TransformerBlockU::BuildMoE() const {
+  static const char* const kExpertParams[] = {
+      "gate", "expert_w1", "expert_b1", "expert_w2", "expert_b2"};
+  // validate BEFORE moving anything: a failed build must leave p_
+  // intact so a retry reports the same (correct) missing param
+  for (const char* name : kExpertParams)
+    if (!p_.count(name))
+      throw std::runtime_error(
+          std::string("TransformerBlock missing param ") + name);
   Json cfg = Json::Parse(
       "{\"n_experts\": " + std::to_string(n_experts_) +
       ", \"top_k\": " + std::to_string(top_k_) +
       ", \"hidden\": " + std::to_string(hidden_) + "}");
   moe_.reset(new MoE(cfg));
-  for (const char* name : {"gate", "expert_w1", "expert_b1",
-                           "expert_w2", "expert_b2"}) {
+  for (const char* name : kExpertParams) {
     auto it = p_.find(name);
-    if (it == p_.end())
-      throw std::runtime_error(
-          std::string("TransformerBlock missing param ") + name);
     // MOVE the expert tensors out of p_: they are the block's
     // largest parameters and keeping both copies alive would double
     // the runner's weight footprint

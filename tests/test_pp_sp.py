@@ -149,6 +149,29 @@ class TestPipeline:
             pipeline_forward(mesh, lambda p, h: h, [{}] * 4,
                              jnp.zeros((10, 4)), n_micro=4)
 
+    def test_gpipe_dp_composition(self):
+        """pp×dp: microbatches shard over dp while stages hop over pp —
+        each dp slice runs its own bubble schedule (VERDICT r3 #4)."""
+        from veles_tpu.parallel.pipeline import pipeline_forward
+        mesh = build_mesh({"pp": 4, "dp": 2})
+        rng = numpy.random.default_rng(2)
+        dim = 8
+        stage_params = [
+            {"w": jnp.asarray(rng.normal(scale=0.5, size=(dim, dim)),
+                              jnp.float32)} for _ in range(4)]
+
+        def stage_fn(params, h):
+            return jnp.tanh(h @ params["w"])
+
+        x = jnp.asarray(rng.normal(size=(16, dim)), jnp.float32)
+        out = pipeline_forward(mesh, stage_fn, stage_params, x,
+                               n_micro=2, batch_axes=("dp",))
+        ref = x
+        for p in stage_params:
+            ref = stage_fn(p, ref)
+        numpy.testing.assert_allclose(numpy.asarray(out),
+                                      numpy.asarray(ref), atol=1e-5)
+
     def test_gpipe_differentiable(self):
         """The whole pipeline is one traced program — autodiff crosses
         the stage hops (training through pp works)."""
@@ -207,6 +230,56 @@ class TestRingAttentionTraining:
         for a, b in zip(g_ring, g_ref):
             numpy.testing.assert_allclose(numpy.asarray(a),
                                           numpy.asarray(b), atol=1e-4)
+
+
+class TestSequenceShardedTraining:
+    """sp is first-class at the MODEL layer (VERDICT r3 #3): a workflow
+    whose mesh carries an sp axis trains sequence-sharded end-to-end —
+    the trainer hands the mesh to its forwards and mha_apply switches
+    to the ppermute ring."""
+
+    def test_transformer_sample_trains_dp_sp(self):
+        from veles_tpu.backends import Device
+        from veles_tpu.config import root
+        from veles_tpu.samples.transformer import TransformerWorkflow
+        root.transformer_tpu.update({
+            "mesh": {"dp": 2, "sp": 4}, "seq": 16, "dim": 16,
+            "heads": 2, "blocks": 1, "causal": True,
+            "minibatch_size": 16, "synthetic_train": 64,
+            "synthetic_valid": 16, "max_epochs": 1,
+            "snapshot_time_interval": 1e9})
+        try:
+            wf = TransformerWorkflow(None)
+            wf.initialize(device=Device(backend="numpy"))
+            blk = [u for u in wf.forwards
+                   if type(u).__name__ == "TransformerBlock"][0]
+            assert getattr(blk, "sp_mesh_", None) is not None, \
+                "trainer did not hand the sp mesh to the block"
+            wf.run()
+            wf.gd.loss.map_read()
+            assert numpy.isfinite(wf.gd.loss.mem)
+        finally:
+            root.transformer_tpu.mesh = None
+
+    def test_mha_unit_ring_matches_dense(self):
+        """The unit's ring path computes the same attention as its
+        single-program path (exactness of the online-softmax ring)."""
+        from veles_tpu.backends import Device
+        from veles_tpu.memory import Array
+        from veles_tpu.models.attention import MultiHeadAttention
+        dev = Device(backend="numpy")
+        rng = numpy.random.default_rng(4)
+        x = rng.normal(size=(2, 16, 8)).astype(numpy.float32)
+        u = MultiHeadAttention(None, heads=2, causal=True, name="attn")
+        u.input = Array(x)
+        u.initialize(device=dev)
+        params = {k: jnp.asarray(a.mem)
+                  for k, a in u.param_arrays().items()}
+        dense = u.apply(params, jnp.asarray(x))
+        u.sp_mesh_ = build_mesh({"dp": 2, "sp": 4})
+        ring = u.apply(params, jnp.asarray(x))
+        numpy.testing.assert_allclose(numpy.asarray(ring),
+                                      numpy.asarray(dense), atol=2e-2)
 
 
 class TestBlockwiseAttention:

@@ -4,21 +4,46 @@ absent Znicz submodule, manualrst_veles_algorithms.rst:115-140).
 
 This unit's ``apply`` is the single-program formulation (XLA/GSPMD
 shards it like any other op).  For long contexts where each chip must
-hold only 1/sp of K/V, use veles_tpu.ops.attention.ring_attention_
-sharded explicitly — the ring is a different communication schedule,
-not something sharding propagation derives from this op."""
+hold only 1/sp of K/V, the trainer hands the unit its mesh
+(``sp_mesh_``) and the attention core switches to the RING schedule
+under ``shard_map`` — sequence-sharded training end-to-end, gradients
+flowing through the ppermute ring (ops/attention.py); GSPMD cannot
+derive that communication schedule from the single-program form."""
+
+import functools
 
 import numpy
 
 from veles_tpu.models.nn_units import ForwardBase
 
 
-def mha_apply(params, x, heads, causal, block_size=None):
+def _ring_mha(mesh, q, k, v, causal):
+    """The sp-sharded attention core: q/k/v [batch, seq, heads, hd]
+    with seq over ``sp`` (and batch over dp/fsdp when present); K/V
+    rotate around the ring so each chip only ever holds seq/sp of
+    them."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from veles_tpu.ops.attention import ring_attention
+    batch_axes = tuple(a for a in ("dp", "fsdp")
+                       if mesh.shape.get(a, 1) > 1) or None
+    spec = P(batch_axes, "sp", None, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name="sp",
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def mha_apply(params, x, heads, causal, block_size=None, sp_mesh=None):
     """Multi-head attention forward over [batch, seq, d] — the ONE
     implementation shared by the MultiHeadAttention unit and
     TransformerBlock (params: wq/wk/wv/wo, each [d, d]).  Projections
     run in the compute dtype (bf16 trunk policy); the attention core
-    is ops.attention."""
+    is ops.attention — ring attention when ``sp_mesh`` carries an
+    ``sp`` axis of extent > 1, blockwise streaming when ``block_size``
+    is set, plain single-program attention otherwise."""
     import jax.numpy as jnp
 
     from veles_tpu import dtypes
@@ -34,7 +59,11 @@ def mha_apply(params, x, heads, causal, block_size=None):
                        precision=prec, preferred_element_type=ad)
         return y.astype(cd).reshape(b, s, heads, hd)
 
-    if block_size:
+    sp = sp_mesh.shape.get("sp", 1) if sp_mesh is not None else 0
+    if sp > 1:
+        o = _ring_mha(sp_mesh, proj(params["wq"]), proj(params["wk"]),
+                      proj(params["wv"]), causal)
+    elif block_size:
         from veles_tpu.ops.attention import blockwise_attention
         o = blockwise_attention(proj(params["wq"]), proj(params["wk"]),
                                 proj(params["wv"]), block_size,
@@ -89,4 +118,5 @@ class MultiHeadAttention(ForwardBase):
 
     def apply(self, params, x):
         return mha_apply(params, x, self.heads, self.causal,
-                         self.block_size)
+                         self.block_size,
+                         sp_mesh=getattr(self, "sp_mesh_", None))

@@ -138,6 +138,15 @@ class GradientDescent(AcceleratedUnit):
         if isinstance(self.evaluator, EvaluatorMSE) \
                 and getattr(self.loader, "minibatch_targets", None) is None:
             raise MissingDemand(self, {"loader.minibatch_targets"})
+        if self.mesh is not None \
+                and self.mesh.shape.get("sp", 1) > 1:
+            # sequence parallelism is a COMMUNICATION SCHEDULE, not a
+            # sharding GSPMD can derive: hand each forward the mesh so
+            # attention units switch to the ppermute ring
+            # (models/attention.mha_apply).  Volatile (trailing _) —
+            # re-established here on every snapshot resume.
+            for u in self.forwards:
+                u.sp_mesh_ = self.mesh
         solver = get_solver(self.solver_name)
         if not self.opt_state:  # fresh (not restored from snapshot)
             for i, u in enumerate(self.forwards):
@@ -187,6 +196,10 @@ class GradientDescent(AcceleratedUnit):
             elif isinstance(u, All2AllSoftmax) and i == len(
                     self.forwards) - 1:
                 h = u.logits(p, h)
+            elif getattr(u, "remat", False):
+                # recompute this unit in the backward pass instead of
+                # saving its internals (nn_units.ForwardBase.remat)
+                h = jax.checkpoint(u.apply)(p, h)
             else:
                 h = u.apply(p, h)
         return h
@@ -336,8 +349,13 @@ class GradientDescent(AcceleratedUnit):
                     if len(arr.shape) == 0:  # dev-born slots have no mem
                         opt_sh[i][name][s] = shlib.replicated(mesh)
         mb = self.loader.max_minibatch_size
+        x_shape = self.loader.minibatch_data.shape
+        # dim 1 of the DATA minibatch is the sequence dim for sp
+        # sharding (targets/labels stay sp-replicated: dim 1 there is
+        # a feature dim, not a sequence dim)
         x_sh = shlib.batch_sharding(
-            mesh, len(self.loader.minibatch_data.shape), dim0=mb)
+            mesh, len(x_shape), dim0=mb,
+            seq_dim1=x_shape[1] if len(x_shape) >= 2 else None)
         tgt_ndim = len(self.loader.minibatch_targets.shape) \
             if isinstance(self.evaluator, EvaluatorMSE) \
             else len(self.loader.minibatch_labels.shape)

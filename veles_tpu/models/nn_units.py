@@ -46,6 +46,12 @@ class ForwardBase(AcceleratedUnit):
         self.bias_filling = bias_filling
         self.bias_stddev = bias_stddev
         self.include_bias = include_bias
+        #: recompute this unit's forward during backward instead of
+        #: saving its internal activations (``jax.checkpoint``) — a
+        #: transformer block on long sequences would otherwise pin its
+        #: [seq, seq] attention tensors across the whole backward pass;
+        #: rematerializing trades those HBM bytes for extra MXU FLOPs
+        self.remat = bool(kwargs.get("remat", False))
         self.prng = prng_mod.get(prng_key)
         self.weights = Array()
         self.bias = Array()

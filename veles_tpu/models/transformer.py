@@ -110,7 +110,8 @@ class TransformerBlock(ForwardBase):
         from veles_tpu.models.attention import mha_apply
         return mha_apply(
             {k: params[k] for k in ("wq", "wk", "wv", "wo")}, x,
-            self.heads, self.causal, self.attn_block_size)
+            self.heads, self.causal, self.attn_block_size,
+            sp_mesh=getattr(self, "sp_mesh_", None))
 
     def _ffn(self, params, x):
         from veles_tpu import dtypes

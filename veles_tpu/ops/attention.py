@@ -171,15 +171,16 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
         kv_idx = jax.lax.ppermute(kv_idx, axis_name, perm)
         return (acc, kv, kv_idx), None
 
-    heads = q.shape[-2]
-    batchish = q.shape[:-3]
-    m0 = jnp.full(batchish + (heads, seq_q), -jnp.inf, jnp.float32)
-    s0 = jnp.zeros(batchish + (heads, seq_q), jnp.float32)
+    # derive the accumulators FROM q so they inherit q's varying-axes
+    # under shard_map (a dp x sp mesh makes the carry vary over BOTH
+    # axes; a fresh jnp.zeros would be axis-invariant and trip the
+    # scan carry vma check)
+    hs0 = jnp.swapaxes(q, -3, -2)[..., 0].astype(jnp.float32) * 0
+    m0 = hs0 - jnp.inf                     # [..., heads, seq_q]
+    s0 = hs0
     # the output inherits v's value dim (may differ from q/k's key dim)
-    o0 = jnp.zeros(q.shape[:-1] + (v.shape[-1],), jnp.float32)
-    # freshly-created carries are axis-invariant constants; the scan
-    # outputs vary over the ring axis — align the types up front
-    m0, s0, o0 = (_pvary(t, axis_name) for t in (m0, s0, o0))
+    o0 = q[..., :1].astype(jnp.float32) * jnp.zeros(
+        (v.shape[-1],), jnp.float32)
     (acc, _, _), _ = jax.lax.scan(
         body, ((m0, s0, o0), (k, v), my_idx), None, length=n)
     m, s, o = acc

@@ -65,10 +65,11 @@ def gpipe_apply(stage_fn, stacked_params, microbatches, axis_name):
     steps = m + n - 1
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    # replicated-input zeros become stage-varying through the loop —
-    # align the carry types for the new shard_map varying-axis checks
-    h0 = _pvary(jnp.zeros_like(microbatches[0]), axis_name)
-    outputs0 = _pvary(jnp.zeros_like(microbatches), axis_name)
+    # carries derive FROM the input so they inherit its varying axes
+    # (batch may be dp-sharded on a pp×dp mesh), then get marked
+    # varying over the stage axis the loop rotates them around
+    h0 = _pvary(microbatches[0] * 0, axis_name)
+    outputs0 = _pvary(microbatches * 0, axis_name)
     microbatches = _pvary(microbatches, axis_name)
 
     def body(carry, t):
@@ -95,9 +96,15 @@ def gpipe_apply(stage_fn, stacked_params, microbatches, axis_name):
 
 
 def pipeline_forward(mesh, stage_fn, per_stage_params, x, n_micro,
-                     axis="pp"):
+                     axis="pp", batch_axes=None):
     """Convenience wrapper: stack params, microbatch x [batch, ...],
-    run the GPipe loop, return [batch, ...] outputs (replicated)."""
+    run the GPipe loop, return [batch, ...] outputs (replicated over
+    ``pp``).
+
+    ``batch_axes`` composes the pipeline with data parallelism: each
+    microbatch's sample dim shards over those mesh axes (e.g.
+    ``("dp",)`` on a pp×dp mesh — every dp slice runs its own bubble
+    schedule on its batch shard, stages still hop over ``pp``)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     from jax import shard_map
 
@@ -114,9 +121,10 @@ def pipeline_forward(mesh, stage_fn, per_stage_params, x, n_micro,
     stacked = jax.device_put(
         stacked, NamedSharding(mesh, P(axis)))
     stage_spec = jax.tree.map(lambda _: P(axis), stacked)
+    mb_spec = P(None, tuple(batch_axes)) if batch_axes else P()
 
     fn = shard_map(
         functools.partial(gpipe_apply, stage_fn, axis_name=axis),
-        mesh=mesh, in_specs=(stage_spec, P()), out_specs=P())
+        mesh=mesh, in_specs=(stage_spec, mb_spec), out_specs=mb_spec)
     out = fn(stacked, micro)
     return out.reshape((x.shape[0],) + out.shape[2:])

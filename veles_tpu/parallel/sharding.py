@@ -54,13 +54,26 @@ def _axis_size(mesh, name):
     return mesh.shape[name] if name in mesh.axis_names else 1
 
 
-def batch_spec(mesh, ndim, dim0=None):
+def batch_spec(mesh, ndim, dim0=None, seq_dim1=None):
     """Batch-axis spec.  When ``dim0`` (the static batch size) is given,
     raises a clear error if it doesn't divide over the data axes instead
-    of letting device_put fail mid-training."""
+    of letting device_put fail mid-training.
+
+    ``seq_dim1`` marks dim 1 as a SEQUENCE dim of that length: on a
+    mesh with an ``sp`` axis it shards over sp (the ring-attention
+    layout).  Only the caller knows dim 1's meaning — a [batch, seq]
+    token minibatch sp-shards, an MSE target's feature dim must not —
+    so sp sharding is strictly opt-in via this parameter."""
     axes = [a for a in ("dp", "fsdp")
             if _axis_size(mesh, a) > 1]
-    if not axes:
+    sp = _axis_size(mesh, "sp")
+    shard_seq = sp > 1 and ndim >= 2 and seq_dim1 is not None
+    if shard_seq and seq_dim1 % sp:
+        raise ValueError(
+            "sequence length %d is not divisible by the sp extent %d — "
+            "pick a sequence length that is a multiple of it"
+            % (seq_dim1, sp))
+    if not axes and not shard_seq:
         return P(*([None] * ndim))
     total = 1
     for a in axes:
@@ -70,7 +83,10 @@ def batch_spec(mesh, ndim, dim0=None):
             "minibatch size %d is not divisible by the data-parallel "
             "extent %d (mesh axes %s) — pick a minibatch_size that is a "
             "multiple of it" % (dim0, total, axes))
-    return P(tuple(axes), *([None] * (ndim - 1)))
+    spec = [tuple(axes) if axes else None] + [None] * (ndim - 1)
+    if shard_seq:
+        spec[1] = "sp"
+    return P(*spec)
 
 
 def param_spec(mesh, name, shape):
@@ -108,5 +124,5 @@ def replicated(mesh):
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh, ndim, dim0=None):
-    return NamedSharding(mesh, batch_spec(mesh, ndim, dim0))
+def batch_sharding(mesh, ndim, dim0=None, seq_dim1=None):
+    return NamedSharding(mesh, batch_spec(mesh, ndim, dim0, seq_dim1))

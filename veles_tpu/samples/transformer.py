@@ -55,6 +55,17 @@ class TransformerWorkflow(StandardWorkflow):
 
     def __init__(self, workflow, **kwargs):
         cfg = root.transformer_tpu
+        # {'dp': 2, 'sp': 4}-style axis dict -> device mesh: dp splits
+        # the batch, sp sequence-shards attention through the ring
+        # (parallel/mesh.py axis conventions)
+        from veles_tpu.config import Config
+        mesh = None
+        raw = vars(cfg).get("mesh")  # dict overrides become subtrees;
+        if isinstance(raw, Config):  # plain values (incl. None) don't
+            raw = raw.__content__()
+        if raw:
+            from veles_tpu.parallel import build_mesh
+            mesh = build_mesh(dict(raw))
         vocab = int(cfg.get("vocab", 16))
         dim = int(cfg.get("dim", 64))
         blocks = int(cfg.get("blocks", 2))
@@ -73,6 +84,7 @@ class TransformerWorkflow(StandardWorkflow):
                  for _ in range(blocks)]
         spec += [{"type": "mean_pool_seq"},
                  {"type": "softmax", "output_sample_shape": (vocab,)}]
+        kwargs.setdefault("mesh", mesh)  # explicit caller mesh wins
         super(TransformerWorkflow, self).__init__(
             workflow, name="Transformer",
             loader_factory=InductionLoader,
