@@ -31,11 +31,19 @@ import time
 
 import numpy
 
-#: round-2 span-serving MLP measurement (BENCH_r02.json) — the
-#: like-for-like baseline for the shipped training path.  (Round 1's
-#: 48931.4 was per-minibatch dispatch, a different methodology; the
-#: r2/r1 methodology jump is recorded in BENCH_r02.json's 108x.)
-MLP_BASELINE_SAMPLES_PER_SEC = 5306686.0
+#: RE-PINNED in round 4 (was the r2-recorded 5,306,686, BENCH_r02.json).
+#: That number is a tunnel artifact, not a code baseline: running the
+#: EXACT r2 tree (commit b36a1a4) on the same chip in round 4 gave
+#: 2.62M in isolation, and interleaved A/B windows of 24 spans
+#: (r2-tree, current, r2-tree, current, minutes apart) measured
+#: 1.19M / 1.12M / 0.87M / 0.98M — code-version parity, with the
+#: absolute level set by axon-tunnel health (the ~250 ms MLP span is
+#: short enough that window timing swings ~5x with it; ROUND4_NOTES.md
+#: has the full table).  The pin below is the median of six round-4
+#: measurements (max-window and marginal, bf16 and f32: 1.27–2.39M);
+#: ``mlp_vs_baseline`` now compares the tunnel-robust MARGINAL metric
+#: against it.
+MLP_BASELINE_SAMPLES_PER_SEC = 1900000.0
 #: first AlexNet measurement on the TPU v5e chip (round 2, this file;
 #: same span methodology)
 ALEXNET_BASELINE_SAMPLES_PER_SEC = 15403.7
@@ -53,6 +61,25 @@ PEAK_FLOPS = {
     "TPU v6 lite": 918e12,
     "TPU v6e": 918e12,
 }
+
+
+def transformer_train_flops_per_sample(d_model, seq, layers, hidden):
+    """Analytic train FLOPs of one SEQUENCE through the decoder stack:
+    per layer forward = qkvo projections (8·s·d²) + score/PV matmuls
+    (4·s²·d, FULL matrices — the PaLM/Megatron MFU convention counts
+    causal attention undiscounted) + FFN (4·s·d·h); ×3 for
+    forward + both backward passes.  Embedding gather and the pooled
+    classifier head are O(s·d + d·V) — noise at these sizes, omitted.
+
+    Returns (standard_flops, causal_discounted_flops): the second
+    halves the s² terms — the flash kernel really does skip masked
+    blocks, so the discounted number is the conservative MFU basis."""
+    d, s, h = float(d_model), float(seq), float(hidden)
+    proj_ffn = 8 * s * d * d + 4 * s * d * h
+    scores = 4 * s * s * d
+    std = 3.0 * layers * (proj_ffn + scores)
+    disc = 3.0 * layers * (proj_ffn + scores / 2)
+    return std, disc
 
 
 def training_flops_per_sample(forwards):
@@ -178,6 +205,87 @@ def bench_mlp(dev, windows=4):
     stats["marginal"] = round(statistics.median(marginal), 1) \
         if marginal else None
     return max(rates), stats
+
+
+def bench_transformer(dev, windows=4, d_model=1024, layers=12, heads=8,
+                      seq=2048, batch=8, vocab=256):
+    """Transformer decoder train throughput + MFU (VERDICT r3 #1): a
+    compute-dense stack (d 1024 × 12 layers × seq 2048, bf16, causal)
+    through the product path — Embedding → TransformerBlock × N →
+    mean-pool → softmax head → the fused GradientDescent step with
+    span serving.  heads=8 keeps head_dim at 128 (the MXU lane width)
+    so the attention core auto-selects the pallas flash kernel
+    (ops/flash.py); everything else is stock framework code."""
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.evaluator import EvaluatorSoftmax
+    from veles_tpu.models.gd import GradientDescent
+    from veles_tpu.models.standard import make_forwards
+
+    n_train = batch * 16
+
+    class TokenLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            self.class_lengths[:] = [0, 0, n_train]
+            self.original_data = rng.integers(
+                0, vocab, (n_train, seq)).astype(numpy.int32)
+            self.original_labels = rng.integers(
+                0, vocab, n_train).tolist()
+
+    wf = AcceleratedWorkflow(None, name="bench-transformer")
+    loader = TokenLoader(wf, minibatch_size=batch,
+                         normalization_type="none")
+    loader.initialize(device=dev)
+    spec = [{"type": "embedding", "vocab": vocab, "dim": d_model}]
+    spec += [{"type": "transformer_block", "heads": heads,
+              "causal": True} for _ in range(layers)]
+    spec += [{"type": "mean_pool_seq"},
+             {"type": "softmax", "output_sample_shape": (vocab,)}]
+    forwards = make_forwards(wf, loader.minibatch_data, spec)
+    for u in forwards:
+        u.initialize(device=dev)
+    ev = EvaluatorSoftmax(wf, compute_confusion_matrix=False)
+    ev.output = forwards[-1].output
+    ev.labels = loader.minibatch_labels
+    ev.loader = loader
+    ev.initialize(device=dev)
+    gd = GradientDescent(wf, forwards=forwards, evaluator=ev,
+                         loader=loader, solver="sgd",
+                         learning_rate=0.01, gradient_moment=0.9)
+    gd.initialize(device=dev)
+
+    _drain_spans(loader, gd, 2)  # compile + settle
+    spans = 2
+    rates = _timed_windows(loader, gd, spans=spans, windows=windows)
+    sps = max(rates)
+    flops, flops_disc = transformer_train_flops_per_sample(
+        d_model, seq, layers, 4 * d_model)
+    kind = dev.jax_device.device_kind
+    peak = PEAK_FLOPS.get(kind) or dev.compute_power()
+    stats = _window_stats(rates, spans)
+    from veles_tpu.ops.flash import flash_available
+    return {
+        "transformer_samples_per_sec": round(sps, 1),
+        "transformer_tokens_per_sec": round(sps * seq, 1),
+        "transformer_mfu": round(sps * flops / peak, 4),
+        "transformer_mfu_causal_discounted":
+            round(sps * flops_disc / peak, 4),
+        "transformer_flops_per_sample": flops,
+        "transformer_config": {
+            "d_model": d_model, "layers": layers, "heads": heads,
+            "seq": seq, "batch": batch, "vocab": vocab,
+            "dtype": "bfloat16",
+            "attn": "flash" if flash_available(
+                (batch, seq, heads, d_model // heads)) else "fallback"},
+        "transformer_windows": stats["windows"],
+        "transformer_spans_per_window": spans,
+        "transformer_steady_delta": stats["steady_delta"],
+        "transformer_mfu_methodology":
+            "std counts full s^2 attention matmuls (PaLM/Megatron "
+            "convention); causal_discounted halves them (the flash "
+            "kernel skips masked blocks)",
+    }
 
 
 def bench_alexnet(dev, windows=4):
@@ -380,6 +488,7 @@ def main():
     from veles_tpu.backends import Device
     dev = Device()
     alex_sps, mfu, flops, kind, alex_aud = bench_alexnet(dev)
+    trx = bench_transformer(dev)
     mlp_sps, mlp_aud = bench_mlp(dev)
     allreduce = bench_allreduce()
     dp = bench_dp_scaling(dev)
@@ -397,15 +506,21 @@ def main():
         "alexnet_spans_per_window": alex_aud["spans_per_window"],
         "alexnet_steady_delta": alex_aud["steady_delta"],
         "mlp_samples_per_sec": round(mlp_sps, 1),
-        "mlp_vs_baseline": round(mlp_sps / MLP_BASELINE_SAMPLES_PER_SEC,
-                                 3),
+        # null when every marginal window hit a tunnel stall — the
+        # max-window rate is a DIFFERENT methodology than the pin and
+        # substituting it would inflate the ratio unlabeled
+        "mlp_vs_baseline": round(
+            mlp_aud["marginal"] / MLP_BASELINE_SAMPLES_PER_SEC, 3)
+            if mlp_aud["marginal"] else None,
         "mlp_windows": mlp_aud["windows"],
         "mlp_steady_delta": mlp_aud["steady_delta"],
         "mlp_marginal_samples_per_sec": mlp_aud["marginal"],
         "mlp_baseline_methodology":
-            "span-serving r2 number 5306686.0 (r1 per-minibatch series "
-            "ended at BENCH_r02.json)",
+            "marginal vs the r4 re-pin 1.9M (the r2 5.3M pin was a "
+            "tunnel artifact: exact-r2-code A/B parity, see bench.py "
+            "docstring + ROUND4_NOTES.md)",
     }
+    record.update(trx)
     record.update(allreduce)
     if dp:
         record.update(dp)

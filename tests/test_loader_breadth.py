@@ -125,7 +125,11 @@ def test_sound_loader_separates_genres(wav_tree):
     assert loader.class_lengths == [0, 0, 6]
     assert loader.labels_mapping == {"hightone": 0, "lowtone": 1}
     d = loader.original_data
-    l = numpy.asarray(loader.original_labels)
+    # the NUMERIC label path (what the evaluator actually sees):
+    # original_labels stays raw, _post_load maps it — a pre-mapped list
+    # would double-map to the -1 sentinel (the r4 GTZAN 100%-err bug)
+    l = numpy.asarray(loader._numeric_labels)
+    assert set(l.tolist()) == {0, 1}, l
     # the two tones produce separable feature vectors
     c0 = d[l == 0].mean(axis=0)
     c1 = d[l == 1].mean(axis=0)

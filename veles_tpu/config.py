@@ -71,6 +71,16 @@ class Config:
         v = vars(self).get(name, default)
         return default if isinstance(v, Config) else v
 
+    def get_dict(self, name, default=None):
+        """Read a dict-valued key without autovivifying.  ``update``
+        stores nested dicts AS subtrees, so plain ``get`` can't see
+        them; this returns the subtree's content, a plain dict value,
+        or ``default`` (for unset/None/empty)."""
+        v = vars(self).get(name)
+        if isinstance(v, Config):
+            v = v.__content__()
+        return dict(v) if v else default
+
     def __contains__(self, name):
         v = vars(self).get(name)
         return v is not None and not isinstance(v, Config)

@@ -95,10 +95,10 @@ class HDFSTextLoader(FullBatchLoader):
                              (self, self.class_paths))
         self.original_data = numpy.asarray(rows, numpy.float32)
         if any(l is not None for l in labels):
+            # original_labels stays RAW — fullbatch._post_load applies
+            # labels_mapping (pre-mapping would double-map to -1)
             self.original_labels = labels
             if not all(isinstance(l, (int, numpy.integer))
                        for l in labels):
-                mapping = {l: i for i, l in
-                           enumerate(sorted(set(labels)))}
-                self.labels_mapping = mapping
-                self.original_labels = [mapping[l] for l in labels]
+                self.labels_mapping = {
+                    l: i for i, l in enumerate(sorted(set(labels)))}

@@ -364,10 +364,11 @@ class FullBatchImageLoader(FullBatchLoader):
         self.original_data = numpy.stack(samples)
         labels = sum(labels_per_class, [])
         if any(l is not None for l in labels):
+            # original_labels stays RAW — fullbatch._post_load applies
+            # labels_mapping (pre-mapping would double-map to -1)
             if not all(isinstance(l, (int, numpy.integer)) for l in labels):
-                mapping = {l: i for i, l in enumerate(sorted(set(labels)))}
-                self.labels_mapping = mapping
-                labels = [mapping[l] for l in labels]
+                self.labels_mapping = {
+                    l: i for i, l in enumerate(sorted(set(labels)))}
             self.original_labels = list(labels)
 
 

@@ -158,4 +158,7 @@ class SoundLoader(FullBatchLoader):
         self.original_data = data
         mapping = {l: i for i, l in enumerate(sorted(set(labels)))}
         self.labels_mapping = mapping
-        self.original_labels = [mapping[l] for l in labels]
+        # original_labels carries the RAW directory names — fullbatch's
+        # _post_load maps them through labels_mapping (pre-mapping here
+        # would double-map every label to the -1 sentinel)
+        self.original_labels = list(labels)

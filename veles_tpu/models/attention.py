@@ -36,14 +36,21 @@ def _ring_mha(mesh, q, k, v, causal):
     return fn(q, k, v)
 
 
-def mha_apply(params, x, heads, causal, block_size=None, sp_mesh=None):
+def mha_apply(params, x, heads, causal, block_size=None, sp_mesh=None,
+              attn_impl=None, backend=None):
     """Multi-head attention forward over [batch, seq, d] — the ONE
     implementation shared by the MultiHeadAttention unit and
     TransformerBlock (params: wq/wk/wv/wo, each [d, d]).  Projections
     run in the compute dtype (bf16 trunk policy); the attention core
-    is ops.attention — ring attention when ``sp_mesh`` carries an
-    ``sp`` axis of extent > 1, blockwise streaming when ``block_size``
-    is set, plain single-program attention otherwise."""
+    is selected in priority order:
+
+    - ``sp_mesh`` with an sp axis > 1 → the ppermute RING (sequence
+      parallelism is a communication schedule, it overrides the rest);
+    - ``attn_impl`` "flash" | "blockwise" | "dense" → that core;
+    - default (None/"auto") → the pallas flash kernel when it applies
+      (TPU, block-aligned seq, lane-multiple head_dim — ops/flash.py),
+      else blockwise streaming if ``block_size`` says so, else the
+      plain single-program form."""
     import jax.numpy as jnp
 
     from veles_tpu import dtypes
@@ -63,14 +70,26 @@ def mha_apply(params, x, heads, causal, block_size=None, sp_mesh=None):
     if sp > 1:
         o = _ring_mha(sp_mesh, proj(params["wq"]), proj(params["wk"]),
                       proj(params["wv"]), causal)
-    elif block_size:
-        from veles_tpu.ops.attention import blockwise_attention
-        o = blockwise_attention(proj(params["wq"]), proj(params["wk"]),
-                                proj(params["wv"]), block_size,
-                                causal=causal)
     else:
-        o = attention(proj(params["wq"]), proj(params["wk"]),
-                      proj(params["wv"]), causal=causal)
+        impl = attn_impl or "auto"
+        if impl == "auto":
+            from veles_tpu.ops.flash import flash_available
+            if flash_available((b, s, heads, hd), backend=backend):
+                impl = "flash"
+            else:
+                impl = "blockwise" if block_size else "dense"
+        q, k, v = (proj(params[n]) for n in ("wq", "wk", "wv"))
+        if impl == "flash":
+            from veles_tpu.ops.flash import flash_attention
+            o = flash_attention(q, k, v, causal=causal)
+        elif impl == "blockwise":
+            from veles_tpu.ops.attention import blockwise_attention
+            o = blockwise_attention(q, k, v, block_size or 512,
+                                    causal=causal)
+        elif impl == "dense":
+            o = attention(q, k, v, causal=causal)
+        else:
+            raise ValueError("unknown attn_impl %r" % (attn_impl,))
     return jnp.einsum("bsd,de->bse", o.reshape(b, s, d).astype(cd),
                       params["wo"].astype(cd),
                       precision=prec,
@@ -85,7 +104,7 @@ class MultiHeadAttention(ForwardBase):
     PARAMS = ("wq", "wk", "wv", "wo")
 
     def __init__(self, workflow, heads=4, causal=False,
-                 block_size=None, **kwargs):
+                 block_size=None, attn_impl=None, **kwargs):
         from veles_tpu.memory import Array
         super(MultiHeadAttention, self).__init__(workflow, **kwargs)
         self.heads = int(heads)
@@ -93,6 +112,9 @@ class MultiHeadAttention(ForwardBase):
         #: stream K/V in blocks of this many tokens (long sequences:
         #: avoids the [seq, seq] score matrix; ops/attention.py)
         self.block_size = block_size
+        #: attention core override: "flash" | "blockwise" | "dense"
+        #: (None = auto; see mha_apply)
+        self.attn_impl = attn_impl
         for p in self.PARAMS:
             setattr(self, p, Array())
 
@@ -114,9 +136,14 @@ class MultiHeadAttention(ForwardBase):
         cfg = {"heads": self.heads, "causal": self.causal}
         if self.block_size:  # v2 key — omit when unused so plain
             cfg["block_size"] = int(self.block_size)  # packages stay v1
+        if self.attn_impl:  # an explicit core pin must survive export
+            cfg["attn_impl"] = self.attn_impl
         return cfg
 
     def apply(self, params, x):
+        dev = getattr(self, "device", None)
         return mha_apply(params, x, self.heads, self.causal,
                          self.block_size,
-                         sp_mesh=getattr(self, "sp_mesh_", None))
+                         sp_mesh=getattr(self, "sp_mesh_", None),
+                         attn_impl=getattr(self, "attn_impl", None),
+                         backend=dev.jax_device.platform if dev else None)

@@ -48,7 +48,7 @@ class GradientDescent(AcceleratedUnit):
                  weights_decay=0.0, weights_decay_bias=None, l1_vs_l2=0.0,
                  gradient_moment=0.0, gradient_moment_bias=None,
                  lr_schedule="constant", lr_schedule_params=None,
-                 prng_key="trainer", mesh=None, **kwargs):
+                 prng_key="trainer", mesh=None, augment=None, **kwargs):
         super(GradientDescent, self).__init__(workflow, **kwargs)
         #: jax.sharding.Mesh — when set, the fused step is sharded over
         #: it (dp batch split + psum, tp weight split; see
@@ -71,6 +71,11 @@ class GradientDescent(AcceleratedUnit):
             if gradient_moment_bias is not None else gradient_moment
         self.lr_schedule = lr_schedule
         self.lr_schedule_params = lr_schedule_params or {}
+        #: in-graph train-time augmentation traced into the fused step
+        #: (ops/augment.py); eval sees clean data.  A dict spec like
+        #: {"kind": "image", "pad": 4} survives snapshots (a raw
+        #: callable works too but won't pickle)
+        self.augment = augment
         self.prng = prng_mod.get(prng_key)
         self.lr_multiplier = 1.0  # Rollback adjusts this
 
@@ -219,7 +224,18 @@ class GradientDescent(AcceleratedUnit):
                for i, u in enumerate(self.forwards)}
         is_mse = isinstance(self.evaluator, EvaluatorMSE)
 
+        augment_fn = None
+        if self.augment is not None:
+            if callable(self.augment):
+                augment_fn = self.augment
+            else:
+                from veles_tpu.ops.augment import make_augment
+                augment_fn = make_augment(**dict(self.augment))
+
         def loss_and_metrics(params, x, target, size, key, train):
+            if train and augment_fn is not None:
+                key, sub = jax.random.split(key)
+                x = augment_fn(x, sub)
             y = self._forward(params, x, key, train)
             loss = self.evaluator.loss(y, target, size)
             if is_mse:

@@ -49,8 +49,28 @@ class InvLR:
         return (1.0 + self.gamma * step) ** (-self.power)
 
 
+class CosineLR:
+    """Half-cosine decay from 1 to ``floor`` over ``total_steps``, with
+    an optional linear warmup (the standard modern training recipe;
+    no caffe analogue — the reference predates it)."""
+
+    def __init__(self, total_steps=100000, floor=0.0, warmup=0,
+                 **kwargs):
+        self.total_steps = total_steps
+        self.floor = floor
+        self.warmup = warmup
+
+    def __call__(self, step):
+        frac = jnp.clip(step / self.total_steps, 0.0, 1.0)
+        mult = self.floor + (1.0 - self.floor) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * frac))
+        if self.warmup:
+            mult = mult * jnp.clip(step / self.warmup, 0.0, 1.0)
+        return mult
+
+
 SCHEDULES = {"constant": ConstantLR, "step": StepLR, "exp": ExpLR,
-             "inv": InvLR}
+             "inv": InvLR, "cosine": CosineLR}
 
 
 def get_schedule(name, **kwargs):

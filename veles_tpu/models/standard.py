@@ -137,6 +137,16 @@ class StandardWorkflow(AcceleratedWorkflow):
         from veles_tpu.plumbing import Repeater
         from veles_tpu.snapshotter import Snapshotter
 
+        if mesh is None:
+            # every config-driven sample honours the generic mesh knob:
+            # -c "root.common.mesh = {'dp': -1}" shards ANY standard
+            # workflow without sample-specific plumbing
+            from veles_tpu.config import root
+            raw = root.common.get_dict("mesh")
+            if raw:
+                from veles_tpu.parallel import build_mesh
+                mesh = build_mesh(raw)
+
         super(StandardWorkflow, self).__init__(workflow, name=name)
         self.repeater = Repeater(self)
         self.repeater.link_from(self.start_point)

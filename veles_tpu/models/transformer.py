@@ -39,7 +39,8 @@ class TransformerBlock(ForwardBase):
                    "ln2_scale", "ln2_bias")
 
     def __init__(self, workflow, heads=4, hidden=None, causal=True,
-                 n_experts=0, top_k=2, attn_block_size=None, **kwargs):
+                 n_experts=0, top_k=2, attn_block_size=None,
+                 attn_impl=None, **kwargs):
         super(TransformerBlock, self).__init__(workflow,
                                                include_bias=True,
                                                **kwargs)
@@ -48,6 +49,9 @@ class TransformerBlock(ForwardBase):
         self.causal = bool(causal)
         #: stream K/V blockwise for long sequences (ops/attention.py)
         self.attn_block_size = attn_block_size
+        #: attention core override: "flash" | "blockwise" | "dense"
+        #: (None = auto; models/attention.mha_apply)
+        self.attn_impl = attn_impl
         self.n_experts = int(n_experts)
         self.top_k = int(top_k)
         if self.n_experts and self.top_k > self.n_experts:
@@ -108,10 +112,13 @@ class TransformerBlock(ForwardBase):
 
     def _mha(self, params, x):
         from veles_tpu.models.attention import mha_apply
+        dev = getattr(self, "device", None)
         return mha_apply(
             {k: params[k] for k in ("wq", "wk", "wv", "wo")}, x,
             self.heads, self.causal, self.attn_block_size,
-            sp_mesh=getattr(self, "sp_mesh_", None))
+            sp_mesh=getattr(self, "sp_mesh_", None),
+            attn_impl=getattr(self, "attn_impl", None),
+            backend=dev.jax_device.platform if dev else None)
 
     def _ffn(self, params, x):
         from veles_tpu import dtypes
@@ -140,6 +147,8 @@ class TransformerBlock(ForwardBase):
                "top_k": self.top_k}
         if self.attn_block_size:  # v2 key — omit when unused
             cfg["attn_block_size"] = int(self.attn_block_size)
+        if self.attn_impl:  # an explicit core pin must survive export
+            cfg["attn_impl"] = self.attn_impl
         return cfg
 
 

@@ -1,0 +1,56 @@
+"""In-graph data augmentation — random transforms traced INTO the
+fused train step (TPU-first: the reference augmented per-minibatch on
+the host with PIL, veles/loader/image.py — that would stall the span
+pipeline here, so augmentation runs on device, keyed by the trainer's
+per-minibatch prng, costing microseconds instead of a host hop).
+
+The trainer applies the configured augment only on TRAIN minibatches
+(models/gd.py); evaluation always sees clean data.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def image_augment(flip=True, pad=0, cutout=0):
+    """The classic small-image recipe: random horizontal flip +
+    random crop after reflect-padding ``pad`` pixels + optional
+    ``cutout``-sized random erase.  Returns ``fn(x, key)`` for
+    [batch, h, w, c] inputs."""
+
+    def fn(x, key):
+        b, h, w, c = x.shape
+        kf, kc, ku = jax.random.split(key, 3)
+        if flip:
+            do = jax.random.bernoulli(kf, 0.5, (b,))
+            x = jnp.where(do[:, None, None, None], x[:, :, ::-1, :], x)
+        if pad:
+            xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                         mode="reflect")
+            off = jax.random.randint(kc, (b, 2), 0, 2 * pad + 1)
+
+            def crop(img, o):
+                return jax.lax.dynamic_slice(
+                    img, (o[0], o[1], 0), (h, w, c))
+
+            x = jax.vmap(crop)(xp, off)
+        if cutout:
+            cy = jax.random.randint(ku, (b,), 0, h)
+            cx = jax.random.randint(jax.random.fold_in(ku, 1),
+                                    (b,), 0, w)
+            yy = jnp.arange(h)[None, :, None]
+            xx = jnp.arange(w)[None, None, :]
+            half = cutout // 2
+            mask = ((jnp.abs(yy - cy[:, None, None]) <= half)
+                    & (jnp.abs(xx - cx[:, None, None]) <= half))
+            x = jnp.where(mask[..., None], 0.0, x)
+        return x
+
+    return fn
+
+
+def make_augment(kind, **kwargs):
+    """Config-friendly factory: ``kind`` names the recipe."""
+    if kind in ("image", "flip_crop"):
+        return image_augment(**kwargs)
+    raise ValueError("unknown augment kind %r" % (kind,))

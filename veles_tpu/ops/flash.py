@@ -1,0 +1,70 @@
+"""Flash attention — the pallas TPU kernel path for the attention hot
+op (SURVEY.md §5 "Long-context"; the reference's hottest ops were
+hand-written CUDA/OpenCL kernels, e.g. ocl/forward.cl — on TPU the
+equivalent discipline is a pallas kernel that keeps the score blocks
+in VMEM instead of round-tripping the [seq, seq] matrix through HBM).
+
+The kernel itself is ``jax.experimental.pallas.ops.tpu.flash_attention``
+(a pallas_call program with custom fwd/dq/dkv kernels, shipped with
+JAX the way cuDNN ships with CUDA); this module owns the framework's
+integration: the [batch, seq, heads, head_dim] layout adaptation, the
+block-size tuning that measured 2.6x over the kernel's defaults on
+TPU v5e (512-token blocks; see ROUND4_NOTES.md), the applicability
+check, and the numerically-equivalent streaming fallback
+(ops.attention.blockwise_attention) for CPU meshes and odd shapes so
+tests and virtual-device dryruns run the same model code."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+#: the kernel wants block-aligned tiles; 512 measured fastest for
+#: seq 1024-4096 at head_dim 128 on TPU v5e (ROUND4_NOTES.md)
+_BLOCK = 512
+
+
+def flash_available(q_shape, backend=None):
+    """True when the pallas TPU kernel applies: TPU backend, seq a
+    multiple of the block, head_dim a lane multiple.
+
+    ``backend`` should be the platform of the device the computation
+    actually targets (callers inside a unit pass
+    ``unit.device.jax_device.platform``) — the process default backend
+    is only a last resort, since a CPU-compiled program on a TPU host
+    must NOT trace the TPU kernel."""
+    if backend is None:
+        backend = jax.default_backend()
+    if backend not in ("tpu", "axon"):
+        return False
+    seq, hd = q_shape[-3], q_shape[-1]
+    return seq % _BLOCK == 0 and hd % 128 == 0
+
+
+@functools.lru_cache(maxsize=None)
+def _block_sizes(seq):
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+    b = min(_BLOCK, seq)
+    return fa.BlockSizes(
+        block_q=b, block_k_major=b, block_k=b, block_b=1,
+        block_q_major_dkv=b, block_k_major_dkv=b, block_k_dkv=b,
+        block_q_dkv=b,
+        block_k_major_dq=b, block_k_dq=b, block_q_dq=b)
+
+
+def flash_attention(q, k, v, causal=False, scale=None):
+    """Exact attention via the pallas TPU kernel.  q/k/v:
+    [batch, seq, heads, head_dim] (the framework layout — seq-major so
+    sp sharding stays a leading-dim spec); falls back to the streaming
+    blockwise op when the kernel doesn't apply."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if not flash_available(q.shape):
+        from veles_tpu.ops.attention import blockwise_attention
+        return blockwise_attention(q, k, v, block_size=_BLOCK,
+                                   causal=causal, scale=scale)
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+    qt, kt, vt = (jnp.swapaxes(t, -3, -2) for t in (q, k, v))
+    o = fa.flash_attention(qt, kt, vt, causal=causal, sm_scale=scale,
+                           block_sizes=_block_sizes(q.shape[-3]))
+    return jnp.swapaxes(o, -3, -2)

@@ -105,6 +105,11 @@ class CifarWorkflow(StandardWorkflow):
             {"type": fc_t, "output_sample_shape": (64,)},
             {"type": "softmax", "output_sample_shape": (10,)},
         ]
+        # in-graph augmentation spec (ops/augment.py), e.g.
+        # root.cifar_tpu.augment = {'kind': 'image', 'pad': 4} — the
+        # trainer traces it into the fused step on train minibatches
+        augment = cfg.get_dict("augment")
+        lr_sched = cfg.get_dict("lr_schedule_params")
         super(CifarWorkflow, self).__init__(
             workflow, name="CIFAR-10",
             loader_factory=CifarLoader,
@@ -120,6 +125,9 @@ class CifarWorkflow(StandardWorkflow):
             learning_rate=float(cfg.get("learning_rate", 0.002)),
             gradient_moment=float(cfg.get("gradient_moment", 0.9)),
             weights_decay=float(cfg.get("weights_decay", 0.0005)),
+            augment=augment,
+            lr_schedule=cfg.get("lr_schedule", "constant"),
+            lr_schedule_params=lr_sched or {},
             decision_config={
                 "fail_iterations": int(cfg.get("fail_iterations", 20)),
                 "max_epochs": cfg.get("max_epochs"),

@@ -58,14 +58,11 @@ class TransformerWorkflow(StandardWorkflow):
         # {'dp': 2, 'sp': 4}-style axis dict -> device mesh: dp splits
         # the batch, sp sequence-shards attention through the ring
         # (parallel/mesh.py axis conventions)
-        from veles_tpu.config import Config
         mesh = None
-        raw = vars(cfg).get("mesh")  # dict overrides become subtrees;
-        if isinstance(raw, Config):  # plain values (incl. None) don't
-            raw = raw.__content__()
+        raw = cfg.get_dict("mesh")
         if raw:
             from veles_tpu.parallel import build_mesh
-            mesh = build_mesh(dict(raw))
+            mesh = build_mesh(raw)
         vocab = int(cfg.get("vocab", 16))
         dim = int(cfg.get("dim", 64))
         blocks = int(cfg.get("blocks", 2))

@@ -254,7 +254,13 @@ class FeatureExtractor:
         n = len(onset)
         if n < 4:
             return numpy.zeros((2, 2), numpy.float32)
-        ac = numpy.correlate(onset, onset, "full")[n - 1:]
+        # FFT autocorrelation: the direct numpy.correlate is O(n^2)
+        # and took 12s of a 15s GTZAN-track extraction; Wiener-
+        # Khinchin via rfft is O(n log n) (the reference's C++
+        # extractor used FFT convolution here too)
+        m = 1 << int(2 * n - 1).bit_length()
+        spec = numpy.fft.rfft(onset, m)
+        ac = numpy.fft.irfft(spec * numpy.conj(spec), m)[:n]
         ac = ac / max(ac[0], 1e-12)
         return numpy.stack([numpy.arange(len(ac), dtype=numpy.float32),
                             ac.astype(numpy.float32)])
