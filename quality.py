@@ -73,16 +73,27 @@ RUNS = {
     "stl10_conv": {
         "workflow": "veles_tpu/samples/cifar.py",
         "config": "veles_tpu/samples/cifar_config.py",
+        # the r4 low-data recipe (VERDICT r3 #6): in-graph flip/crop/
+        # cutout augmentation + cosine LR + longer patience — measured
+        # 23.4% in the round-4 tuning run (ROUND4_NOTES.md §5), well
+        # inside (and past) the published 35.10 band the bare recipe
+        # missed by 8pp
         "overrides": (
             "root.cifar_tpu.update({"
             "'synthetic_kind': 'scenes', 'synthetic_size': 96,"
             "'synthetic_train': 5000, 'synthetic_valid': 8000,"
             "'minibatch_size': 100,"  # STL-10's low-data regime
-            "'fail_iterations': 25, 'max_epochs': 120,"
+            "'fail_iterations': 60, 'max_epochs': 300,"
+            "'augment': {'kind': 'image', 'flip': True, 'pad': 8,"
+            "            'cutout': 16},"
+            "'lr_schedule': 'cosine',"
+            # warmup de-risks the strict-relu plateau: without it the
+            # default seed can sit at chance for 60+ epochs (the
+            # escape is luck; ROUND4_NOTES.md §5)
+            "'lr_schedule_params': {'total_steps': 15000,"
+            "                       'floor': 0.05, 'warmup': 500},"
             "'snapshot_time_interval': 1e9})"),
-        "target": "validation_error_pct toward the 35.10 band "
-                  "(difficulty comes from 5k labeled samples, like "
-                  "real STL-10)",
+        "target": "validation_error_pct at-or-below the 35.10 band",
     },
     "gtzan_mlp": {
         "workflow": "veles_tpu/samples/gtzan.py",
@@ -123,13 +134,11 @@ def run_one(name, spec, timeout=3000):
         suffix=".json", prefix="quality_%s_" % name, delete=False).name
     overrides = spec["overrides"]
     if spec.get("needs_corpus") == "tones":
-        # synthesize the procedural GTZAN-layout wav tree (idempotent,
-        # cached across runs)
+        # synthesize the procedural GTZAN-layout wav tree (idempotent;
+        # cached per-user with a generator-parameter hash in the path)
         sys.path.insert(0, REPO)
         from veles_tpu.datasets import tones
-        corpus = os.path.join(
-            tempfile.gettempdir(), "veles_tpu_tones_corpus")
-        tones.generate(corpus)
+        corpus = tones.generate()
         overrides = overrides.replace("{corpus}", corpus)
     cmd = [sys.executable, "-m", "veles_tpu", spec["workflow"]]
     if spec["config"]:
@@ -178,9 +187,38 @@ def run_one(name, spec, timeout=3000):
             pass
 
 
+def derive_metrics(name, metrics):
+    """Metrics computed FROM the result file (kept out of the product
+    path): the AE's comparison metric is RMSE = sqrt(validation MSE)
+    on the loader's normalization scale."""
+    if name == "mnist_ae" and "validation_loss" in metrics:
+        metrics["validation_rmse"] = round(
+            float(metrics["validation_loss"]) ** 0.5, 5)
+    return metrics
+
+
+def summarize(runs):
+    """The at-a-glance block: ours vs the reference's published number
+    per family."""
+    out = {}
+    for name, rec in runs.items():
+        m = rec.get("metrics") or {}
+        ref = REFERENCE[name]
+        entry = {"reference": ref["value"], "source": ref["source"]}
+        if name == "mnist_ae":
+            entry["ours_rmse"] = m.get("validation_rmse")
+        else:
+            entry["ours"] = m.get("validation_error_pct")
+        entry["target"] = rec.get("target")
+        if rec.get("returncode"):
+            entry["failed"] = True
+        out[name] = entry
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("--out", default="QUALITY_r03.json")
+    ap.add_argument("--out", default="QUALITY_r04.json")
     ap.add_argument("--only", help="run a single config family")
     args = ap.parse_args(argv)
     out = {"corpus": "procedural surrogates (zero-egress; see "
@@ -189,10 +227,12 @@ def main(argv=None):
         if args.only and name != args.only:
             continue
         print("== %s" % name, flush=True)
-        out["runs"][name] = run_one(name, spec)
-        print(json.dumps(out["runs"][name].get("metrics",
-                                               out["runs"][name]),
-                         indent=1), flush=True)
+        rec = run_one(name, spec)
+        if "metrics" in rec:
+            rec["metrics"] = derive_metrics(name, rec["metrics"])
+        out["runs"][name] = rec
+        print(json.dumps(rec.get("metrics", rec), indent=1), flush=True)
+    out["summary"] = summarize(out["runs"])
     with open(os.path.join(REPO, args.out), "w") as f:
         json.dump(out, f, indent=1)
     print("-> %s" % args.out)

@@ -1,0 +1,123 @@
+"""In-graph augmentation (ops/augment.py), cosine LR schedule, and the
+Config.get_dict helper."""
+
+import jax
+import jax.numpy as jnp
+import numpy
+import pytest
+
+
+class TestImageAugment:
+    def _x(self, b=4, h=8, w=8, c=3, seed=0):
+        rng = numpy.random.default_rng(seed)
+        return jnp.asarray(rng.normal(size=(b, h, w, c)), jnp.float32)
+
+    def test_shapes_preserved(self):
+        from veles_tpu.ops.augment import image_augment
+        fn = image_augment(flip=True, pad=2, cutout=3)
+        x = self._x()
+        y = fn(x, jax.random.key(0))
+        assert y.shape == x.shape
+        assert bool(jnp.isfinite(y).all())
+
+    def test_flip_only_permutes_columns(self):
+        from veles_tpu.ops.augment import image_augment
+        fn = image_augment(flip=True, pad=0, cutout=0)
+        x = self._x()
+        y = fn(x, jax.random.key(1))
+        # every sample is either itself or its mirror
+        for i in range(x.shape[0]):
+            same = numpy.allclose(y[i], x[i])
+            flipped = numpy.allclose(y[i], x[i, :, ::-1, :])
+            assert same or flipped
+
+    def test_randomness_is_keyed(self):
+        from veles_tpu.ops.augment import image_augment
+        fn = image_augment(flip=True, pad=2)
+        x = self._x()
+        y1 = fn(x, jax.random.key(2))
+        y2 = fn(x, jax.random.key(2))
+        y3 = fn(x, jax.random.key(3))
+        numpy.testing.assert_array_equal(numpy.asarray(y1),
+                                         numpy.asarray(y2))
+        assert not numpy.allclose(numpy.asarray(y1), numpy.asarray(y3))
+
+    def test_cutout_zeroes_a_patch(self):
+        from veles_tpu.ops.augment import image_augment
+        fn = image_augment(flip=False, pad=0, cutout=4)
+        x = jnp.ones((2, 8, 8, 1), jnp.float32)
+        y = numpy.asarray(fn(x, jax.random.key(4)))
+        assert (y == 0).any()
+
+    def test_make_augment_rejects_unknown(self):
+        from veles_tpu.ops.augment import make_augment
+        with pytest.raises(ValueError):
+            make_augment("nope")
+
+    def test_trains_through_fused_step(self):
+        """The augment spec rides the trainer config and the fused
+        step still produces finite losses."""
+        from veles_tpu.backends import Device
+        from veles_tpu.accelerated_units import AcceleratedWorkflow
+        from veles_tpu.loader.fullbatch import FullBatchLoader
+        from veles_tpu.models import EvaluatorSoftmax, GradientDescent
+        from veles_tpu.models.standard import make_forwards
+
+        class Loader(FullBatchLoader):
+            def load_data(self):
+                rng = numpy.random.default_rng(0)
+                n = 32
+                self.class_lengths[:] = [0, 8, 24]
+                self.original_data = rng.normal(
+                    size=(n, 8, 8, 3)).astype(numpy.float32)
+                self.original_labels = rng.integers(0, 4, n).tolist()
+
+        dev = Device(backend="numpy")
+        wf = AcceleratedWorkflow(None, name="aug")
+        loader = Loader(wf, minibatch_size=8)
+        loader.initialize(device=dev)
+        fw = make_forwards(wf, loader.minibatch_data, [
+            {"type": "all2all_tanh", "output_sample_shape": (16,)},
+            {"type": "softmax", "output_sample_shape": (4,)}])
+        for u in fw:
+            u.initialize(device=dev)
+        ev = EvaluatorSoftmax(wf, compute_confusion_matrix=False)
+        ev.output = fw[-1].output
+        ev.labels = loader.minibatch_labels
+        ev.loader = loader
+        ev.initialize(device=dev)
+        gd = GradientDescent(
+            wf, forwards=fw, evaluator=ev, loader=loader,
+            learning_rate=0.1,
+            augment={"kind": "image", "flip": True, "pad": 1})
+        gd.initialize(device=dev)
+        loader.run()
+        gd.run()
+        gd.loss.map_read()
+        assert numpy.isfinite(gd.loss.mem)
+
+
+def test_cosine_schedule():
+    from veles_tpu.models.lr_adjust import get_schedule
+    s = get_schedule("cosine", total_steps=100, floor=0.1)
+    assert float(s(0)) == pytest.approx(1.0)
+    assert float(s(50)) == pytest.approx(0.55, abs=1e-6)
+    assert float(s(100)) == pytest.approx(0.1, abs=1e-6)
+    assert float(s(200)) == pytest.approx(0.1, abs=1e-6)  # clamped
+    w = get_schedule("cosine", total_steps=100, warmup=10)
+    assert float(w(5)) == pytest.approx(0.5 * float(s(5) / s(5)) *
+                                        float(w(10)) / 1.0, rel=0.5)
+    assert float(w(0)) == 0.0
+
+
+def test_config_get_dict():
+    from veles_tpu.config import Config
+    c = Config("t")
+    c.update({"mesh": {"dp": 2, "sp": 4}, "plain": 5})
+    assert c.get_dict("mesh") == {"dp": 2, "sp": 4}
+    assert c.get_dict("absent") is None
+    assert c.get_dict("absent", {}) == {}
+    c.raw = {"a": 1}  # a plain dict value (not a subtree)
+    assert c.get_dict("raw") == {"a": 1}
+    c.none_key = None
+    assert c.get_dict("none_key") is None

@@ -1,0 +1,78 @@
+"""Native pallas flash-attention kernels (ops/pallas_attention.py) —
+exactness against the dense reference, fwd and all three gradients,
+causal and not (interpret mode on the CPU mesh; the real-TPU numbers
+live in ROUND4_NOTES.md)."""
+
+import jax
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu.ops.attention import attention
+from veles_tpu.ops.pallas_attention import pallas_attention
+
+
+def _qkv(b=2, s=64, h=2, d=16, dv=None, seed=0):
+    rng = numpy.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, h, dv or d)), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_dense(causal):
+    q, k, v = _qkv()
+    out = pallas_attention(q, k, v, causal=causal, block_q=32,
+                           block_k=32)
+    ref = attention(q, k, v, causal=causal)
+    numpy.testing.assert_allclose(numpy.asarray(out),
+                                  numpy.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match_dense(causal):
+    q, k, v = _qkv()
+
+    def loss(core):
+        def f(a, b, c):
+            return jnp.sum(jnp.sin(core(a, b, c)))
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    g1 = loss(lambda a, b, c: pallas_attention(
+        a, b, c, causal=causal, block_q=32, block_k=32))
+    g2 = loss(lambda a, b, c: attention(a, b, c, causal=causal))
+    for name, a, b in zip("qkv", g1, g2):
+        numpy.testing.assert_allclose(
+            numpy.asarray(a), numpy.asarray(b), atol=1e-4,
+            err_msg="d%s diverged (causal=%s)" % (name, causal))
+
+
+def test_dv_neq_dqk():
+    q, k, v = _qkv(d=16, dv=8)
+    out = pallas_attention(q, k, v, causal=True, block_q=32,
+                           block_k=32)
+    assert out.shape == v.shape[:1] + (q.shape[1],) + v.shape[2:]
+    ref = attention(q, k, v, causal=True)
+    numpy.testing.assert_allclose(numpy.asarray(out),
+                                  numpy.asarray(ref), atol=2e-5)
+
+
+def test_block_divisibility_error():
+    q, k, v = _qkv(s=60)
+    with pytest.raises(ValueError):
+        pallas_attention(q, k, v, block_q=32, block_k=32)
+
+
+def test_mha_apply_pallas_impl():
+    from veles_tpu.models.attention import mha_apply
+    rng = numpy.random.default_rng(1)
+    d, heads = 8, 2
+    x = jnp.asarray(rng.normal(size=(2, 32, d)), jnp.float32)
+    params = {n: jnp.asarray(rng.normal(size=(d, d)) * 0.2,
+                             jnp.float32)
+              for n in ("wq", "wk", "wv", "wo")}
+    out = mha_apply(params, x, heads, True, attn_impl="pallas")
+    ref = mha_apply(params, x, heads, True, attn_impl="dense")
+    numpy.testing.assert_allclose(numpy.asarray(out),
+                                  numpy.asarray(ref), atol=5e-2)

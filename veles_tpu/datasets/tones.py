@@ -17,7 +17,10 @@ the shipped MLP landed in that band rather than saturating — see
 QUALITY_r04.json for the measured value.
 """
 
+import getpass
+import hashlib
 import os
+import tempfile
 
 import numpy
 
@@ -102,11 +105,27 @@ def synth_track(style, rng, seconds=10.0, rate=22050):
         numpy.float32)
 
 
-def generate(dest, tracks_per_genre=40, seconds=10.0, rate=22050,
+def default_cache_dir(tracks_per_genre=40, seconds=10.0, rate=22050,
+                      seed=4242):
+    """Per-user, parameter-hashed cache path: a shared machine's /tmp
+    can't collide across users, and changing the generator parameters
+    (or the style table) invalidates the cache instead of silently
+    reusing a stale tree."""
+    recipe = hashlib.sha256(repr(
+        (sorted(GENRES.items()), _SCALE, tracks_per_genre, seconds,
+         rate, seed)).encode()).hexdigest()[:12]
+    user = getpass.getuser() or "nouser"
+    return os.path.join(tempfile.gettempdir(),
+                        "veles_tpu_tones_%s_%s" % (user, recipe))
+
+
+def generate(dest=None, tracks_per_genre=40, seconds=10.0, rate=22050,
              seed=4242):
-    """Write the GTZAN-layout wav tree ``dest/<genre>/<idx>.wav``;
-    returns ``dest``.  Idempotent: skips generation when the tree is
-    already complete."""
+    """Write the GTZAN-layout wav tree ``dest/<genre>/<idx>.wav``
+    (default: :func:`default_cache_dir`); returns the tree path.
+    Idempotent: skips generation when the tree is already complete."""
+    if dest is None:
+        dest = default_cache_dir(tracks_per_genre, seconds, rate, seed)
     from scipy.io import wavfile
     rng = numpy.random.default_rng(seed)
     complete = all(

@@ -81,7 +81,14 @@ def mha_apply(params, x, heads, causal, block_size=None, sp_mesh=None,
         q, k, v = (proj(params[n]) for n in ("wq", "wk", "wv"))
         if impl == "flash":
             from veles_tpu.ops.flash import flash_attention
-            o = flash_attention(q, k, v, causal=causal)
+            o = flash_attention(q, k, v, causal=causal,
+                                backend=backend)
+        elif impl == "pallas":
+            # the framework's OWN flash kernels (ops/pallas_attention)
+            from veles_tpu.ops.pallas_attention import pallas_attention
+            o = pallas_attention(q, k, v, causal=causal,
+                                 block_q=min(512, s),
+                                 block_k=min(512, s))
         elif impl == "blockwise":
             from veles_tpu.ops.attention import blockwise_attention
             o = blockwise_attention(q, k, v, block_size or 512,

@@ -35,14 +35,18 @@ def image_augment(flip=True, pad=0, cutout=0):
 
             x = jax.vmap(crop)(xp, off)
         if cutout:
-            cy = jax.random.randint(ku, (b,), 0, h)
+            # an exactly cutout×cutout box (top-left anchored so the
+            # erased area matches the configured size; the box may
+            # hang off the edge, like the original cutout paper)
+            cy = jax.random.randint(ku, (b,), -cutout // 2, h)
             cx = jax.random.randint(jax.random.fold_in(ku, 1),
-                                    (b,), 0, w)
+                                    (b,), -cutout // 2, w)
             yy = jnp.arange(h)[None, :, None]
             xx = jnp.arange(w)[None, None, :]
-            half = cutout // 2
-            mask = ((jnp.abs(yy - cy[:, None, None]) <= half)
-                    & (jnp.abs(xx - cx[:, None, None]) <= half))
+            mask = ((yy >= cy[:, None, None])
+                    & (yy < cy[:, None, None] + cutout)
+                    & (xx >= cx[:, None, None])
+                    & (xx < cx[:, None, None] + cutout))
             x = jnp.where(mask[..., None], 0.0, x)
         return x
 

@@ -52,14 +52,17 @@ def _block_sizes(seq):
         block_k_major_dq=b, block_k_dq=b, block_q_dq=b)
 
 
-def flash_attention(q, k, v, causal=False, scale=None):
+def flash_attention(q, k, v, causal=False, scale=None, backend=None):
     """Exact attention via the pallas TPU kernel.  q/k/v:
     [batch, seq, heads, head_dim] (the framework layout — seq-major so
     sp sharding stays a leading-dim spec); falls back to the streaming
-    blockwise op when the kernel doesn't apply."""
+    blockwise op when the kernel doesn't apply.  ``backend`` is the
+    TARGET device platform (see :func:`flash_available`) — callers
+    that know their device must pass it, or a CPU-compiled program on
+    a TPU host would trace the TPU kernel."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    if not flash_available(q.shape):
+    if not flash_available(q.shape, backend=backend):
         from veles_tpu.ops.attention import blockwise_attention
         return blockwise_attention(q, k, v, block_size=_BLOCK,
                                    causal=causal, scale=scale)
