@@ -121,3 +121,12 @@ def test_config_get_dict():
     assert c.get_dict("raw") == {"a": 1}
     c.none_key = None
     assert c.get_dict("none_key") is None
+    # a dict merge over a plain-value leaf replaces it with a subtree
+    c.mesh = None
+    c.update({"mesh": {"dp": 8}})
+    assert c.get_dict("mesh") == {"dp": 8}
+    # ...but a plain-DICT leaf seeds the subtree: layered overrides
+    # merge instead of discarding the leaf's other keys
+    c.mesh2 = {"dp": 2, "sp": 4}
+    c.update({"mesh2": {"dp": 8}})
+    assert c.get_dict("mesh2") == {"dp": 8, "sp": 4}

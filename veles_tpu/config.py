@@ -52,6 +52,17 @@ class Config:
                 raise AttributeError(
                     "config key %s.%s is protected" % (self._path_, k))
             if isinstance(v, dict):
+                cur = vars(self).get(k)
+                if not isinstance(cur, Config):
+                    # a dict merge over a plain leaf replaces it with
+                    # a fresh subtree (instead of crashing on
+                    # None.update) — seeded from the leaf's own keys
+                    # when the leaf was a plain dict, so layered
+                    # overrides still MERGE rather than discard
+                    node = Config("%s.%s" % (self._path_, k))
+                    object.__setattr__(self, k, node)
+                    if isinstance(cur, dict):
+                        node.update(cur)
                 getattr(self, k).update(v)
             else:
                 setattr(self, k, v)

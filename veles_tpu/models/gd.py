@@ -89,6 +89,19 @@ class GradientDescent(AcceleratedUnit):
         self.epoch_acc = Array()
         self.demand("forwards", "evaluator", "loader")
 
+    def __getstate__(self):
+        state = super(GradientDescent, self).__getstate__()
+        if state.get("mesh") is not None \
+                and not isinstance(state["mesh"], dict):
+            # a jax Mesh holds Device objects — unpicklable.  Persist
+            # the concrete AXIS SPEC; initialize() rebuilds the mesh
+            # over the resuming process's devices (which must supply a
+            # matching chip count — to re-shard onto a different
+            # topology, override .mesh before initialize).  A not-yet-
+            # initialized restore re-pickles the spec dict as-is.
+            state["mesh"] = {"__mesh_axes__": dict(state["mesh"].shape)}
+        return state
+
     def init_unpickled(self):
         super(GradientDescent, self).init_unpickled()
         self._train_step_ = None
@@ -134,6 +147,16 @@ class GradientDescent(AcceleratedUnit):
 
     def initialize(self, device=None, **kwargs):
         from veles_tpu.units import MissingDemand
+        if isinstance(self.mesh, dict) and "__mesh_axes__" in self.mesh:
+            # snapshot resume: rebuild the mesh over the target
+            # device's backend from the persisted axis spec (see
+            # __getstate__); build_mesh raises a clear error when the
+            # resuming chip count doesn't match the spec
+            from veles_tpu.parallel import build_mesh
+            self.mesh = build_mesh(
+                self.mesh["__mesh_axes__"],
+                devices=device.jax_devices if device is not None
+                else None)
         if not self.forwards or self.evaluator is None \
                 or self.loader is None:
             raise MissingDemand(self, {"forwards", "evaluator", "loader"})
