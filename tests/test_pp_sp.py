@@ -261,6 +261,36 @@ class TestSequenceShardedTraining:
         finally:
             root.transformer_tpu.mesh = None
 
+    def test_transformer_trains_sp_ep_dp(self):
+        """Three-way composition: a MoE transformer trains with batch
+        over dp, sequence through the ring over sp, AND expert weights
+        sharded over ep — in one fused step on one mesh."""
+        from veles_tpu.backends import Device
+        from veles_tpu.config import root
+        from veles_tpu.samples.transformer import TransformerWorkflow
+        root.transformer_tpu.update({
+            "mesh": {"dp": 2, "sp": 2, "ep": 2}, "seq": 16, "dim": 16,
+            "heads": 2, "blocks": 1, "causal": True, "n_experts": 2,
+            "top_k": 1, "minibatch_size": 16, "synthetic_train": 64,
+            "synthetic_valid": 16, "max_epochs": 2,
+            "snapshot_time_interval": 1e9})
+        try:
+            wf = TransformerWorkflow(None, plotters=False)
+            wf.initialize(device=Device(backend="numpy"))
+            wf.run()
+            wf.gd.loss.map_read()
+            assert numpy.isfinite(wf.gd.loss.mem)
+            blk = [u for u in wf.forwards
+                   if type(u).__name__ == "TransformerBlock"][0]
+            shards = {s.data.shape
+                      for s in blk.expert_w1.devmem.addressable_shards}
+            (shape,) = shards
+            assert shape[0] * 2 == blk.expert_w1.shape[0], \
+                "expert weights not sharded over ep: %s" % shards
+        finally:
+            root.transformer_tpu.mesh = None
+            root.transformer_tpu.n_experts = 0
+
     def test_mesh_workflow_snapshot_resume(self):
         """A mesh-sharded workflow pickles (the jax Mesh is persisted
         as its AXIS SPEC — Device objects don't pickle) and resumes:
@@ -301,6 +331,23 @@ class TestSequenceShardedTraining:
             assert float(wf2.gd.loss.mem) != 0.0
         finally:
             root.transformer_tpu.mesh = None
+
+    def test_trainer_accepts_plain_axis_dict_mesh(self):
+        """The documented override form — gd.mesh = {'dp': 2} before
+        initialize — materializes into a real Mesh (same path the
+        snapshot-restore sentinel takes)."""
+        from veles_tpu.backends import Device
+        dev = Device(backend="numpy")
+        import __graft_entry__ as g
+        loader, layers, gd = g._build_flagship(dev)
+        gd2_loader, gd2_layers, gd2 = loader, layers, gd
+        gd2.mesh = {"dp": -1}  # wildcard absorbs the backend's devices
+        gd2.initialize(device=dev)
+        assert dict(gd2.mesh.shape) == {"dp": len(dev.jax_devices)}
+        gd2_loader.run()
+        gd2.run()
+        gd2.loss.map_read()
+        assert numpy.isfinite(gd2.loss.mem)
 
     def test_mha_unit_ring_matches_dense(self):
         """The unit's ring path computes the same attention as its

@@ -147,16 +147,20 @@ class GradientDescent(AcceleratedUnit):
 
     def initialize(self, device=None, **kwargs):
         from veles_tpu.units import MissingDemand
-        if isinstance(self.mesh, dict) and "__mesh_axes__" in self.mesh:
-            # snapshot resume: rebuild the mesh over the target
-            # device's backend from the persisted axis spec (see
-            # __getstate__); build_mesh raises a clear error when the
-            # resuming chip count doesn't match the spec
-            from veles_tpu.parallel import build_mesh
-            self.mesh = build_mesh(
-                self.mesh["__mesh_axes__"],
-                devices=device.jax_devices if device is not None
-                else None)
+        if isinstance(self.mesh, dict):
+            # an axis-spec dict — a snapshot restore (__getstate__'s
+            # sentinel form) or a user override like {'dp': 4} — is
+            # materialized here: over ALL processes' devices for a
+            # multi-host gang, over the target device's backend
+            # otherwise (build_mesh raises a clear error on a
+            # mismatched chip count)
+            import jax
+            axes = self.mesh.get("__mesh_axes__", self.mesh)
+            if jax.process_count() > 1 or device is None:
+                from veles_tpu.parallel import build_mesh
+                self.mesh = build_mesh(dict(axes))
+            else:
+                self.mesh = device.make_mesh(axes)
         if not self.forwards or self.evaluator is None \
                 or self.loader is None:
             raise MissingDemand(self, {"forwards", "evaluator", "loader"})
