@@ -339,15 +339,14 @@ class TestSequenceShardedTraining:
         from veles_tpu.backends import Device
         dev = Device(backend="numpy")
         import __graft_entry__ as g
-        loader, layers, gd = g._build_flagship(dev)
-        gd2_loader, gd2_layers, gd2 = loader, layers, gd
-        gd2.mesh = {"dp": -1}  # wildcard absorbs the backend's devices
-        gd2.initialize(device=dev)
-        assert dict(gd2.mesh.shape) == {"dp": len(dev.jax_devices)}
-        gd2_loader.run()
-        gd2.run()
-        gd2.loss.map_read()
-        assert numpy.isfinite(gd2.loss.mem)
+        loader, _, gd = g._build_flagship(dev)
+        gd.mesh = {"dp": -1}  # wildcard absorbs the backend's devices
+        gd.initialize(device=dev)
+        assert dict(gd.mesh.shape) == {"dp": len(dev.jax_devices)}
+        loader.run()
+        gd.run()
+        gd.loss.map_read()
+        assert numpy.isfinite(gd.loss.mem)
 
     def test_mha_unit_ring_matches_dense(self):
         """The unit's ring path computes the same attention as its

@@ -156,11 +156,19 @@ class GradientDescent(AcceleratedUnit):
             # mismatched chip count)
             import jax
             axes = self.mesh.get("__mesh_axes__", self.mesh)
-            if jax.process_count() > 1 or device is None:
+            if jax.process_count() > 1:
+                # a gang spans every process's chips — but still on
+                # the target device's PLATFORM (a numpy-backend run on
+                # a GPU-default host must not grab GPU devices)
+                from veles_tpu.parallel import build_mesh
+                self.mesh = build_mesh(dict(axes), devices=jax.devices(
+                    device.jax_device.platform) if device is not None
+                    else None)
+            elif device is not None:
+                self.mesh = device.make_mesh(axes)
+            else:
                 from veles_tpu.parallel import build_mesh
                 self.mesh = build_mesh(dict(axes))
-            else:
-                self.mesh = device.make_mesh(axes)
         if not self.forwards or self.evaluator is None \
                 or self.loader is None:
             raise MissingDemand(self, {"forwards", "evaluator", "loader"})

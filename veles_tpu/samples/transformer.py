@@ -73,6 +73,9 @@ class TransformerWorkflow(StandardWorkflow):
                   "causal": bool(cfg.get("causal", False)),
                   "n_experts": n_experts,
                   "top_k": int(cfg.get("top_k", 2)),
+                  # attention core pin: "flash" | "pallas" |
+                  # "blockwise" | "dense" (None = auto; mha_apply)
+                  "attn_impl": cfg.get("attn_impl"),
                   # long sequences: stream K/V in blocks instead of
                   # materializing [seq, seq] scores (ops/attention.py)
                   "attn_block_size": (
