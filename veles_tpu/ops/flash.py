@@ -9,8 +9,9 @@ The kernel itself is ``jax.experimental.pallas.ops.tpu.flash_attention``
 JAX the way cuDNN ships with CUDA); this module owns the framework's
 integration: the [batch, seq, heads, head_dim] layout adaptation, the
 block-size tuning that measured 2.6x over the kernel's defaults on
-TPU v5e (512-token blocks; see ROUND4_NOTES.md), the applicability
-check, and the numerically-equivalent streaming fallback
+TPU v5e (1024-token Q blocks over 512-token K blocks, dropping to
+uniform 512 when seq doesn't divide 1024; see ROUND4_NOTES.md), the
+applicability check, and the numerically-equivalent streaming fallback
 (ops.attention.blockwise_attention) for CPU meshes and odd shapes so
 tests and virtual-device dryruns run the same model code."""
 
@@ -19,8 +20,11 @@ import functools
 import jax
 import jax.numpy as jnp
 
-#: the kernel wants block-aligned tiles; 512 measured fastest for
-#: seq 1024-4096 at head_dim 128 on TPU v5e (ROUND4_NOTES.md)
+#: the kernel wants block-aligned tiles; Q blocks of 1024 over K
+#: blocks of 512 measured fastest at head_dim 128 on TPU v5e
+#: (21% over uniform 512 at seq 2048 / 16 heads; ROUND4_NOTES.md) —
+#: the applicability gate stays at the K granularity
+_BLOCK_Q = 1024
 _BLOCK = 512
 
 
@@ -44,12 +48,17 @@ def flash_available(q_shape, backend=None):
 @functools.lru_cache(maxsize=None)
 def _block_sizes(seq):
     from jax.experimental.pallas.ops.tpu import flash_attention as fa
-    b = min(_BLOCK, seq)
+    # the kernel's backward pass REQUIRES seq divisible by the q
+    # block — a 512-but-not-1024 multiple (1536, 2560, …) drops to
+    # the uniform 512 config the applicability gate guarantees
+    bq = _BLOCK_Q if seq % _BLOCK_Q == 0 else _BLOCK
+    bq = min(bq, seq)
+    bk = min(_BLOCK, seq)
     return fa.BlockSizes(
-        block_q=b, block_k_major=b, block_k=b, block_b=1,
-        block_q_major_dkv=b, block_k_major_dkv=b, block_k_dkv=b,
-        block_q_dkv=b,
-        block_k_major_dq=b, block_k_dq=b, block_q_dq=b)
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
+        block_q_dkv=bq,
+        block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, backend=None):
