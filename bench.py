@@ -244,8 +244,7 @@ def bench_transformer(dev, windows=4, d_model=2048, layers=8, heads=16,
             "d_model": d_model, "layers": layers, "heads": heads,
             "seq": seq, "batch": batch, "vocab": vocab,
             "dtype": "bfloat16",
-            "attn": "flash" if flash_available(
-                (batch, seq, heads, d_model // heads)) else "fallback"},
+            "attn": attn_label(batch, seq, heads, d_model // heads)},
         "transformer_windows": stats["windows"],
         "transformer_spans_per_window": spans,
         "transformer_steady_delta": stats["steady_delta"],
@@ -254,6 +253,17 @@ def bench_transformer(dev, windows=4, d_model=2048, layers=8, heads=16,
             "convention); causal_discounted halves them (the flash "
             "kernel skips masked blocks)",
     }
+
+
+def attn_label(batch, seq, heads, head_dim):
+    """Which attention core mha_apply's auto path selects for this
+    shape — mirrored from models/attention (so the bench JSON
+    attributes numbers to the right kernel)."""
+    from veles_tpu.models.attention import AUTO_NATIVE_MAX_SEQ
+    from veles_tpu.ops.flash import flash_available
+    if not flash_available((batch, seq, heads, head_dim)):
+        return "fallback"
+    return "pallas_native" if seq <= AUTO_NATIVE_MAX_SEQ else "flash"
 
 
 def _build_token_lm(dev, d_model, layers, heads, seq, batch, vocab,
@@ -319,8 +329,8 @@ def bench_longcontext(dev, seq=32768, d_model=512, heads=4, layers=2,
     return {
         "longcontext_seq": seq,
         "longcontext_tokens_per_sec": round(sps * seq, 1),
-        "longcontext_attn": "flash" if flash_available(
-            (batch, seq, heads, d_model // heads)) else "blockwise",
+        "longcontext_attn": attn_label(batch, seq, heads,
+                                       d_model // heads),
         "longcontext_windows": _window_stats(rates, spans)["windows"],
     }
 

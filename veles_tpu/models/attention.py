@@ -16,6 +16,11 @@ import numpy
 
 from veles_tpu.models.nn_units import ForwardBase
 
+#: auto-select boundary: the native pallas kernels win below it, the
+#: jax flash kernel's masked-block DMA skip wins above (measured at
+#: seq 2048 and 32768 — ROUND4_NOTES.md §1b)
+AUTO_NATIVE_MAX_SEQ = 4096
+
 
 def _ring_mha(mesh, q, k, v, causal):
     """The sp-sharded attention core: q/k/v [batch, seq, heads, hd]
@@ -75,7 +80,14 @@ def mha_apply(params, x, heads, causal, block_size=None, sp_mesh=None,
         if impl == "auto":
             from veles_tpu.ops.flash import flash_available
             if flash_available((b, s, heads, hd), backend=backend):
-                impl = "flash"
+                # measured split (ROUND4_NOTES.md §1b): the NATIVE
+                # kernels beat the jax-shipped flash kernel at
+                # moderate sequence lengths (6.3 vs 7.1 ms at seq
+                # 2048), but the jax kernel's masked-block DMA skip
+                # wins at long sequences (32 vs 49 ms at 32k) — auto
+                # picks by sequence length; attn_impl pins override
+                impl = "pallas" if s <= AUTO_NATIVE_MAX_SEQ \
+                    else "flash"
             else:
                 impl = "blockwise" if block_size else "dense"
         q, k, v = (proj(params[n]) for n in ("wq", "wk", "wv"))
@@ -86,9 +98,7 @@ def mha_apply(params, x, heads, causal, block_size=None, sp_mesh=None,
         elif impl == "pallas":
             # the framework's OWN flash kernels (ops/pallas_attention)
             from veles_tpu.ops.pallas_attention import pallas_attention
-            o = pallas_attention(q, k, v, causal=causal,
-                                 block_q=min(512, s),
-                                 block_k=min(512, s))
+            o = pallas_attention(q, k, v, causal=causal)
         elif impl == "blockwise":
             from veles_tpu.ops.attention import blockwise_attention
             o = blockwise_attention(q, k, v, block_size or 512,

@@ -35,6 +35,10 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK = 512
+#: larger Q blocks amortize the K/V streaming (21% on the jax kernel
+#: at head_dim 128 — ROUND4_NOTES.md); callers fall back to 512 when
+#: seq doesn't divide 1024
+DEFAULT_BLOCK_Q = 1024
 #: finite stand-in for -inf: exp(x - max) underflows to 0 for masked
 #: entries without generating nan through (-inf) - (-inf)
 _NEG_INF = -1e30
@@ -43,7 +47,10 @@ _LANES = 128
 
 
 def _use_interpret():
-    return jax.default_backend() not in ("tpu",)
+    # same platform whitelist as ops.flash.flash_available — a
+    # mismatch would silently run interpret-mode kernels on a real
+    # accelerator the auto-select routed here
+    return jax.default_backend() not in ("tpu", "axon")
 
 
 def _mask(s, q_base, k_base, block_q, block_k):
@@ -313,15 +320,19 @@ _mha.defvjp(_mha_fwd, _mha_bwd)
 
 
 def pallas_attention(q, k, v, causal=False, scale=None,
-                     block_q=DEFAULT_BLOCK, block_k=DEFAULT_BLOCK):
+                     block_q=None, block_k=DEFAULT_BLOCK):
     """Exact attention via the native pallas kernels.  q/k/v:
     [batch, seq, heads, head_dim] (framework layout).  Sequence
-    lengths must divide the block sizes; head_dim should be a lane
-    multiple for real-hardware performance."""
+    lengths must divide the block sizes (the default Q block drops
+    1024 → 512 when seq doesn't divide 1024); head_dim should be a
+    lane multiple for real-hardware performance."""
     b, sq, h, d = q.shape
     sk, dv = k.shape[1], v.shape[3]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    if block_q is None:
+        block_q = DEFAULT_BLOCK_Q if sq % DEFAULT_BLOCK_Q == 0 \
+            else DEFAULT_BLOCK
     bq = min(block_q, sq)
     bk = min(block_k, sk)
     if sq % bq or sk % bk:
