@@ -1,0 +1,63 @@
+"""Parity tests for the pallas LRN kernel pair (ops/lrn.py r5).
+
+The kernels run under ``interpret=True`` on the CPU test mesh, so the
+real kernel bodies (band matmul + recompute backward) are exercised.
+Reference is the band formulation ``lrn`` (itself tested against the
+shifted-add definition in test_models).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu.ops.lrn import _pack_group, lrn, lrn_pallas
+
+
+@pytest.mark.parametrize("shape,dtype", [
+    ((8, 5, 5, 96), jnp.float32),      # packed g=4 path
+    ((4, 3, 3, 256), jnp.float32),     # packed g=1 (aligned)
+    ((7, 5, 5, 96), jnp.float32),      # rows not divisible by g
+    ((3, 11, 64), jnp.float32),        # packed g=2
+    ((2, 9, 9, 96), jnp.bfloat16),     # bf16 operands
+])
+def test_forward_matches_band(shape, dtype):
+    rng = numpy.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal(shape), dtype)
+    a = lrn(x).astype(jnp.float32)
+    b = lrn_pallas(x).astype(jnp.float32)
+    tol = 2e-2 if dtype == jnp.bfloat16 else 1e-5
+    assert float(jnp.max(jnp.abs(a - b))) < tol
+
+
+@pytest.mark.parametrize("shape", [(8, 5, 5, 96), (4, 3, 3, 256),
+                                   (5, 7, 64)])
+def test_gradient_matches_band(shape):
+    rng = numpy.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+    g1 = jax.grad(lambda x: jnp.sum(jnp.sin(lrn(x))))(x)
+    g2 = jax.grad(lambda x: jnp.sum(jnp.sin(lrn_pallas(x))))(x)
+    assert float(jnp.max(jnp.abs(g1 - g2))) < 1e-4
+
+
+def test_nondefault_params():
+    rng = numpy.random.default_rng(11)
+    x = jnp.asarray(rng.standard_normal((4, 6, 6, 96)), jnp.float32)
+    kw = dict(alpha=2e-4, beta=0.5, n=3, k=1.0)
+    a = lrn(x, **kw)
+    b = lrn_pallas(x, **kw)
+    assert float(jnp.max(jnp.abs(a - b))) < 1e-5
+    g1 = jax.grad(lambda x: jnp.sum(lrn(x, **kw) ** 2))(x)
+    g2 = jax.grad(lambda x: jnp.sum(lrn_pallas(x, **kw) ** 2))(x)
+    assert float(jnp.max(jnp.abs(g1 - g2))) < 1e-4
+
+
+def test_pack_group():
+    assert _pack_group(96) == 4     # 384 = 3 lanes of 128
+    assert _pack_group(256) == 1    # already aligned
+    assert _pack_group(128) == 1
+    assert _pack_group(64) == 2
+    # odd width can never align (needs g a multiple of 128, far above
+    # the g*c < 1024 cap) — the fallback must return 1
+    assert _pack_group(81) == 1

@@ -42,8 +42,10 @@ class LRNormalizerForward(ForwardBase):
 
     def apply(self, params, x):
         # On TPU: plain-autodiff band-matmul LRN (veles_tpu/ops/lrn.py
-        # documents the measured formulation shootout).  Off-TPU the
-        # same math as shifted adds — cheap on CPU, no band constant.
+        # documents the measured formulation shootout, including the
+        # r5 pallas kernels that win in isolation but lose in-graph to
+        # the 4D→2D relayout copy).  Off-TPU the same math as shifted
+        # adds — cheap on CPU, no band constant.
         if jax.default_backend() == "tpu":
             from veles_tpu.ops.lrn import lrn
             return lrn(x, self.alpha, self.beta, self.n, self.k)

@@ -46,11 +46,7 @@ _NEG_INF = -1e30
 _LANES = 128
 
 
-def _use_interpret():
-    # same platform whitelist as ops.flash.flash_available — a
-    # mismatch would silently run interpret-mode kernels on a real
-    # accelerator the auto-select routed here
-    return jax.default_backend() not in ("tpu", "axon")
+from veles_tpu.ops.common import use_interpret as _use_interpret
 
 
 def _mask(s, q_base, k_base, block_q, block_k):
@@ -117,7 +113,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
-def _run_fwd(q, k, v, scale, causal, block_q, block_k):
+def _run_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     """q/k/v: [bh, seq, d] → (o [bh, sq, dv],
     lse [bh, sq, 128] f32 lane-replicated)."""
     bh, sq, d = q.shape
@@ -145,7 +141,7 @@ def _run_fwd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, _LANES), jnp.float32),
             pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
-        interpret=_use_interpret(),
+        interpret=interpret,
     )(q, k, v)
 
 
@@ -250,18 +246,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
 # -- custom_vjp wiring ------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _mha(q, k, v, scale, causal, block_q, block_k):
-    o, _ = _mha_fwd(q, k, v, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _mha(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, _ = _mha_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
     return o
 
 
-def _mha_fwd(q, k, v, scale, causal, block_q, block_k):
-    o, lse = _run_fwd(q, k, v, scale, causal, block_q, block_k)
+def _mha_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    o, lse = _run_fwd(q, k, v, scale, causal, block_q, block_k,
+                      interpret)
     return o, (q, k, v, o, lse)
 
 
-def _mha_bwd(scale, causal, block_q, block_k, res, do):
+def _mha_bwd(scale, causal, block_q, block_k, interpret, res, do):
     q, k, v, o, lse = res
     bh, sq, d = q.shape
     sk, dv = k.shape[1], v.shape[2]
@@ -283,7 +280,7 @@ def _mha_bwd(scale, causal, block_q, block_k, res, do):
                                lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        interpret=_use_interpret(),
+        interpret=interpret,
     )(q, k, v, do, o, lse)
 
     dk, dv_out = pl.pallas_call(
@@ -311,7 +308,7 @@ def _mha_bwd(scale, causal, block_q, block_k, res, do):
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, dv), jnp.float32),
         ],
-        interpret=_use_interpret(),
+        interpret=interpret,
     )(q, k, v, do, o, lse)
     return dq, dk, dv_out
 
@@ -320,12 +317,15 @@ _mha.defvjp(_mha_fwd, _mha_bwd)
 
 
 def pallas_attention(q, k, v, causal=False, scale=None,
-                     block_q=None, block_k=DEFAULT_BLOCK):
+                     block_q=None, block_k=DEFAULT_BLOCK,
+                     backend=None):
     """Exact attention via the native pallas kernels.  q/k/v:
     [batch, seq, heads, head_dim] (framework layout).  Sequence
     lengths must divide the block sizes (the default Q block drops
     1024 → 512 when seq doesn't divide 1024); head_dim should be a
-    lane multiple for real-hardware performance."""
+    lane multiple for real-hardware performance.  ``backend`` is the
+    platform of the TARGET device (see ops.common.use_interpret) —
+    callers that know their device must pass it (ADVICE.md r4 #1)."""
     b, sq, h, d = q.shape
     sk, dv = k.shape[1], v.shape[3]
     if scale is None:
@@ -344,5 +344,5 @@ def pallas_attention(q, k, v, causal=False, scale=None,
                                              t.shape[3])
 
     o = _mha(flat(q), flat(k), flat(v), float(scale), bool(causal),
-             bq, bk)
+             bq, bk, _use_interpret(backend))
     return jnp.swapaxes(o.reshape(b, h, sq, dv), 1, 2)
