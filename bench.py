@@ -92,6 +92,11 @@ def training_flops_per_sample(forwards):
         if isinstance(u, Conv):
             _, h, w, k = u.output.shape
             cin = u.input.shape[-1]
+            if getattr(u, "space_to_depth", 0):
+                # blocked stem: MODEL flops count the logical conv
+                # (the block padding is implementation cost, not
+                # model work — keeps MFU honest)
+                cin //= u.space_to_depth ** 2
             total += 2.0 * h * w * k * (u.kx * u.ky * cin / u.n_groups)
         elif isinstance(u, All2All):
             fan_in = int(numpy.prod(u.input.shape[1:]))
@@ -346,6 +351,9 @@ def bench_alexnet(dev, windows=4):
     root.alexnet_tpu.update({
         "synthetic_train": 4096, "synthetic_valid": 0,
         "side": 227, "classes": 1000,
+        # pinned so loader and alexnet_layers() cannot desync if the
+        # ambient config carries a stem override
+        "space_to_depth": 0,
     })
     wf = AcceleratedWorkflow(None, name="bench-alexnet")
     loader = ImagenetLoader(wf, minibatch_size=1024)

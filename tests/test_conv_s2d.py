@@ -1,0 +1,89 @@
+"""Space-to-depth conv stem: exact parity with the plain strided conv
+(models/conv.py r5 — the AlexNet 11×11/4 emitter fix)."""
+
+import jax
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu.accelerated_units import AcceleratedWorkflow
+from veles_tpu.models.conv import Conv, space_to_depth
+
+
+def _apply_conv(x, weights, bias, **kw):
+    wf = AcceleratedWorkflow(None, name="t")
+    u = Conv(wf, include_bias=bias is not None, **kw)
+    params = {"weights": weights}
+    if bias is not None:
+        params["bias"] = bias
+    return u.apply(params, x)
+
+
+@pytest.mark.parametrize("h,kx,n", [(227, 11, 4), (29, 5, 2), (21, 3, 3)])
+def test_s2d_matches_strided(h, kx, n):
+    assert (h - kx) % n == 0
+    rng = numpy.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, h, h, 3)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((kx, kx, 3, 8)) * 0.1,
+                    jnp.float32)
+    b = jnp.asarray(rng.standard_normal((8,)), jnp.float32)
+    y_ref = _apply_conv(x, w, b, n_kernels=8, kx=kx, ky=kx,
+                        sliding=(n, n), padding="valid")
+    xb = space_to_depth(x, n)
+    y = _apply_conv(xb, w, b, n_kernels=8, kx=kx, ky=kx,
+                    sliding=(n, n), padding="valid", space_to_depth=n)
+    assert y.shape == y_ref.shape
+    # both paths compute in the bf16 policy; the blocked
+    # contraction sums 432 taps vs 363 -> bf16 rounding differs
+    assert float(jnp.max(jnp.abs(y - y_ref))) < 5e-3
+
+
+def test_s2d_gradients_match():
+    rng = numpy.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((2, 19, 19, 3)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((11, 11, 3, 4)) * 0.1,
+                    jnp.float32)
+
+    def loss_ref(w):
+        y = _apply_conv(x, w, None, n_kernels=4, kx=11, ky=11,
+                        sliding=(4, 4), padding="valid")
+        return jnp.sum(y * y)
+
+    xb = space_to_depth(x, 4)
+
+    def loss_s2d(w):
+        y = _apply_conv(xb, w, None, n_kernels=4, kx=11, ky=11,
+                        sliding=(4, 4), padding="valid",
+                        space_to_depth=4)
+        return jnp.sum(y * y)
+
+    g_ref = jax.grad(loss_ref)(w)
+    g = jax.grad(loss_s2d)(w)
+    assert g.shape == w.shape                  # logical convention kept
+    denom = float(jnp.max(jnp.abs(g_ref))) + 1e-6
+    assert float(jnp.max(jnp.abs(g - g_ref))) / denom < 2e-2
+
+
+def test_s2d_validation():
+    wf = AcceleratedWorkflow(None, name="t")
+    with pytest.raises(ValueError):
+        Conv(wf, n_kernels=4, kx=3, ky=3, sliding=(2, 2),
+             padding="valid", space_to_depth=4)     # stride mismatch
+    with pytest.raises(ValueError):
+        Conv(wf, n_kernels=4, kx=3, ky=3, sliding=(4, 4),
+             padding="same", space_to_depth=4)      # padding
+    with pytest.raises(ValueError):
+        Conv(wf, n_kernels=4, kx=3, ky=3, sliding=(4, 4),
+             padding="valid", n_groups=2, space_to_depth=4)
+
+
+def test_space_to_depth_shape():
+    x = jnp.ones((2, 227, 227, 3))
+    xb = space_to_depth(x, 4)
+    assert xb.shape == (2, 57, 57, 48)
+    # round-trip of an aligned case
+    x2 = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 8, 3)
+    b2 = space_to_depth(x2, 2)
+    assert b2.shape == (2, 4, 4, 12)
+    assert float(b2[0, 0, 0, 0]) == float(x2[0, 0, 0, 0])
+    assert float(b2[0, 0, 0, 3]) == float(x2[0, 0, 1, 0])   # (dh,dw,c)

@@ -248,7 +248,8 @@ def test_cpp_runner_mini_alexnet(runner_binary, tmp_path):
     rng = numpy.random.default_rng(9)
     x = rng.random((2, 67, 67, 3)).astype(numpy.float32)
     wf = AcceleratedWorkflow(None, name="axmini")
-    units = make_forwards(wf, Array(x), alexnet_layers(classes=7))
+    units = make_forwards(
+        wf, Array(x), alexnet_layers(classes=7, space_to_depth=0))
     dev = Device(backend="numpy")
     for u in units:
         u.initialize(device=dev)

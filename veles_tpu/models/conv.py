@@ -23,6 +23,43 @@ def _pair(v):
     return (int(v), int(v))
 
 
+def validate_space_to_depth(h, w, ky, kx, n):
+    """Raise unless a stride-n VALID ky×kx conv over [h, w] produces
+    the same output from the blocked form — i.e. (h-ky) and (w-kx)
+    are stride multiples AND the blocked VALID output count matches
+    the logical one.  Loaders/samples that pre-block data call this
+    with the model's stem geometry (misalignment would silently add
+    border outputs computed from block padding)."""
+    for dim, k in ((h, ky), (w, kx)):
+        if (dim - k) % n:
+            raise ValueError(
+                "space_to_depth=%d misaligned: (%d - %d) %% %d != 0"
+                % (n, dim, k, n))
+        logical = (dim - k) // n + 1
+        blocked = -(-dim // n) - (-(-k // n)) + 1
+        if logical != blocked:
+            raise ValueError(
+                "space_to_depth=%d: blocked VALID output %d != "
+                "logical %d over extent %d (kernel %d)"
+                % (n, blocked, logical, dim, k))
+
+
+def space_to_depth(x, n):
+    """[B, H, W, C] → [B, ceil(H/n), ceil(W/n), n²·C] (zero-padded to
+    block multiples; block channel layout (dh, dw, c)).  Loaders call
+    this to pre-block data for a ``Conv(space_to_depth=n)`` stem —
+    and should call :func:`validate_space_to_depth` with the stem
+    geometry first."""
+    b, h, w, c = x.shape
+    hp = -h % n
+    wp = -w % n
+    if hp or wp:
+        x = jnp.pad(x, ((0, 0), (0, hp), (0, wp), (0, 0)))
+    hb, wb = (h + hp) // n, (w + wp) // n
+    x = x.reshape(b, hb, n, wb, n, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, hb, wb, n * n * c)
+
+
 class Conv(ForwardBase):
     """y = activation(conv(x, W) + b), x: [N, H, W, C]
     (znicz conv.Conv; kwargs kx/ky/n_kernels/sliding/padding match the
@@ -32,7 +69,7 @@ class Conv(ForwardBase):
 
     def __init__(self, workflow, n_kernels=None, kx=3, ky=3,
                  sliding=(1, 1), padding="same", n_groups=1,
-                 activation=None, **kwargs):
+                 activation=None, space_to_depth=0, **kwargs):
         super(Conv, self).__init__(workflow, **kwargs)
         if n_kernels is None:
             raise ValueError("n_kernels is required")
@@ -44,6 +81,27 @@ class Conv(ForwardBase):
         self.padding = padding  # "same" | "valid" | ((t,b),(l,r)) | int
         self.n_groups = int(n_groups)
         self.activation = activation or self.ACTIVATION
+        #: stride-matched space-to-depth stem (TPU emitter fix for
+        #: tiny-C strided stems like AlexNet's 11×11/4 over RGB: the
+        #: blocked form measured 5.42 vs 7.88 ms fwd+dk on v5e,
+        #: ROUND5_NOTES.md §1a).  Weights stay in the LOGICAL
+        #: [ky, kx, C, O] convention — the blocked kernel is built
+        #: in-graph, so export/snapshot/autodiff are unchanged.  The
+        #: loader must feed pre-blocked data (``space_to_depth()``).
+        #: NOT supported by the C++ runner's Conv (runtime/units.cc
+        #: computes the plain strided form) — export with
+        #: space_to_depth=0 for package_export targets.
+        self.space_to_depth = int(space_to_depth or 0)
+        if self.space_to_depth:
+            if self.n_groups != 1:
+                raise ValueError("space_to_depth requires n_groups=1")
+            if self.sliding != (self.space_to_depth,) * 2:
+                raise ValueError(
+                    "space_to_depth=%d requires sliding=(%d, %d)"
+                    % ((self.space_to_depth,) * 3))
+            if not (isinstance(self.padding, str)
+                    and self.padding.lower() == "valid"):
+                raise ValueError("space_to_depth requires VALID padding")
 
     @property
     def _hw_strides(self):
@@ -68,20 +126,49 @@ class Conv(ForwardBase):
         return out.shape
 
     def _kernel_shape(self, in_channels):
+        if self.space_to_depth:
+            in_channels //= self.space_to_depth ** 2
         return (self.ky, self.kx, in_channels // self.n_groups,
                 self.n_kernels)
 
+    def _blocked_kernel(self, kernel):
+        """Logical [ky, kx, C, O] → blocked [kby, kbx, n²·C, O]
+        matching ``space_to_depth``'s (dh, dw, c) channel layout.
+        Built in-graph: tiny (≤ tens of KB), and autodiff maps the
+        blocked-kernel cotangent back onto the logical weights."""
+        n = self.space_to_depth
+        ky, kx, c, o = kernel.shape
+        kby, kbx = -(-ky // n), -(-kx // n)
+        kp = jnp.pad(kernel, ((0, kby * n - ky), (0, kbx * n - kx),
+                              (0, 0), (0, 0)))
+        kp = kp.reshape(kby, n, kbx, n, c, o)
+        return kp.transpose(0, 2, 1, 3, 4, 5).reshape(
+            kby, kbx, n * n * c, o)
+
     def _conv(self, x, kernel):
+        if self.space_to_depth:
+            # blocked stem: stride-n VALID conv over [B, H, W, C]
+            # becomes a stride-1 VALID conv over the pre-blocked
+            # [B, ceil(H/n), ceil(W/n), n²·C] input.  The caller must
+            # pre-block with ``space_to_depth()`` and guarantee
+            # (H - ky) % n == 0 so the blocked output equals the
+            # logical one (AlexNet's 227/11/4 stem does).
+            cd = dtypes.compute_dtype()
+            return jax.lax.conv_general_dilated(
+                x.astype(cd), self._blocked_kernel(kernel).astype(cd),
+                window_strides=(1, 1), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                precision=dtypes.matmul_precision())
         # BOTH operands cast to the compute dtype and the output kept in
         # it: the conv trunk's activations are the HBM-bandwidth hot
         # spot (bf16 halves the traffic), and the conv VJP needs
         # matching operand/cotangent dtypes — a bf16-in/f32-out mix is
         # rejected by lax.conv.  The MXU accumulates in f32 internally
         # regardless; the loss is computed in f32 at the evaluator.
-        # (A space-to-depth rewrite of the AlexNet 11x11/4 stem was
-        # measured on v5e — per-minibatch blocking AND a pre-blocked
-        # dataset both ran slower than XLA's native strided conv, so
-        # no stem special-case exists here.)
+        # (The space_to_depth branch above is the r5 stem rewrite:
+        # 2.2 ms faster in isolation but net-negative in the full
+        # step because of the blocked dataset's gather layout — see
+        # ROUND5_NOTES.md §1c; it therefore ships opt-in.)
         cd = dtypes.compute_dtype()
         return jax.lax.conv_general_dilated(
             x.astype(cd), kernel.astype(cd),
@@ -94,7 +181,7 @@ class Conv(ForwardBase):
     def fill_params(self):
         in_ch = self.input.shape[-1]
         kshape = self._kernel_shape(in_ch)
-        fan_in = self.kx * self.ky * in_ch // self.n_groups
+        fan_in = self.kx * self.ky * kshape[2]
         fan_out = self.n_kernels
         self.weights.reset(numpy.zeros(kshape, numpy.float32))
         self._fill(self.weights.mem, self.weights_filling,
@@ -111,10 +198,13 @@ class Conv(ForwardBase):
         return get_activation(self.activation)(y)
 
     def export_config(self):
-        return {"n_kernels": self.n_kernels, "kx": self.kx, "ky": self.ky,
-                "sliding": list(self.sliding), "padding": self.padding,
-                "n_groups": self.n_groups, "activation": self._export_activation(),
-                "include_bias": self.include_bias}
+        cfg = {"n_kernels": self.n_kernels, "kx": self.kx, "ky": self.ky,
+               "sliding": list(self.sliding), "padding": self.padding,
+               "n_groups": self.n_groups, "activation": self._export_activation(),
+               "include_bias": self.include_bias}
+        if self.space_to_depth:
+            cfg["space_to_depth"] = self.space_to_depth
+        return cfg
 
 
 class ConvTanh(Conv):

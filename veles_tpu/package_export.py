@@ -44,7 +44,7 @@ import numpy
 #: so plain packages stay loadable by older deployments.
 FORMAT_VERSION = 2
 #: unit-config keys that require a v2 reader
-V2_KEYS = ("block_size", "attn_block_size")
+V2_KEYS = ("block_size", "attn_block_size", "space_to_depth")
 
 
 def _unit_entry(i, unit):
