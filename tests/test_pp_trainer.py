@@ -1,0 +1,167 @@
+"""Pipeline parallelism at the TRAINER (VERDICT r4 #2): a mesh with a
+``pp`` axis trains through the fused GradientDescent step — trunk
+split into stages, GPipe fwd+bwd+update in one program, composing
+with dp — with loss parity against an identically-initialized
+unsharded twin."""
+
+import jax
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu.config import root
+
+from veles_tpu.accelerated_units import AcceleratedWorkflow
+from veles_tpu.backends import Device
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.models.evaluator import EvaluatorSoftmax
+from veles_tpu.models.gd import GradientDescent
+from veles_tpu.models.standard import make_forwards
+from veles_tpu.parallel import build_mesh
+
+
+class _TokenLoader(FullBatchLoader):
+    def load_data(self):
+        rng = numpy.random.default_rng(0)
+        n, seq, vocab = 32, 8, 11
+        self.class_lengths[:] = [0, 0, n]
+        self.original_data = rng.integers(
+            0, vocab, (n, seq)).astype(numpy.int32)
+        self.original_labels = rng.integers(0, vocab, n).tolist()
+
+
+def _build_lm(mesh, blocks=4, dim=16, heads=2, mb=16):
+    dev = Device(backend="numpy")
+    wf = AcceleratedWorkflow(None, name="pp-lm")
+    # shuffle_limit=0: the twin loaders draw from the same global
+    # prng stream, so shuffling would desync their minibatch order
+    loader = _TokenLoader(wf, minibatch_size=mb, shuffle_limit=0,
+                          normalization_type="none")
+    loader.span_serving = False
+    loader.initialize(device=dev)
+    spec = [{"type": "embedding", "vocab": 11, "dim": dim}]
+    spec += [{"type": "transformer_block", "heads": heads,
+              "causal": True} for _ in range(blocks)]
+    spec += [{"type": "mean_pool_seq"},
+             {"type": "softmax", "output_sample_shape": (11,)}]
+    forwards = make_forwards(wf, loader.minibatch_data, spec)
+    for u in forwards:
+        u.initialize(device=dev)
+    ev = EvaluatorSoftmax(wf, compute_confusion_matrix=False)
+    ev.output = forwards[-1].output
+    ev.labels = loader.minibatch_labels
+    ev.loader = loader
+    ev.initialize(device=dev)
+    gd = GradientDescent(wf, forwards=forwards, evaluator=ev,
+                         loader=loader, solver="sgd",
+                         learning_rate=0.05, gradient_moment=0.9,
+                         mesh=mesh)
+    gd.initialize(device=dev)
+    return loader, gd, forwards
+
+
+def _seed_params_from(src_forwards, dst_forwards):
+    for su, du in zip(src_forwards, dst_forwards):
+        for name, arr in su.param_arrays().items():
+            darr = du.param_arrays()[name]
+            darr.map_invalidate()
+            darr.mem[...] = numpy.array(arr.map_read().mem)
+            darr.unmap()
+
+
+def _steps(loader, gd, n):
+    losses = []
+    for _ in range(n):
+        loader.run()
+        gd.run()
+        gd.loss.map_read()
+        losses.append(float(gd.loss.mem))
+    return losses
+
+
+def _mesh(axes):
+    import math
+    n = math.prod(axes.values())
+    return build_mesh(dict(axes), devices=jax.devices()[:n])
+
+
+@pytest.fixture
+def f32_compute():
+    # f32 parity run: bf16 reduction-order noise would otherwise smear
+    # the pipelined-vs-sequential comparison over update steps
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    yield
+    root.common.precision.compute_dtype = saved
+
+
+@pytest.mark.parametrize("axes", [{"pp": 2}, {"pp": 2, "dp": 2},
+                                  {"pp": 4, "dp": 2}])
+def test_pp_train_matches_unsharded(axes, f32_compute):
+    mesh = _mesh(axes)
+    ref_loader, ref_gd, ref_fw = _build_lm(None)
+    pp_loader, pp_gd, pp_fw = _build_lm(mesh)
+    _seed_params_from(ref_fw, pp_fw)
+    ref_losses = _steps(ref_loader, ref_gd, 3)
+    pp_losses = _steps(pp_loader, pp_gd, 3)
+    assert numpy.allclose(ref_losses, pp_losses, rtol=1e-4, atol=1e-4), \
+        (ref_losses, pp_losses)
+    # multi-step: parameters actually moved and stayed in lockstep
+    w0 = numpy.array(ref_fw[1].param_arrays()["wq"].map_read().mem)
+    wp = numpy.array(pp_fw[1].param_arrays()["wq"].map_read().mem)
+    assert numpy.allclose(w0, wp, rtol=1e-3, atol=1e-4)
+    assert not numpy.allclose(
+        w0, 0.0), "wq never initialized or never trained" 
+
+
+def test_pp_plan_validation():
+    mesh = _mesh({"pp": 3})
+    with pytest.raises(ValueError, match="stage-divisible"):
+        _build_lm(mesh, blocks=4)
+    mesh = _mesh({"pp": 2, "tp": 2})
+    with pytest.raises(ValueError, match="composes with dp"):
+        _build_lm(mesh, blocks=4)
+
+
+def test_pp_microbatch_validation():
+    mesh = _mesh({"pp": 2})
+    with pytest.raises(ValueError, match="microbatch"):
+        loader, gd, _ = _build_lm(mesh, mb=16)
+        gd.pp_microbatches = 5
+        gd._pp_plan_ = None
+        gd._pp_plan_ = gd._make_pp_plan()
+
+
+def test_pp_spans_train():
+    """The span-serving path (the perf path) pipelines too."""
+    mesh = _mesh({"pp": 2, "dp": 2})
+    loader, gd, fw = _build_lm(mesh)
+    loader.span_serving = True
+    for _ in range(4):
+        loader.run()
+        gd.run()
+    gd.loss.map_read()
+    assert numpy.isfinite(gd.loss.mem)
+
+
+def test_transformer_sample_trains_pp_dp():
+    """The product path: the transformer SAMPLE trains with
+    {'pp': 2, 'dp': 2} through the real workflow machinery."""
+    from veles_tpu.samples.transformer import TransformerWorkflow
+    root.transformer_tpu.update({
+        "mesh": {"pp": 2, "dp": -1}, "seq": 16, "dim": 16,
+        "heads": 2, "blocks": 2, "causal": True,
+        "minibatch_size": 16, "synthetic_train": 64,
+        "synthetic_valid": 16, "max_epochs": 1,
+        "snapshot_time_interval": 1e9})
+    try:
+        wf = TransformerWorkflow(None, plotters=False)
+        wf.initialize(device=Device(backend="numpy"))
+        assert wf.gd._pp_plan_ is not None \
+            and wf.gd._pp_plan_["stages"] == 2, \
+            "sample trainer did not build a pp plan"
+        wf.run()
+        wf.gd.loss.map_read()
+        assert numpy.isfinite(wf.gd.loss.mem)
+    finally:
+        root.transformer_tpu.mesh = None

@@ -118,6 +118,11 @@ class MultiHeadAttention(ForwardBase):
 
     x: [batch, seq, model_dim]."""
 
+    #: minibatch dim 1 is a SEQUENCE dim for this unit — the
+    #: trainer sp-shards data dim 1 only when a forward says so
+    #: (ADVICE.md r4 #2: sp sharding is opt-in)
+    SEQ_DIM1_INPUT = True
+
     PARAMS = ("wq", "wk", "wv", "wo")
 
     def __init__(self, workflow, heads=4, causal=False,

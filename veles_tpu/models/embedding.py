@@ -17,6 +17,11 @@ class Embedding(ForwardBase):
     parameter so tp/fsdp sharding conventions apply to it like any
     weight matrix."""
 
+    #: minibatch dim 1 is a SEQUENCE dim for this unit — the
+    #: trainer sp-shards data dim 1 only when a forward says so
+    #: (ADVICE.md r4 #2: sp sharding is opt-in)
+    SEQ_DIM1_INPUT = True
+
     PARAMS = ("weights", "positions")
 
     def __init__(self, workflow, vocab=None, dim=None,

@@ -48,7 +48,8 @@ class GradientDescent(AcceleratedUnit):
                  weights_decay=0.0, weights_decay_bias=None, l1_vs_l2=0.0,
                  gradient_moment=0.0, gradient_moment_bias=None,
                  lr_schedule="constant", lr_schedule_params=None,
-                 prng_key="trainer", mesh=None, augment=None, **kwargs):
+                 prng_key="trainer", mesh=None, augment=None,
+                 pp_microbatches=None, **kwargs):
         super(GradientDescent, self).__init__(workflow, **kwargs)
         #: jax.sharding.Mesh — when set, the fused step is sharded over
         #: it (dp batch split + psum, tp weight split; see
@@ -76,6 +77,10 @@ class GradientDescent(AcceleratedUnit):
         #: {"kind": "image", "pad": 4} survives snapshots (a raw
         #: callable works too but won't pickle)
         self.augment = augment
+        #: microbatches per pipeline step on a ``pp`` mesh (None →
+        #: the pp extent; larger shrinks the bubble fraction
+        #: (S-1)/(M+S-1) at the cost of smaller per-stage matmuls)
+        self.pp_microbatches = pp_microbatches
         self.prng = prng_mod.get(prng_key)
         self.lr_multiplier = 1.0  # Rollback adjusts this
 
@@ -107,6 +112,7 @@ class GradientDescent(AcceleratedUnit):
         self._train_step_ = None
         self._span_step_ = None
         self._shardings_ = None
+        self._pp_plan_ = None
         #: master-side epoch accumulator in float64: the master's device
         #: program never runs, and f32 accumulation of worker sample
         #: counts stops being exact past ~2^24 samples/epoch — the
@@ -178,6 +184,8 @@ class GradientDescent(AcceleratedUnit):
         if isinstance(self.evaluator, EvaluatorMSE) \
                 and getattr(self.loader, "minibatch_targets", None) is None:
             raise MissingDemand(self, {"loader.minibatch_targets"})
+        if self.mesh is not None and self.mesh.shape.get("pp", 1) > 1:
+            self._pp_plan_ = self._make_pp_plan()
         if self.mesh is not None \
                 and self.mesh.shape.get("sp", 1) > 1:
             # sequence parallelism is a COMMUNICATION SCHEDULE, not a
@@ -219,13 +227,115 @@ class GradientDescent(AcceleratedUnit):
                 for arr in slots.values():
                     arr.initialize(self.device)
 
+    # -- pipeline parallelism (pp first-class at the trainer, r5) --------------
+
+    def _make_pp_plan(self):
+        """Locate the pipelineable TRUNK — the longest contiguous run
+        of shape-preserving forwards with identical type/config/param
+        shapes (e.g. the TransformerBlock × N stack) — and split it
+        into ``pp`` stages.  SURVEY §2.3: every strategy a first-class
+        mesh-axis config; pp mirrors sp's r4 treatment (an explicit
+        communication schedule the trainer owns, param storage stays
+        replicated like sp/dp)."""
+        S = self.mesh.shape["pp"]
+        for ax in ("tp", "fsdp", "sp", "ep"):
+            if self.mesh.shape.get(ax, 1) > 1:
+                raise ValueError(
+                    "pp composes with dp only (got %s>1): shard the "
+                    "trunk over pp×dp, or drop the pp axis" % ax)
+
+        def signature(u):
+            return (type(u).__name__, repr(sorted(
+                u.export_config().items(), key=str)),
+                tuple(sorted((n, a.mem.shape)
+                             for n, a in u.param_arrays().items())))
+
+        best = (0, 0)
+        i = 0
+        units = self.forwards
+        while i < len(units):
+            u = units[i]
+            if isinstance(u, DropoutForward) \
+                    or tuple(u.input.shape) != tuple(u.output.shape):
+                i += 1
+                continue
+            j = i
+            sig = signature(u)
+            while j < len(units) and not isinstance(
+                    units[j], DropoutForward) \
+                    and tuple(units[j].input.shape) == tuple(
+                        units[j].output.shape) \
+                    and signature(units[j]) == sig:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j
+        start, end = best
+        n = end - start
+        if n < S or n % S:
+            raise ValueError(
+                "pp=%d needs a homogeneous shape-preserving trunk with "
+                "a stage-divisible length; found %d matching units "
+                "(forwards[%d:%d]) — use a layer count divisible by pp"
+                % (S, n, start, end))
+        n_micro = int(self.pp_microbatches or S)
+        mb = self.loader.max_minibatch_size
+        dp_total = self.mesh.shape.get("dp", 1)  # fsdp rejected above
+        per_dev = mb // dp_total
+        if mb % dp_total or per_dev % n_micro:
+            raise ValueError(
+                "minibatch %d must divide into dp extent %d and then "
+                "into %d pp microbatches per dp slice"
+                % (mb, dp_total, n_micro))
+        batch_axes = ("dp",) if dp_total > 1 else ()
+        return {"start": start, "end": end, "stages": S,
+                "n_micro": n_micro, "batch_axes": batch_axes}
+
+    def _pp_trunk_apply(self, params, h):
+        """Stack the trunk units' params stage-major and run the GPipe
+        schedule (parallel/pipeline.gpipe_train) inside the fused
+        step — fwd, bwd (transposed ppermute schedule) and the solver
+        update share one XLA program."""
+        from veles_tpu.parallel.pipeline import gpipe_train
+        plan = self._pp_plan_
+        start, end, S = plan["start"], plan["end"], plan["stages"]
+        trunk = self.forwards[start:end]
+        k = len(trunk) // S
+        stacked = {
+            j: {name: jnp.stack(
+                [params[start + s * k + j][name] for s in range(S)])
+                for name in params[start]}
+            for j in range(k)}
+        unit0 = trunk[0]
+
+        def stage_fn(stage_params, h):
+            for j in range(k):
+                p = stage_params[j]
+                if getattr(unit0, "remat", False):
+                    h = jax.checkpoint(unit0.apply)(p, h)
+                else:
+                    h = unit0.apply(p, h)
+            return h
+
+        return gpipe_train(self.mesh, stage_fn, stacked, h,
+                           plan["n_micro"],
+                           batch_axes=plan["batch_axes"])
+
     # -- the fused program -----------------------------------------------------
 
     def _forward(self, params, x, key, train):
         """Compose the chain; returns the trainer-facing head output
-        (logits for a softmax head)."""
+        (logits for a softmax head).  On a ``pp`` mesh the trunk runs
+        the GPipe schedule; pre/post units run replicated."""
         h = x
-        for i, u in enumerate(self.forwards):
+        plan = self._pp_plan_
+        i = 0
+        while i < len(self.forwards):
+            if plan is not None and i == plan["start"]:
+                h = self._pp_trunk_apply(params, h)
+                i = plan["end"]
+                continue
+            u = self.forwards[i]
             p = {name: params[i][name] for name in params[i]}
             if isinstance(u, DropoutForward):
                 if train:
@@ -242,6 +352,7 @@ class GradientDescent(AcceleratedUnit):
                 h = jax.checkpoint(u.apply)(p, h)
             else:
                 h = u.apply(p, h)
+            i += 1
         return h
 
     def _target_of(self, labels, targets):
@@ -401,12 +512,17 @@ class GradientDescent(AcceleratedUnit):
                         opt_sh[i][name][s] = shlib.replicated(mesh)
         mb = self.loader.max_minibatch_size
         x_shape = self.loader.minibatch_data.shape
-        # dim 1 of the DATA minibatch is the sequence dim for sp
-        # sharding (targets/labels stay sp-replicated: dim 1 there is
-        # a feature dim, not a sequence dim)
+        # dim 1 of the DATA minibatch is a sequence dim ONLY when the
+        # FIRST forward consumes it as one (SEQ_DIM1_INPUT on the unit
+        # class — attention/transformer/embedding/recurrent); image
+        # workflows' dim 1 is height and must not sp-shard, even if a
+        # sequence unit appears later in the chain (ADVICE.md r4 #2)
+        has_seq = bool(self.forwards) and getattr(
+            self.forwards[0], "SEQ_DIM1_INPUT", False)
         x_sh = shlib.batch_sharding(
             mesh, len(x_shape), dim0=mb,
-            seq_dim1=x_shape[1] if len(x_shape) >= 2 else None)
+            seq_dim1=x_shape[1]
+            if has_seq and len(x_shape) >= 2 else None)
         tgt_ndim = len(self.loader.minibatch_targets.shape) \
             if isinstance(self.evaluator, EvaluatorMSE) \
             else len(self.loader.minibatch_labels.shape)

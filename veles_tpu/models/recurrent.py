@@ -20,6 +20,11 @@ from veles_tpu.ops.gemm import matmul
 class SimpleRNN(ForwardBase):
     """h_t = tanh(x_t·Wx + h_{t-1}·Wh + b)."""
 
+    #: minibatch dim 1 is a SEQUENCE dim for this unit — the
+    #: trainer sp-shards data dim 1 only when a forward says so
+    #: (ADVICE.md r4 #2: sp sharding is opt-in)
+    SEQ_DIM1_INPUT = True
+
     PARAMS = ("wx", "wh", "bias")
 
     def __init__(self, workflow, hidden=None, **kwargs):
@@ -60,6 +65,11 @@ class SimpleRNN(ForwardBase):
 class LSTM(ForwardBase):
     """Standard LSTM (i, f, g, o gates; one fused [f+h, 4h] GEMM per
     step rides the MXU)."""
+
+    #: minibatch dim 1 is a SEQUENCE dim for this unit — the
+    #: trainer sp-shards data dim 1 only when a forward says so
+    #: (ADVICE.md r4 #2: sp sharding is opt-in)
+    SEQ_DIM1_INPUT = True
 
     PARAMS = ("weights", "bias")
 

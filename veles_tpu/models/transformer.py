@@ -35,6 +35,11 @@ class TransformerBlock(ForwardBase):
     ``n_experts`` switches the FFN to a top-k MoE (dense einsum
     dispatch, expert-major params on the ``ep`` axis)."""
 
+    #: minibatch dim 1 is a SEQUENCE dim for this unit — the
+    #: trainer sp-shards data dim 1 only when a forward says so
+    #: (ADVICE.md r4 #2: sp sharding is opt-in)
+    SEQ_DIM1_INPUT = True
+
     BASE_PARAMS = ("ln1_scale", "ln1_bias", "wq", "wk", "wv", "wo",
                    "ln2_scale", "ln2_bias")
 

@@ -95,6 +95,44 @@ def gpipe_apply(stage_fn, stacked_params, microbatches, axis_name):
     return jax.lax.psum(outputs, axis_name)
 
 
+def gpipe_train(mesh, stage_fn, stacked_params, x, n_micro,
+                axis="pp", batch_axes=None):
+    """Trace-friendly GPipe: runs INSIDE a jitted (and differentiable)
+    program — no device_put, shardings applied as constraints.  The
+    trainer (models/gd.py) calls this from its fused step, so the
+    pipeline's backward (the transposed ppermute schedule) and the
+    solver update live in the same XLA program.
+
+    - ``stacked_params``: pytree with leading stage dim (traced
+      values); constrained to P(axis) here;
+    - ``x``: [batch, ...] activations entering stage 0;
+    - ``batch_axes``: data-parallel mesh axes the batch dim is sharded
+      over (pp×dp composition — each dp slice runs its own bubble
+      schedule).
+
+    Returns [batch, ...] outputs of the last stage, replicated over
+    ``axis``."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax import shard_map
+
+    if x.shape[0] % n_micro:
+        raise ValueError("batch %d not divisible into %d microbatches"
+                         % (x.shape[0], n_micro))
+    micro = x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
+    stage_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+    stacked = jax.lax.with_sharding_constraint(
+        stacked_params,
+        jax.tree.map(lambda s: NamedSharding(mesh, s), stage_spec))
+    mb_spec = P(None, tuple(batch_axes)) if batch_axes else P()
+    micro = jax.lax.with_sharding_constraint(
+        micro, NamedSharding(mesh, mb_spec))
+    fn = shard_map(
+        functools.partial(gpipe_apply, stage_fn, axis_name=axis),
+        mesh=mesh, in_specs=(stage_spec, mb_spec), out_specs=mb_spec)
+    out = fn(stacked, micro)
+    return out.reshape((x.shape[0],) + out.shape[2:])
+
+
 def pipeline_forward(mesh, stage_fn, per_stage_params, x, n_micro,
                      axis="pp", batch_axes=None):
     """Convenience wrapper: stack params, microbatch x [batch, ...],
