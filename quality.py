@@ -49,26 +49,43 @@ RUNS = {
     "mnist_mlp": {
         "workflow": "veles_tpu/samples/mnist.py",
         "config": "veles_tpu/samples/mnist_config.py",
+        # r5 recipe (VERDICT r4 #5): shift augmentation on the flat
+        # minibatch (the augment op reshapes via 'shape') + warmup-
+        # then-cosine + longer patience — tuning run measured 1.11%
+        # min / 1.24% final (r4 recipe: 1.76 vs the published 1.48)
         "overrides": (
             "root.mnist_tpu.update({"
             "'synthetic_kind': 'glyphs',"
             "'synthetic_train': 60000, 'synthetic_valid': 10000,"
             "'minibatch_size': 128, 'learning_rate': 0.1,"
-            "'gradient_moment': 0.9, 'fail_iterations': 40,"
-            "'max_epochs': 200, 'snapshot_time_interval': 1e9})"),
-        "target": "validation_error_pct <= 2.0 (VERDICT r2 #3)",
+            "'gradient_moment': 0.9, 'fail_iterations': 60,"
+            "'max_epochs': 250, 'snapshot_time_interval': 1e9,"
+            "'augment': {'kind': 'image', 'flip': False, 'pad': 2,"
+            "            'shape': (28, 28, 1)},"
+            "'lr_schedule': 'cosine',"
+            "'lr_schedule_params': {'total_steps': 50000,"
+            "                       'floor': 0.03, 'warmup': 300}})"),
+        "target": "validation_error_pct <= 1.48 (the published "
+                  "number, VERDICT r4 #5)",
     },
     "cifar_conv": {
         "workflow": "veles_tpu/samples/cifar.py",
         "config": "veles_tpu/samples/cifar_config.py",
+        # r5 recipe (VERDICT r4 #5): the STL-10 machinery at full
+        # data — flip + pad-4 crop + warmup-then-cosine + patience 60
         "overrides": (
             "root.cifar_tpu.update({"
             "'synthetic_kind': 'scenes',"
             "'synthetic_train': 50000, 'synthetic_valid': 10000,"
             "'minibatch_size': 128,"  # solver/lr: the sample's adam
-            "'fail_iterations': 30, 'max_epochs': 150,"
+            "'fail_iterations': 60, 'max_epochs': 250,"
+            "'augment': {'kind': 'image', 'flip': True, 'pad': 4},"
+            "'lr_schedule': 'cosine',"
+            "'lr_schedule_params': {'total_steps': 70000,"
+            "                       'floor': 0.03, 'warmup': 500},"
             "'snapshot_time_interval': 1e9})"),
-        "target": "validation_error_pct toward the 17.21 band",
+        "target": "validation_error_pct <= 17.21 (the published "
+                  "number, VERDICT r4 #5)",
     },
     "stl10_conv": {
         "workflow": "veles_tpu/samples/cifar.py",

@@ -10,6 +10,28 @@ import os
 import sys
 
 
+
+def _resume_loader_cls():
+    """Module-level loader class (locally-defined loaders don't
+    pickle — framework gotcha)."""
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+
+    class ResumeLoader(FullBatchLoader):
+        def load_data(self):
+            import numpy
+            r = numpy.random.default_rng(3)
+            n = 64
+            self.class_lengths[:] = [0, 16, 48]
+            self.original_data = r.normal(
+                size=(n, 12)).astype(numpy.float32)
+            self.original_labels = r.integers(0, 4, n).tolist()
+
+    ResumeLoader.__module__ = __name__
+    ResumeLoader.__qualname__ = "RESUME_LOADER"
+    globals()["RESUME_LOADER"] = ResumeLoader
+    return ResumeLoader
+
+
 def main():
     coordinator, nproc, pid = (sys.argv[1], int(sys.argv[2]),
                                int(sys.argv[3]))
@@ -53,6 +75,40 @@ def main():
     gd.run()
     gd.loss.map_read()
     print("PROOF loss=%.6f" % float(gd.loss.mem), flush=True)
+
+    # 3. mesh-sharded snapshot RESUME across the gang (r4's multi-
+    # host-aware mesh rebuild, gd.py initialize): train → pickle
+    # (the Mesh persists as its axis spec) → restore → the rebuilt
+    # mesh spans every process's devices → continue training
+    import pickle
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.models.standard import build_mlp_classifier
+    wf = AcceleratedWorkflow(None, name="mh-resume")
+    ResumeLoader = _resume_loader_cls()
+    loader2 = ResumeLoader(wf, minibatch_size=16)
+    _, layers2, ev2, gd2 = build_mlp_classifier(
+        dev, loader2, hidden=(8,), classes=4, workflow=wf,
+        mesh=mesh, gradient_moment=0.9)
+    loader2.run()
+    gd2.run()
+    blob = pickle.dumps(wf)
+    wf3 = pickle.loads(blob)
+    from veles_tpu.models.gd import GradientDescent
+    gd3 = next(u for u in wf3.units
+               if isinstance(u, GradientDescent))
+    loader3 = next(u for u in wf3.units if hasattr(u, "load_data"))
+    assert isinstance(gd3.mesh, dict), \
+        "mesh must pickle as its axis spec, got %r" % (gd3.mesh,)
+    for u in wf3.units:
+        u.initialize(device=dev)
+    assert dict(gd3.mesh.shape) == {"dp": 4}, dict(gd3.mesh.shape)
+    assert any(d.process_index != got_pid
+               for d in gd3.mesh.devices.flat), \
+        "rebuilt mesh does not span the other process's devices"
+    loader3.run()
+    gd3.run()
+    gd3.loss.map_read()
+    print("PROOF resumed_loss=%.6f" % float(gd3.loss.mem), flush=True)
     multihost.sync_global_devices("done")
     return 0
 

@@ -58,10 +58,16 @@ def test_two_process_gang_trains():
             "worker %d rc=%s:\n%s" % (i, p.returncode, out[-1500:])
         proofs.append(dict(
             l.split(" ", 1)[1].split("=", 1) for l in lines
-            if l.startswith(("PROOF sum=", "PROOF loss="))))
+            if l.startswith(("PROOF sum=", "PROOF loss=",
+                             "PROOF resumed_loss="))))
     # gang assembled: 4 global devices, 2 local each
     for i, out in enumerate(outs):
         assert "process %d/2 devices=4 local=2" % i in outs[i]
     # the sharded collective and the full train step agree bitwise
     assert proofs[0]["sum"] == proofs[1]["sum"] == "120.0"
     assert proofs[0]["loss"] == proofs[1]["loss"]
+    # the mesh-sharded snapshot resumed across the gang (r4's
+    # multi-host-aware mesh rebuild) and kept training in lockstep
+    assert "resumed_loss" in proofs[0], outs[0][-800:]
+    assert proofs[0]["resumed_loss"] == proofs[1]["resumed_loss"]
+    assert float(proofs[0]["resumed_loss"]) != 0.0

@@ -58,10 +58,14 @@ def test_dv_neq_dqk():
                                   numpy.asarray(ref), atol=2e-5)
 
 
-def test_block_divisibility_error():
+def test_non_divisible_seq_pads_and_masks():
+    # r5: odd lengths no longer raise — they pad to block multiples
+    # and mask (the old ValueError contract is gone)
+    from veles_tpu.ops.attention import attention as dense_attention
     q, k, v = _qkv(s=60)
-    with pytest.raises(ValueError):
-        pallas_attention(q, k, v, block_q=32, block_k=32)
+    out = pallas_attention(q, k, v, block_q=32, block_k=32)
+    ref = dense_attention(q, k, v)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
 
 
 def test_mha_apply_pallas_impl():
@@ -76,3 +80,48 @@ def test_mha_apply_pallas_impl():
     ref = mha_apply(params, x, heads, True, attn_impl="dense")
     numpy.testing.assert_allclose(numpy.asarray(out),
                                   numpy.asarray(ref), atol=5e-2)
+
+
+class TestOddLengthsAndDmaSkip:
+    """r5: pad-and-mask entry (odd sequence lengths keep the native
+    kernels) and the clamped causal index maps."""
+
+    def _qkv(self, seq, heads=2, dim=64, batch=2, seed=0, seq_k=None):
+        rng = numpy.random.default_rng(seed)
+        shape_q = (batch, seq, heads, dim)
+        shape_k = (batch, seq_k or seq, heads, dim)
+        q = jnp.asarray(rng.standard_normal(shape_q), jnp.float32)
+        k = jnp.asarray(rng.standard_normal(shape_k), jnp.float32)
+        v = jnp.asarray(rng.standard_normal(shape_k), jnp.float32)
+        return q, k, v
+
+    @pytest.mark.parametrize("seq", [1000, 1536, 100, 17])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_odd_seq_matches_dense(self, seq, causal):
+        from veles_tpu.ops.attention import attention as dense_attention
+        q, k, v = self._qkv(seq)
+        out = pallas_attention(q, k, v, causal=causal)
+        ref = dense_attention(q, k, v, causal=causal)
+        assert out.shape == ref.shape
+        assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+    def test_odd_seq_gradients(self):
+        from veles_tpu.ops.attention import attention as dense_attention
+        q, k, v = self._qkv(100)
+
+        def f(fn):
+            def loss(q, k, v):
+                return jnp.sum(fn(q, k, v, causal=True) ** 2)
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        gp = f(pallas_attention)
+        gr = f(dense_attention)
+        for a, b in zip(gp, gr):
+            assert float(jnp.max(jnp.abs(a - b))) < 5e-5
+
+    def test_cross_lengths(self):
+        from veles_tpu.ops.attention import attention as dense_attention
+        q, k, v = self._qkv(96, seq_k=200)
+        out = pallas_attention(q, k, v, causal=False)
+        ref = dense_attention(q, k, v, causal=False)
+        assert float(jnp.max(jnp.abs(out - ref))) < 2e-5

@@ -16,10 +16,7 @@ import numpy
 
 from veles_tpu.models.nn_units import ForwardBase
 
-#: auto-select boundary: the native pallas kernels win below it, the
-#: jax flash kernel's masked-block DMA skip wins above (measured at
-#: seq 2048 and 32768 — ROUND4_NOTES.md §1b)
-AUTO_NATIVE_MAX_SEQ = 4096
+
 
 
 def _ring_mha(mesh, q, k, v, causal):
@@ -52,10 +49,10 @@ def mha_apply(params, x, heads, causal, block_size=None, sp_mesh=None,
     - ``sp_mesh`` with an sp axis > 1 → the ppermute RING (sequence
       parallelism is a communication schedule, it overrides the rest);
     - ``attn_impl`` "flash" | "blockwise" | "dense" → that core;
-    - default (None/"auto") → the pallas flash kernel when it applies
-      (TPU, block-aligned seq, lane-multiple head_dim — ops/flash.py),
-      else blockwise streaming if ``block_size`` says so, else the
-      plain single-program form."""
+    - default (None/"auto") → the framework's NATIVE pallas flash
+      kernels on TPU at any sequence length (lane-multiple head_dim;
+      ops/pallas_attention.py), else blockwise streaming if
+      ``block_size`` says so, else the plain single-program form."""
     import jax.numpy as jnp
 
     from veles_tpu import dtypes
@@ -78,16 +75,19 @@ def mha_apply(params, x, heads, causal, block_size=None, sp_mesh=None,
     else:
         impl = attn_impl or "auto"
         if impl == "auto":
-            from veles_tpu.ops.flash import flash_available
-            if flash_available((b, s, heads, hd), backend=backend):
-                # measured split (ROUND4_NOTES.md §1b): the NATIVE
-                # kernels beat the jax-shipped flash kernel at
-                # moderate sequence lengths (6.3 vs 7.1 ms at seq
-                # 2048), but the jax kernel's masked-block DMA skip
-                # wins at long sequences (32 vs 49 ms at 32k) — auto
-                # picks by sequence length; attn_impl pins override
-                impl = "pallas" if s <= AUTO_NATIVE_MAX_SEQ \
-                    else "flash"
+            from veles_tpu.ops.common import resolve_backend, \
+                ACCEL_PLATFORMS
+            # the NATIVE kernels are the default at EVERY length (r5:
+            # clamped causal index maps skip dead-block DMAs and
+            # 1024-token K blocks fix the long-context bookkeeping —
+            # measured past the jax-shipped kernel at 2048, 8192 AND
+            # 32768; ROUND5_NOTES.md §5).  Odd lengths pad-and-mask
+            # inside the kernel.  head_dim off the lane width falls
+            # back (the MXU would run mostly idle); attn_impl pins
+            # either kernel explicitly.
+            if resolve_backend(backend) in ACCEL_PLATFORMS \
+                    and hd % 128 == 0:
+                impl = "pallas"
             else:
                 impl = "blockwise" if block_size else "dense"
         q, k, v = (proj(params[n]) for n in ("wq", "wk", "wv"))
@@ -98,7 +98,8 @@ def mha_apply(params, x, heads, causal, block_size=None, sp_mesh=None,
         elif impl == "pallas":
             # the framework's OWN flash kernels (ops/pallas_attention)
             from veles_tpu.ops.pallas_attention import pallas_attention
-            o = pallas_attention(q, k, v, causal=causal)
+            o = pallas_attention(q, k, v, causal=causal,
+                                 backend=backend)
         elif impl == "blockwise":
             from veles_tpu.ops.attention import blockwise_attention
             o = blockwise_attention(q, k, v, block_size or 512,

@@ -61,11 +61,17 @@ class CosineLR:
         self.warmup = warmup
 
     def __call__(self, step):
-        frac = jnp.clip(step / self.total_steps, 0.0, 1.0)
+        # warmup-THEN-cosine (ADVICE.md r4 #4): the linear ramp runs to
+        # the full peak multiplier, and the cosine phase starts at the
+        # end of warmup — not a ramp multiplied onto an already-decaying
+        # cosine, which never reaches 1.0
+        denom = max(self.total_steps - self.warmup, 1)
+        frac = jnp.clip((step - self.warmup) / denom, 0.0, 1.0)
         mult = self.floor + (1.0 - self.floor) * 0.5 * (
             1.0 + jnp.cos(jnp.pi * frac))
         if self.warmup:
-            mult = mult * jnp.clip(step / self.warmup, 0.0, 1.0)
+            mult = jnp.where(step < self.warmup,
+                             step / self.warmup, mult)
         return mult
 
 

@@ -12,13 +12,19 @@ import jax
 import jax.numpy as jnp
 
 
-def image_augment(flip=True, pad=0, cutout=0):
+def image_augment(flip=True, pad=0, cutout=0, shape=None):
     """The classic small-image recipe: random horizontal flip +
     random crop after reflect-padding ``pad`` pixels + optional
     ``cutout``-sized random erase.  Returns ``fn(x, key)`` for
-    [batch, h, w, c] inputs."""
+    [batch, h, w, c] inputs — or for FLAT [batch, features]
+    minibatches when ``shape=(h, w, c)`` is given (MLP pipelines like
+    the MNIST sample keep their data flat; the augment reshapes in
+    and out around the spatial ops)."""
 
     def fn(x, key):
+        flat_in = shape is not None and x.ndim == 2
+        if flat_in:
+            x = x.reshape((x.shape[0],) + tuple(shape))
         b, h, w, c = x.shape
         kf, kc, ku = jax.random.split(key, 3)
         if flip:
@@ -48,6 +54,8 @@ def image_augment(flip=True, pad=0, cutout=0):
                     & (xx >= cx[:, None, None])
                     & (xx < cx[:, None, None] + cutout))
             x = jnp.where(mask[..., None], 0.0, x)
+        if flat_in:
+            x = x.reshape(b, h * w * c)
         return x
 
     return fn

@@ -34,10 +34,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK = 512
+#: 1024-token K blocks HALVE the per-block online-softmax bookkeeping
+#: rounds (the m/l/acc rescale runs on lane-replicated [bq, 128]
+#: scratch, so its cost rivals the matmuls at small batch×heads) —
+#: measured faster than 512 at every length, and past the jax-shipped
+#: kernel at 32k (32.3 vs 38.3 ms; ROUND5_NOTES.md §5)
+DEFAULT_BLOCK = 1024
 #: larger Q blocks amortize the K/V streaming (21% on the jax kernel
-#: at head_dim 128 — ROUND4_NOTES.md); callers fall back to 512 when
-#: seq doesn't divide 1024
+#: at head_dim 128 — ROUND4_NOTES.md)
 DEFAULT_BLOCK_Q = 1024
 #: finite stand-in for -inf: exp(x - max) underflows to 0 for masked
 #: entries without generating nan through (-inf) - (-inf)
@@ -49,19 +53,70 @@ _LANES = 128
 from veles_tpu.ops.common import use_interpret as _use_interpret
 
 
-def _mask(s, q_base, k_base, block_q, block_k):
+def _mask(s, q_base, k_base, block_q, block_k, causal, kv_len):
+    """Causal and/or K-length masking of a score block.  ``kv_len``
+    is the REAL key length — block-padded tail columns (the
+    pad-and-mask entry for odd sequence lengths) mask away here."""
     rows = q_base + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     cols = k_base + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return jnp.where(cols <= rows, s, _NEG_INF)
+    keep = cols < kv_len
+    if causal:
+        keep &= cols <= rows
+    return jnp.where(keep, s, _NEG_INF)
+
+
+def _masked_scores(s, q_base, k_base, block_q, block_k, causal,
+                   kv_len):
+    """Apply causal/tail masking to a score block, but make the
+    masking straight-line (see the note below)."""
+    tail = kv_len % block_k != 0      # static: padded K tail exists
+    if not causal and not tail:
+        return s
+    # NOTE a lax.cond that skips the mask on sub-diagonal blocks was
+    # measured SLOWER at every length (12.0 vs 11.4 ms at seq 2048,
+    # 55.7 vs 42.3 at 32k) — Mosaic's branch disrupts the pipeline
+    # more than the unconditional mask costs; keep it straight-line
+    return _mask(s, q_base, k_base, block_q, block_k, causal, kv_len)
+
+
+def _clamp_maps(block_q, block_k, causal):
+    """Index maps for the K/V streams of a (bh, q, k) grid.  For the
+    causal case the K index CLAMPS to the diagonal block: grid steps
+    past the diagonal re-request the same block, and pallas skips the
+    DMA for a repeated index — causally dead K/V blocks are never
+    fetched (the r4 gap vs the jax kernel at long context:
+    ROUND4_NOTES.md §1b named this as the next step)."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+
+    def kv_map(b, i, j):
+        j_max = ((i + 1) * block_q - 1) // block_k
+        return (b, jnp.minimum(j, j_max), 0)
+
+    return kv_map
+
+
+def _clamp_maps_dkv(block_q, block_k, causal):
+    """Index maps for the Q/dO/O/lse streams of a (bh, k, q) grid:
+    the Q index clamps UP to the first block at-or-past the diagonal,
+    so leading dead steps re-request that block (one DMA, no more)."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+
+    def q_map(b, i, j):
+        j_min = (i * block_k) // block_q
+        return (b, jnp.maximum(j, j_min), 0)
+
+    return q_map
 
 
 # -- forward ----------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 acc_ref, m_ref, l_ref, *, scale, causal,
-                block_q, block_k):
+                block_q, block_k, kv_len):
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
     q_base = pl.program_id(1) * block_q
@@ -81,8 +136,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
-        if causal:
-            s = _mask(s, q_base, k_base, block_q, block_k)
+        s = _masked_scores(s, q_base, k_base, block_q, block_k,
+                           causal, kv_len)
         m_prev = m_ref[:, 0]                      # [bq]
         m_cur = jnp.maximum(m_prev, s.max(axis=1))
         alpha = jnp.exp(m_prev - m_cur)
@@ -113,19 +168,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
-def _run_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    """q/k/v: [bh, seq, d] → (o [bh, sq, dv],
-    lse [bh, sq, 128] f32 lane-replicated)."""
+def _run_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+             kv_len):
+    """q/k/v: [bh, seq, d] (block-padded) → (o [bh, sq, dv],
+    lse [bh, sq, 128] f32 lane-replicated); ``kv_len`` = real key
+    length for tail masking."""
     bh, sq, d = q.shape
     sk, dv = k.shape[1], v.shape[2]
+    kv_map = _clamp_maps(block_q, block_k, causal)
     return pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k,
+                          kv_len=kv_len),
         grid=(bh, sq // block_q, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, dv), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, dv), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, dv), lambda b, i, j: (b, i, 0)),
@@ -149,7 +208,7 @@ def _run_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                    dq_ref, acc_ref, *, scale, causal, block_q,
-                   block_k):
+                   block_k, kv_len):
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
     q_base = pl.program_id(1) * block_q
@@ -171,8 +230,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _mask(s, q_base, k_base, block_q, block_k)
+        s = _masked_scores(s, q_base, k_base, block_q, block_k,
+                           causal, kv_len)
         p = jnp.exp(s - lse_ref[0][:, 0][:, None])    # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -196,7 +255,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                     dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, scale,
-                    causal, block_q, block_k):
+                    causal, block_q, block_k, kv_len):
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
     q_base = qi * block_q
@@ -217,8 +276,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            s = _mask(s, q_base, k_base, block_q, block_k)
+        s = _masked_scores(s, q_base, k_base, block_q, block_k,
+                           causal, kv_len)
         p = jnp.exp(s - lse_ref[0][:, 0][:, None])
         dv_acc_ref[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -246,31 +305,37 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
 # -- custom_vjp wiring ------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _mha(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, _ = _mha_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _mha(q, k, v, scale, causal, block_q, block_k, interpret, kv_len):
+    o, _ = _mha_fwd(q, k, v, scale, causal, block_q, block_k,
+                    interpret, kv_len)
     return o
 
 
-def _mha_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _mha_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+             kv_len):
     o, lse = _run_fwd(q, k, v, scale, causal, block_q, block_k,
-                      interpret)
+                      interpret, kv_len)
     return o, (q, k, v, o, lse)
 
 
-def _mha_bwd(scale, causal, block_q, block_k, interpret, res, do):
+def _mha_bwd(scale, causal, block_q, block_k, interpret, kv_len, res,
+             do):
     q, k, v, o, lse = res
     bh, sq, d = q.shape
     sk, dv = k.shape[1], v.shape[2]
 
+    kv_map = _clamp_maps(block_q, block_k, causal)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k,
+                          kv_len=kv_len),
         grid=(bh, sq // block_q, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, dv), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, dv), kv_map),
             pl.BlockSpec((1, block_q, dv), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, dv), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, _LANES),
@@ -283,18 +348,19 @@ def _mha_bwd(scale, causal, block_q, block_k, interpret, res, do):
         interpret=interpret,
     )(q, k, v, do, o, lse)
 
+    q_map = _clamp_maps_dkv(block_q, block_k, causal)
     dk, dv_out = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k,
+                          kv_len=kv_len),
         grid=(bh, sk // block_k, sq // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, dv), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, dv), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, dv), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, _LANES),
-                         lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, dv), q_map),
+            pl.BlockSpec((1, block_q, dv), q_map),
+            pl.BlockSpec((1, block_q, _LANES), q_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
@@ -320,29 +386,41 @@ def pallas_attention(q, k, v, causal=False, scale=None,
                      block_q=None, block_k=DEFAULT_BLOCK,
                      backend=None):
     """Exact attention via the native pallas kernels.  q/k/v:
-    [batch, seq, heads, head_dim] (framework layout).  Sequence
-    lengths must divide the block sizes (the default Q block drops
-    1024 → 512 when seq doesn't divide 1024); head_dim should be a
-    lane multiple for real-hardware performance.  ``backend`` is the
-    platform of the TARGET device (see ops.common.use_interpret) —
-    callers that know their device must pass it (ADVICE.md r4 #1)."""
+    [batch, seq, heads, head_dim] (framework layout).  ANY sequence
+    length runs the fast path (odd lengths pad-and-mask to block
+    multiples in-kernel); head_dim should be a lane multiple for
+    real-hardware performance.  Causally dead K/V blocks are never
+    FETCHED (clamped index maps — pallas skips the DMA on a repeated
+    block index), so long-context cost scales with the triangle, not
+    the square.  ``backend`` is the platform of the TARGET device
+    (see ops.common.use_interpret) — callers that know their device
+    must pass it (ADVICE.md r4 #1)."""
     b, sq, h, d = q.shape
     sk, dv = k.shape[1], v.shape[3]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     if block_q is None:
-        block_q = DEFAULT_BLOCK_Q if sq % DEFAULT_BLOCK_Q == 0 \
-            else DEFAULT_BLOCK
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
-    if sq % bq or sk % bk:
-        raise ValueError("seq (%d, %d) must divide the blocks (%d, %d)"
-                         % (sq, sk, bq, bk))
+        block_q = DEFAULT_BLOCK_Q
+    bq = min(block_q, max(sq, 16))
+    bk = min(block_k, max(sk, 16))
+    # pad-and-mask (VERDICT r4 #7): odd sequence lengths keep the
+    # fast path — Q/K/V zero-pad up to block multiples, the kernels
+    # mask tail K columns via kv_len, and the output slices back.
+    # Zero-padded Q rows produce garbage outputs that are sliced
+    # away, and their backward contributions vanish because the
+    # padded cotangent rows are zero.
+    sq_p = -(-sq // bq) * bq
+    sk_p = -(-sk // bk) * bk
 
-    def flat(t):
-        return jnp.swapaxes(t, 1, 2).reshape(b * h, t.shape[1],
-                                             t.shape[3])
+    def flat(t, seq_to):
+        t = jnp.swapaxes(t, 1, 2).reshape(b * h, t.shape[1],
+                                          t.shape[3])
+        if t.shape[1] != seq_to:
+            t = jnp.pad(t, ((0, 0), (0, seq_to - t.shape[1]), (0, 0)))
+        return t
 
-    o = _mha(flat(q), flat(k), flat(v), float(scale), bool(causal),
-             bq, bk, _use_interpret(backend))
+    o = _mha(flat(q, sq_p), flat(k, sk_p), flat(v, sk_p),
+             float(scale), bool(causal), bq, bk,
+             _use_interpret(backend), sk)
+    o = o[:, :sq]
     return jnp.swapaxes(o.reshape(b, h, sq, dv), 1, 2)

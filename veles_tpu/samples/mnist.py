@@ -118,6 +118,12 @@ class MnistWorkflow(StandardWorkflow):
             learning_rate=float(cfg.get("learning_rate", 0.1)),
             gradient_moment=float(cfg.get("gradient_moment", 0.9)),
             weights_decay=float(cfg.get("weights_decay", 0.0)),
+            # r5 quality recipe knobs (mirrors the cifar sample):
+            # in-graph augmentation (flat minibatches reshape via
+            # 'shape') and an lr schedule
+            augment=cfg.get_dict("augment"),
+            lr_schedule=cfg.get("lr_schedule", "constant"),
+            lr_schedule_params=cfg.get_dict("lr_schedule_params") or {},
             decision_config={
                 "fail_iterations": int(cfg.get("fail_iterations", 25)),
                 "max_epochs": cfg.get("max_epochs"),
