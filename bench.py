@@ -89,13 +89,12 @@ def training_flops_per_sample(forwards):
     for u in forwards:
         if isinstance(u, Conv):
             _, h, w, k = u.output.shape
-            cin = u.input.shape[-1]
-            if getattr(u, "space_to_depth", 0):
-                # blocked stem: MODEL flops count the logical conv
-                # (the block padding is implementation cost, not
-                # model work — keeps MFU honest)
-                cin //= u.space_to_depth ** 2
-            total += 2.0 * h * w * k * (u.kx * u.ky * cin / u.n_groups)
+            # taps per output from the LOGICAL kernel tensor
+            # [ky, kx, cin/groups, out] — correct for plain, grouped
+            # and space_to_depth stems alike (the blocked stem's pad
+            # taps are implementation cost, not model flops)
+            ky, kx, cin_g, _ = u.weights.mem.shape
+            total += 2.0 * h * w * k * (ky * kx * cin_g)
         elif isinstance(u, All2All):
             fan_in = int(numpy.prod(u.input.shape[1:]))
             total += 2.0 * fan_in * u.neurons_number

@@ -77,6 +77,23 @@ def test_s2d_validation():
              padding="valid", n_groups=2, space_to_depth=4)
 
 
+def test_s2d_flat_input_matches():
+    """Flat [B, hb*wb*n^2*C] input (the fast-gather dataset layout)
+    reshapes in-graph and matches the strided conv exactly."""
+    rng = numpy.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((2, 227, 227, 3)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((11, 11, 3, 8)) * 0.1,
+                    jnp.float32)
+    y_ref = _apply_conv(x, w, None, n_kernels=8, kx=11, ky=11,
+                        sliding=(4, 4), padding="valid")
+    xb = space_to_depth(x, 4).reshape(2, -1)
+    y = _apply_conv(xb, w, None, n_kernels=8, kx=11, ky=11,
+                    sliding=(4, 4), padding="valid", space_to_depth=4,
+                    space_to_depth_hw=(57, 57))
+    assert y.shape == y_ref.shape
+    assert float(jnp.max(jnp.abs(y - y_ref))) < 5e-3
+
+
 def test_space_to_depth_shape():
     x = jnp.ones((2, 227, 227, 3))
     xb = space_to_depth(x, 4)

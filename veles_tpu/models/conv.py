@@ -69,7 +69,8 @@ class Conv(ForwardBase):
 
     def __init__(self, workflow, n_kernels=None, kx=3, ky=3,
                  sliding=(1, 1), padding="same", n_groups=1,
-                 activation=None, space_to_depth=0, **kwargs):
+                 activation=None, space_to_depth=0,
+                 space_to_depth_hw=None, **kwargs):
         super(Conv, self).__init__(workflow, **kwargs)
         if n_kernels is None:
             raise ValueError("n_kernels is required")
@@ -92,6 +93,12 @@ class Conv(ForwardBase):
         #: computes the plain strided form) — export with
         #: space_to_depth=0 for package_export targets.
         self.space_to_depth = int(space_to_depth or 0)
+        #: (hb, wb) of the blocked input when the loader stores it
+        #: FLAT [batch, hb·wb·n²·C] — 4D-blocked dataset layouts
+        #: gather pathologically (ROUND5_NOTES.md §1c), so the fast
+        #: path is flat storage + this in-graph reshape
+        self.space_to_depth_hw = tuple(space_to_depth_hw) \
+            if space_to_depth_hw else None
         if self.space_to_depth:
             if self.n_groups != 1:
                 raise ValueError("space_to_depth requires n_groups=1")
@@ -116,13 +123,24 @@ class Conv(ForwardBase):
             return ((p, p), (p, p))
         return tuple(tuple(int(x) for x in p) for p in self.padding)
 
+    def _blocked_in_channels(self, input_shape):
+        """Per-block input channels (n²·C_logical) from either the 4D
+        blocked layout or the flat [batch, hb·wb·n²·C] one."""
+        if len(input_shape) == 2 and self.space_to_depth:
+            if not self.space_to_depth_hw:
+                raise ValueError(
+                    "flat space_to_depth input needs space_to_depth_hw")
+            hb, wb = self.space_to_depth_hw
+            return input_shape[-1] // (hb * wb)
+        return input_shape[-1]
+
     def output_shape_for(self, input_shape):
-        n, h, w, _ = input_shape
+        kshape = self._kernel_shape(
+            self._blocked_in_channels(input_shape))
         out = jax.eval_shape(
             lambda x, k: self._conv(x, k),
             jax.ShapeDtypeStruct(input_shape, jnp.float32),
-            jax.ShapeDtypeStruct(self._kernel_shape(input_shape[-1]),
-                                 jnp.float32))
+            jax.ShapeDtypeStruct(kshape, jnp.float32))
         return out.shape
 
     def _kernel_shape(self, in_channels):
@@ -145,8 +163,16 @@ class Conv(ForwardBase):
         return kp.transpose(0, 2, 1, 3, 4, 5).reshape(
             kby, kbx, n * n * c, o)
 
+    def _unflatten_s2d(self, x):
+        if x.ndim == 2 and self.space_to_depth:
+            c = self._blocked_in_channels(x.shape)
+            hb, wb = self.space_to_depth_hw
+            x = x.reshape(x.shape[0], hb, wb, c)
+        return x
+
     def _conv(self, x, kernel):
         if self.space_to_depth:
+            x = self._unflatten_s2d(x)
             # blocked stem: stride-n VALID conv over [B, H, W, C]
             # becomes a stride-1 VALID conv over the pre-blocked
             # [B, ceil(H/n), ceil(W/n), n²·C] input.  The caller must
@@ -179,7 +205,7 @@ class Conv(ForwardBase):
             precision=dtypes.matmul_precision())
 
     def fill_params(self):
-        in_ch = self.input.shape[-1]
+        in_ch = self._blocked_in_channels(self.input.shape)
         kshape = self._kernel_shape(in_ch)
         fan_in = self.kx * self.ky * kshape[2]
         fan_out = self.n_kernels
@@ -204,6 +230,8 @@ class Conv(ForwardBase):
                "include_bias": self.include_bias}
         if self.space_to_depth:
             cfg["space_to_depth"] = self.space_to_depth
+            if self.space_to_depth_hw:
+                cfg["space_to_depth_hw"] = list(self.space_to_depth_hw)
         return cfg
 
 

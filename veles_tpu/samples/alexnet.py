@@ -22,20 +22,24 @@ from veles_tpu.loader.fullbatch import FullBatchLoader
 from veles_tpu.models.standard import StandardWorkflow
 
 
-def alexnet_layers(classes=1000, dropout=0.5, space_to_depth=0):
+def alexnet_layers(classes=1000, dropout=0.5, space_to_depth=0,
+                   side=227):
     """The canonical AlexNet layer spec (Krizhevsky et al. 2012).
 
-    ``space_to_depth=4`` runs the 11×11/4 stem in blocked form (the
-    loader pre-blocks, see ImagenetLoader) — numerically identical
-    and 2.2 ms/step faster IN ISOLATION on TPU v5e, but the blocked
-    [57,57,48] dataset layout costs more than that back in the span
-    data path, so the net full-step effect measured NEGATIVE
-    (15.2k → 14.5k samples/s) and the default stays the plain strided
-    stem.  ROUND5_NOTES.md §1 has the full measurements."""
+    ``space_to_depth=4`` runs the 11×11/4 stem in blocked form — the
+    loader pre-blocks AND stores the dataset FLAT [N, hb·wb·48]
+    (4D-blocked layouts gather pathologically, ROUND5_NOTES.md §1c);
+    the stem reshapes in-graph.  Numerically identical to the strided
+    stem (exact parity tests); measured net effect on the full step
+    in §1c."""
+    s2d_hw = None
+    if space_to_depth:
+        s2d_hw = (-(-side // space_to_depth),) * 2
     return [
         {"type": "conv_relu", "n_kernels": 96, "kx": 11, "ky": 11,
          "sliding": (4, 4), "padding": "valid",
-         "space_to_depth": space_to_depth},
+         "space_to_depth": space_to_depth,
+         "space_to_depth_hw": s2d_hw},
         {"type": "norm", "n": 5, "alpha": 1e-4, "beta": 0.75, "k": 2.0},
         {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
         {"type": "conv_relu", "n_kernels": 256, "kx": 5, "ky": 5,
@@ -130,10 +134,13 @@ class ImagenetLoader(FullBatchLoader):
             data = data.astype(jnp.bfloat16)
             if s2d:
                 # pre-blocked for the space_to_depth stem (one-time,
-                # at load — the per-step conv then skips the tiny-C
-                # strided emitter entirely)
+                # at load) and stored FLAT: the per-step gather runs
+                # at full rate on a 2D layout, and the stem's
+                # in-graph reshape costs ~1 ms vs the ~3.5 ms the 4D
+                # blocked layout lost in the span path
                 from veles_tpu.models.conv import space_to_depth
                 data = space_to_depth(data, s2d)
+                data = data.reshape(data.shape[0], -1)
             return data
 
         with jax.default_device(dev):
@@ -158,7 +165,8 @@ class AlexNetWorkflow(StandardWorkflow):
             layers = alexnet_layers(
                 classes=int(cfg.get("classes", 1000)),
                 dropout=float(cfg.get("dropout", 0.5)),
-                space_to_depth=s2d)
+                space_to_depth=s2d,
+                side=int(cfg.get("side", 227)))
         super(AlexNetWorkflow, self).__init__(
             workflow, name="AlexNet",
             loader_factory=ImagenetLoader,
