@@ -172,3 +172,22 @@ def test_cli_debug_pickle_flag(tmp_path):
         capture_output=True, text=True, env=env, cwd=REPO)
     assert r.returncode == 0, r.stderr[-500:]
     assert "pickles cleanly" in r.stdout + r.stderr
+
+
+def test_cosine_lr_warmup_then_cosine():
+    """ADVICE r4 #4: the linear ramp reaches the FULL peak multiplier
+    and the cosine phase spans [warmup, total], not [0, total]."""
+    from veles_tpu.models.lr_adjust import CosineLR
+    import numpy
+    sched = CosineLR(total_steps=1000, floor=0.1, warmup=100)
+    # ramp hits 1.0 at the end of warmup (the old form peaked below)
+    assert abs(float(sched(100)) - 1.0) < 1e-6
+    assert abs(float(sched(50)) - 0.5) < 1e-6
+    # midpoint of the cosine phase = (1 + floor) / 2
+    assert abs(float(sched(550)) - 0.55) < 1e-3
+    # floor at the end, flat beyond
+    assert abs(float(sched(1000)) - 0.1) < 1e-6
+    assert abs(float(sched(5000)) - 0.1) < 1e-6
+    # monotone decreasing after warmup
+    vals = [float(sched(s)) for s in range(100, 1001, 100)]
+    assert all(a >= b - 1e-9 for a, b in zip(vals, vals[1:]))
