@@ -1,0 +1,100 @@
+"""Next-token LM objective (EvaluatorNextToken + TokenProjection +
+samples/lm.py) — the true per-token teacher-forcing loss."""
+
+import jax
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu.config import root
+
+
+def test_next_token_loss_matches_manual():
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.models.evaluator import EvaluatorNextToken
+    wf = AcceleratedWorkflow(None, name="t")
+    ev = EvaluatorNextToken(wf)
+    rng = numpy.random.default_rng(0)
+    B, S, V = 4, 6, 9
+    y = jnp.asarray(rng.standard_normal((B, S, V)), jnp.float32)
+    toks = jnp.asarray(rng.integers(0, V, (B, S)), jnp.int32)
+    size = jnp.int32(3)   # last row masked
+    got = float(ev.loss(y, toks, size))
+    # manual: CE of y[b, t] vs toks[b, t+1] over b < size
+    logp = jax.nn.log_softmax(y[:, :-1].astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        logp, toks[:, 1:][..., None], axis=-1)[..., 0]
+    want = float(-jnp.sum(picked[:3]) / (3 * (S - 1)))
+    assert abs(got - want) < 1e-6
+    # wrong-token count
+    pred = jnp.argmax(y[:, :-1], axis=-1)
+    want_err = int(jnp.sum(pred[:3] != toks[:3, 1:]))
+    assert int(ev.train_metrics(y, toks, size)) == want_err
+    assert ev.metric_units(toks) == S - 1
+
+
+def test_token_projection_shapes_and_grad():
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.backends import Device
+    from veles_tpu.models.transformer import TokenProjection
+    from veles_tpu.memory import Array
+    wf = AcceleratedWorkflow(None, name="t")
+    u = TokenProjection(wf, vocab=11)
+    x = numpy.random.default_rng(1).standard_normal(
+        (2, 5, 8)).astype(numpy.float32)
+    u.input = Array(x)
+    u.initialize(device=Device(backend="numpy"))
+    params = {n: jnp.asarray(a.mem) for n, a in u.param_arrays().items()}
+    y = u.apply(params, jnp.asarray(x))
+    assert y.shape == (2, 5, 11)
+    g = jax.grad(lambda p: jnp.sum(u.apply(p, jnp.asarray(x)) ** 2))(
+        params)
+    assert g["weights"].shape == (8, 11)
+
+
+def _lm_cfg(extra=None):
+    cfg = {"seq": 24, "vocab": 16, "dim": 48, "blocks": 2, "heads": 2,
+           "synthetic_train": 1024, "synthetic_valid": 128,
+           "minibatch_size": 128, "max_epochs": 12,
+           "fail_iterations": 12,
+           "lr_schedule_params": {"total_steps": 120, "floor": 0.1,
+                                  "warmup": 20},
+           "snapshot_time_interval": 1e9}
+    cfg.update(extra or {})
+    return cfg
+
+
+def test_lm_sample_learns_below_unigram():
+    """The per-token objective extracts the planted Markov signal:
+    validation CE drops below the context-free (unigram) entropy."""
+    from veles_tpu.backends import Device
+    from veles_tpu.samples.lm import LMWorkflow
+    root.lm_tpu.update(_lm_cfg({"max_epochs": 30}))
+    wf = LMWorkflow(None, plotters=False)
+    wf.initialize(device=Device(backend="numpy"))
+    wf.run()
+    res = wf.loader.get_metric_values()
+    assert res["h_bigram_nats"] < res["h_unigram_nats"]
+    # the decision layer's tracked per-token validation CE beat the
+    # context-free (unigram) entropy — the objective extracted
+    # sequence structure (epoch_acc itself is reset every epoch close,
+    # so it must be read via the decision's epoch metrics)
+    val_loss = float(wf.decision.epoch_metrics["validation_loss"])
+    assert 0.0 < val_loss < wf.loader.h_unigram_, \
+        (val_loss, wf.loader.h_unigram_)
+
+
+def test_lm_trains_pp_dp():
+    """The LM trunk pipelines: {'pp': 2, 'dp': 2} through the sample."""
+    import math
+    from veles_tpu.backends import Device
+    from veles_tpu.parallel import build_mesh
+    from veles_tpu.samples.lm import LMWorkflow
+    root.lm_tpu.update(_lm_cfg({"max_epochs": 2}))
+    mesh = build_mesh({"pp": 2, "dp": 2}, devices=jax.devices()[:4])
+    wf = LMWorkflow(None, plotters=False, mesh=mesh)
+    wf.initialize(device=Device(backend="numpy"))
+    assert wf.gd._pp_plan_ is not None
+    wf.run()
+    wf.gd.loss.map_read()
+    assert numpy.isfinite(wf.gd.loss.mem)

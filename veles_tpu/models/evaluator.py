@@ -22,6 +22,24 @@ from veles_tpu.memory import Array
 from veles_tpu.units import MissingDemand
 
 
+def masked_ce_from_logits(logits, labels, size, per_row_positions=1):
+    """Masked mean softmax cross-entropy, shared by the classifier and
+    sequence evaluators: ``logits`` [rows, ..., V] (f32-cast here),
+    ``labels`` [rows, ...] int, rows >= ``size`` masked away; the mean
+    divides by size · per_row_positions (1 for classifiers, seq-1 for
+    next-token)."""
+    logits = logits.astype(jnp.float32)
+    z = logits - jnp.max(logits, axis=-1, keepdims=True)
+    logp = z - jnp.log(jnp.sum(jnp.exp(z), axis=-1, keepdims=True))
+    picked = jnp.take_along_axis(
+        logp, jnp.clip(labels, 0)[..., None].astype(jnp.int32),
+        axis=-1)[..., 0]
+    mask = jnp.arange(logits.shape[0]) < size
+    mask = mask.reshape((-1,) + (1,) * (picked.ndim - 1))
+    return -jnp.sum(jnp.where(mask, picked, 0.0)) \
+        / jnp.maximum(size, 1) / per_row_positions
+
+
 class EvaluatorBase(AcceleratedUnit):
     hide_from_registry = True
     VIEW_GROUP = "EVALUATOR"
@@ -95,15 +113,7 @@ class EvaluatorSoftmax(EvaluatorBase):
     def loss_from_logits(logits, labels, size):
         """Masked mean softmax cross-entropy over valid rows (always in
         f32 — the forward chain may run bf16 activations)."""
-        logits = logits.astype(jnp.float32)
-        z = logits - jnp.max(logits, axis=-1, keepdims=True)
-        logp = z - jnp.log(jnp.sum(jnp.exp(z), axis=-1, keepdims=True))
-        picked = jnp.take_along_axis(
-            logp, jnp.clip(labels, 0)[:, None].astype(jnp.int32),
-            axis=-1)[:, 0]
-        mask = jnp.arange(logits.shape[0]) < size
-        return -jnp.sum(jnp.where(mask, picked, 0.0)) \
-            / jnp.maximum(size, 1)
+        return masked_ce_from_logits(logits, labels, size)
 
     def loss(self, y, labels, size):
         return self.loss_from_logits(y, labels, size)
@@ -162,3 +172,75 @@ class EvaluatorMSE(EvaluatorBase):
     def step(self, output, target, batch_size):
         loss = self.loss(output, target, batch_size)
         return {"mse": loss, "loss_out": loss}
+
+
+class EvaluatorNextToken(EvaluatorBase):
+    """Per-token next-token cross-entropy — the actual language-model
+    training objective (teacher forcing): logits [batch, seq, vocab]
+    at position t are scored against token t+1 of the model's own
+    INPUT, averaged over the seq-1 valid positions of the ``size``
+    valid rows.  No reference analogue (the reference had no sequence
+    dimension at all, SURVEY.md §5); this completes the LM stack the
+    TPU rebuild adds: Embedding → TransformerBlock × N →
+    TokenProjection → this evaluator.
+
+    The trainer recognises ``TARGET_IS_INPUT`` and scores against the
+    minibatch tokens (the labels channel is ignored), so any
+    token-sequence loader works unchanged."""
+
+    #: the trainer passes the model INPUT (the token minibatch) as the
+    #: scoring target instead of the loader's labels
+    TARGET_IS_INPUT = True
+
+    WRITES = ("n_err", "loss_out")
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorNextToken, self).__init__(workflow, **kwargs)
+        self.tokens = None       # linked from loader.minibatch_data
+        self.n_err = Array()
+        self.loss_out = Array()
+        self.demand("tokens")
+
+    @property
+    def reads(self):
+        return ("output", "tokens", "batch_size")
+
+    def initialize(self, device=None, **kwargs):
+        super(EvaluatorNextToken, self).initialize(device=device,
+                                                   **kwargs)
+        self.n_err.reset(numpy.zeros((), numpy.int32))
+        self.loss_out.reset(numpy.zeros((), numpy.float32))
+
+    @staticmethod
+    def _shifted(logits, tokens):
+        """(logits[:, :-1] f32, targets tokens[:, 1:])."""
+        return (logits[:, :-1].astype(jnp.float32),
+                tokens[:, 1:].astype(jnp.int32))
+
+    def loss(self, y, tokens, size):
+        """Mean CE per TOKEN over valid positions (rows < size)."""
+        z, tgt = self._shifted(y, tokens)
+        return masked_ce_from_logits(z, tgt, size,
+                                     per_row_positions=tgt.shape[1])
+
+    def metric_units(self, x):
+        """Tokens scored per sample — the trainer's epoch accounting
+        then divides by tokens, so validation_error_pct is the
+        wrong-token percentage and validation_loss the per-token CE."""
+        return x.shape[1] - 1
+
+    def train_metrics(self, y, tokens, size):
+        """Wrong next-token count over valid positions (the trainer's
+        n_err hook — per-TOKEN granularity for min-tracking; the
+        decision layer's error %% is then wrong-token %% × (seq-1))."""
+        z, tgt = self._shifted(y, tokens)
+        pred = jnp.argmax(z, axis=-1).astype(jnp.int32)
+        mask = (jnp.arange(y.shape[0]) < size)[:, None]
+        return jnp.sum(jnp.where(mask, (pred != tgt).astype(jnp.int32),
+                                 0))
+
+    def step(self, output, tokens, batch_size):
+        return {
+            "n_err": self.train_metrics(output, tokens, batch_size),
+            "loss_out": self.loss(output, tokens, batch_size),
+        }

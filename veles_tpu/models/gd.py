@@ -378,13 +378,22 @@ class GradientDescent(AcceleratedUnit):
                 from veles_tpu.ops.augment import make_augment
                 augment_fn = make_augment(**dict(self.augment))
 
+        target_is_input = getattr(self.evaluator, "TARGET_IS_INPUT",
+                                  False)
+
         def loss_and_metrics(params, x, target, size, key, train):
             if train and augment_fn is not None:
                 key, sub = jax.random.split(key)
                 x = augment_fn(x, sub)
+            if target_is_input:
+                # sequence objectives (EvaluatorNextToken) score the
+                # model against its own input tokens
+                target = x
             y = self._forward(params, x, key, train)
             loss = self.evaluator.loss(y, target, size)
-            if is_mse:
+            if hasattr(self.evaluator, "train_metrics"):
+                n_err = self.evaluator.train_metrics(y, target, size)
+            elif is_mse:
                 n_err = jnp.zeros((), jnp.int32)
             else:
                 # argmax over logits is valid for any softmax-CE head,
@@ -428,8 +437,18 @@ class GradientDescent(AcceleratedUnit):
                 class_id == TRAIN, do_train, do_eval,
                 (params, opt_state))
             # per-class epoch accounting stays on device: one row of
-            # [n_err, loss*size, size] added to the class's accumulator
-            row = jnp.stack([n_err.astype(jnp.float32),
+            # [n_err, loss*size, size] added to the class's
+            # accumulator.  The size row stays in SAMPLE units — the
+            # DCN master's epoch-completion gate compares it against
+            # class_lengths.  Sequence objectives (EvaluatorNextToken)
+            # count errors per TOKEN, so their n_err scales down by
+            # tokens-per-sample: the decision layer's error %% is then
+            # the wrong-token percentage, and loss (already per token)
+            # divided by samples stays the per-token CE.
+            per_sample = 1
+            if hasattr(self.evaluator, "metric_units"):
+                per_sample = self.evaluator.metric_units(x)
+            row = jnp.stack([n_err.astype(jnp.float32) / per_sample,
                              loss * size, size.astype(jnp.float32)])
             onehot = (jnp.arange(3) == class_id).astype(jnp.float32)
             acc = acc + onehot[:, None] * row[None, :]

@@ -20,7 +20,7 @@ from veles_tpu.accelerated_units import AcceleratedWorkflow
 from veles_tpu.models.attention import MultiHeadAttention
 from veles_tpu.models.embedding import Embedding
 from veles_tpu.models.moe import MoE
-from veles_tpu.models.transformer import MeanPoolSeq, TransformerBlock
+from veles_tpu.models.transformer import MeanPoolSeq, TransformerBlock, TokenProjection
 from veles_tpu.models.all2all import (
     All2All, All2AllRELU, All2AllSigmoid, All2AllSoftmax,
     All2AllStrictRELU, All2AllTanh)
@@ -59,6 +59,7 @@ LAYER_TYPES = {
     "rnn": SimpleRNN,
     "lstm": LSTM,
     "last_timestep": LastTimestep,
+    "token_logits": TokenProjection,
 }
 
 
@@ -124,7 +125,9 @@ class StandardWorkflow(AcceleratedWorkflow):
     - ``loader_factory(workflow, **loader_config)`` builds the loader
       (or pass a ready ``loader`` instance);
     - ``layers`` — the forward-chain spec (see :func:`make_forwards`);
-    - ``loss`` — "softmax" | "mse" selects the evaluator;
+    - ``loss`` — "softmax" | "mse" | "next_token" selects the
+      evaluator (next_token: per-token LM cross-entropy against the
+      input shifted by one — EvaluatorNextToken);
     - ``decision_config`` / ``snapshotter_config`` / trainer kwargs.
     """
 
@@ -162,6 +165,10 @@ class StandardWorkflow(AcceleratedWorkflow):
         if loss == "mse":
             self.evaluator = EvaluatorMSE(self)
             self.evaluator.target = self.loader.minibatch_targets
+        elif loss == "next_token":
+            from veles_tpu.models.evaluator import EvaluatorNextToken
+            self.evaluator = EvaluatorNextToken(self)
+            self.evaluator.tokens = self.loader.minibatch_data
         else:
             self.evaluator = EvaluatorSoftmax(self)
             self.evaluator.labels = self.loader.minibatch_labels

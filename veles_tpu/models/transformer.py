@@ -173,3 +173,46 @@ class MeanPoolSeq(ForwardBase):
 
     def export_config(self):
         return {}
+
+
+class TokenProjection(ForwardBase):
+    """Per-token logits head: [batch, seq, d] → [batch, seq, vocab]
+    (the LM head — scored per position by EvaluatorNextToken; the
+    pooled classifier head remains ``mean_pool_seq`` + softmax).
+    With a ``tp`` mesh axis the vocab dim column-shards by the
+    standard convention (parallel/sharding.py)."""
+
+    PARAMS = ("weights", "bias")
+    SEQ_DIM1_INPUT = True
+
+    def __init__(self, workflow, vocab=None, **kwargs):
+        super(TokenProjection, self).__init__(workflow,
+                                              include_bias=True,
+                                              **kwargs)
+        if vocab is None:
+            raise ValueError("vocab is required")
+        self.vocab = int(vocab)
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.vocab,)
+
+    def fill_params(self):
+        d = self.input.shape[-1]
+        self.weights.reset(numpy.zeros((d, self.vocab), numpy.float32))
+        self._fill(self.weights.mem, self.weights_filling,
+                   self.weights_stddev, d, self.vocab)
+        self.bias.reset(numpy.zeros((self.vocab,), numpy.float32))
+
+    def apply(self, params, x):
+        from veles_tpu import dtypes
+        cd = dtypes.compute_dtype()
+        y = jnp.einsum("bsd,dv->bsv", x.astype(cd),
+                       params["weights"].astype(cd),
+                       precision=dtypes.matmul_precision(),
+                       preferred_element_type=jnp.float32)
+        # logits stay f32: the CE loss needs full precision and the
+        # [b, s, vocab] tensor is the last thing the chain produces
+        return y + params["bias"].astype(jnp.float32)
+
+    def export_config(self):
+        return {"vocab": self.vocab}
