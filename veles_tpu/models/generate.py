@@ -1,0 +1,79 @@
+"""Autoregressive generation from a trained next-token LM.
+
+No reference analogue (the reference had no sequence models at all —
+SURVEY.md §5); this completes the LM loop the r5 stack opened:
+train (``samples/lm.py``) → snapshot → :func:`generate`.
+
+The whole decode is ONE jitted program: a ``lax.scan`` over decode
+steps on a fixed-length token buffer.  Causal attention makes the
+fixed buffer exact — positions past the cursor are *future* positions
+to every already-generated token, so they cannot influence the logits
+the sampler reads (the buffer's tail holds zeros, not padding that
+would need masking).  Each step runs the full forward over the buffer
+(O(L²) per step without a KV cache — exactness first; a cached decode
+is a layout change inside TransformerBlock, not an API change).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _chain_logits(forwards, params, tokens):
+    h = tokens
+    for i, u in enumerate(forwards):
+        h = u.apply(params[i], h)
+    return h
+
+
+def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
+             key=None):
+    """Decode ``steps`` tokens after ``prompt`` [batch, prompt_len]
+    (int32) through a forward chain ending in per-token logits
+    (Embedding → TransformerBlock × N → TokenProjection).
+
+    - ``temperature`` 0 → greedy argmax; otherwise logits/temperature
+      categorical sampling (``key`` required);
+    - ``top_k`` > 0 restricts sampling to the k most likely tokens.
+
+    Returns [batch, prompt_len + steps] tokens."""
+    params = {i: {name: jnp.asarray(arr.map_read().mem)
+                  for name, arr in u.param_arrays().items()}
+              for i, u in enumerate(forwards)}
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p_len = prompt.shape
+    total = p_len + int(steps)
+    if temperature and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if key is None:
+        key = jax.random.key(0)
+
+    buf0 = jnp.zeros((b, total), jnp.int32)
+    buf0 = jax.lax.dynamic_update_slice(buf0, prompt, (0, 0))
+
+    def sample(logits, k):
+        if temperature:
+            z = logits / float(temperature)
+            if top_k:
+                kth = jnp.sort(z, axis=-1)[:, -int(top_k)][:, None]
+                z = jnp.where(z < kth, -jnp.inf, z)
+            return jax.random.categorical(k, z).astype(jnp.int32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def step(carry, _):
+        buf, pos, k = carry
+        logits = _chain_logits(forwards, params, buf)
+        # logits at the cursor's predecessor predict the cursor token
+        row = jax.lax.dynamic_slice(
+            logits, (0, pos - 1, 0), (b, 1, logits.shape[-1]))[:, 0]
+        k, sub = jax.random.split(k)
+        nxt = sample(row, sub)
+        buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, pos))
+        return (buf, pos + 1, k), None
+
+    @jax.jit
+    def decode(buf, key):
+        (buf, _, _), _ = jax.lax.scan(
+            step, (buf, jnp.int32(p_len), key), None, length=int(steps))
+        return buf
+
+    return decode(buf0, key)
