@@ -157,3 +157,20 @@ def test_generate_sampling_reproducible():
     # usually differ, but never assert on randomness)
     with pytest.raises(ValueError):
         generate(fw, prompt, steps=2, temperature=0.5)
+
+
+def test_generate_cache_keys_on_sampler_settings():
+    """Same model/shapes with different sampler settings must not
+    reuse each other's compiled decode (the step closure bakes the
+    sampler in — the cache key carries it)."""
+    from veles_tpu.models.generate import generate
+    fw = _tiny_lm_units()
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    greedy = generate(fw, prompt, steps=3)
+    hot = generate(fw, prompt, steps=3, temperature=5.0,
+                   key=jax.random.key(1))
+    # greedy again after sampling: still deterministic greedy (a
+    # settings-blind cache would replay the sampling executable)
+    greedy2 = generate(fw, prompt, steps=3)
+    assert numpy.array_equal(numpy.array(greedy), numpy.array(greedy2))
+    assert hot.shape == greedy.shape

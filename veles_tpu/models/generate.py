@@ -14,6 +14,8 @@ would need masking).  Each step runs the full forward over the buffer
 is a layout change inside TransformerBlock, not an API change).
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -46,6 +48,22 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
     if key is None:
         key = jax.random.key(0)
+    for u in forwards:
+        pos_table = getattr(u, "positions", None)
+        if pos_table is not None and hasattr(pos_table, "shape") \
+                and len(pos_table.shape) == 2 \
+                and total > pos_table.shape[0]:
+            raise ValueError(
+                "prompt_len + steps = %d exceeds the model's learned "
+                "positional table (%d — the training sequence length)"
+                % (total, pos_table.shape[0]))
+    vocab = getattr(forwards[-1], "vocab", None)
+    if top_k and vocab is not None and int(top_k) > int(vocab):
+        raise ValueError("top_k %d > vocab %d" % (top_k, vocab))
+    if top_k and not temperature:
+        raise ValueError(
+            "top_k only applies to sampling — set temperature > 0 "
+            "(greedy ignores it)")
 
     buf0 = jnp.zeros((b, total), jnp.int32)
     buf0 = jax.lax.dynamic_update_slice(buf0, prompt, (0, 0))
@@ -59,7 +77,7 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
             return jax.random.categorical(k, z).astype(jnp.int32)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    def step(carry, _):
+    def step(params, carry, _):
         buf, pos, k = carry
         logits = _chain_logits(forwards, params, buf)
         # logits at the cursor's predecessor predict the cursor token
@@ -70,10 +88,43 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
         buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, pos))
         return (buf, pos + 1, k), None
 
+    # params travel as jit ARGUMENTS (constants baked into the trace
+    # would bloat the executable) and the compiled decode is cached on
+    # the unit chain + EVERY static piece of the decode config (batch,
+    # lengths, sampler settings — they are baked into the step
+    # closure), so repeated generate() calls with the same model and
+    # settings reuse one executable
+    cache_key = (tuple(id(u) for u in forwards), b, int(steps), p_len,
+                 float(temperature or 0.0), int(top_k or 0))
+    decode = _decode_cached(cache_key, _StepClosure(step))
+    return decode(params, buf0, key)
+
+
+class _StepClosure:
+    """Always-equal wrapper: the cache keys on ``cache_key`` (unit
+    ids + batch/lengths/sampler settings) — everything the step
+    closure actually varies over — while the closure itself rides
+    along uncompared."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __hash__(self):
+        return 0
+
+    def __eq__(self, other):
+        return isinstance(other, _StepClosure)
+
+
+@functools.lru_cache(maxsize=16)
+def _decode_cached(cache_key, step_closure):
+    steps, p_len = cache_key[2], cache_key[3]
+
     @jax.jit
-    def decode(buf, key):
+    def decode(params, buf, key):
         (buf, _, _), _ = jax.lax.scan(
-            step, (buf, jnp.int32(p_len), key), None, length=int(steps))
+            functools.partial(step_closure.fn, params),
+            (buf, jnp.int32(p_len), key), None, length=steps)
         return buf
 
-    return decode(buf0, key)
+    return decode
