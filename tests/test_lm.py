@@ -121,24 +121,31 @@ def _tiny_lm_units():
 def test_generate_greedy_matches_stepwise():
     """The scan decode equals manual one-at-a-time greedy decoding
     (the fixed causal buffer is exact — tail zeros are future tokens
-    and cannot leak backward)."""
+    and cannot leak backward).  f32 compute: under the bf16 policy the
+    two paths reduce in different orders (length-7 buffer vs grown
+    sequences) and a near-tie argmax can flip — rounding, not logic."""
     from veles_tpu.models.generate import generate, _chain_logits
-    fw = _tiny_lm_units()
-    params = {i: {n: jnp.asarray(a.map_read().mem)
-                  for n, a in u.param_arrays().items()}
-              for i, u in enumerate(fw)}
-    prompt = jnp.asarray([[3, 1, 4], [5, 9, 2]], jnp.int32)
-    out = generate(fw, prompt, steps=4)
-    assert out.shape == (2, 7)
-    assert numpy.array_equal(numpy.array(out[:, :3]),
-                             numpy.array(prompt))
-    # manual decode: grow the sequence one token at a time
-    seq = prompt
-    for _ in range(4):
-        logits = _chain_logits(fw, params, seq)
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
-    assert numpy.array_equal(numpy.array(out), numpy.array(seq))
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    try:
+        fw = _tiny_lm_units()
+        params = {i: {n: jnp.asarray(a.map_read().mem)
+                      for n, a in u.param_arrays().items()}
+                  for i, u in enumerate(fw)}
+        prompt = jnp.asarray([[3, 1, 4], [5, 9, 2]], jnp.int32)
+        out = generate(fw, prompt, steps=4)
+        assert out.shape == (2, 7)
+        assert numpy.array_equal(numpy.array(out[:, :3]),
+                                 numpy.array(prompt))
+        # manual decode: grow the sequence one token at a time
+        seq = prompt
+        for _ in range(4):
+            logits = _chain_logits(fw, params, seq)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        assert numpy.array_equal(numpy.array(out), numpy.array(seq))
+    finally:
+        root.common.precision.compute_dtype = saved
 
 
 def test_generate_sampling_reproducible():

@@ -90,21 +90,29 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
 
     # params travel as jit ARGUMENTS (constants baked into the trace
     # would bloat the executable) and the compiled decode is cached on
-    # the unit chain + EVERY static piece of the decode config (batch,
-    # lengths, sampler settings — they are baked into the step
-    # closure), so repeated generate() calls with the same model and
-    # settings reuse one executable
-    cache_key = (tuple(id(u) for u in forwards), b, int(steps), p_len,
+    # the chain's ARCHITECTURE SIGNATURE + every static piece of the
+    # decode config (batch, lengths, sampler settings — they are
+    # baked into the step closure).  Identical signatures define the
+    # identical computation, so sharing the executable across chains
+    # is correct — and object ids would be unsound (id reuse after gc
+    # replayed a stale chain's executable; caught by the test suite)
+    sig = tuple(
+        (type(u).__name__,
+         repr(sorted(u.export_config().items(), key=str)),
+         tuple(sorted((n, tuple(a.mem.shape))
+                      for n, a in u.param_arrays().items())))
+        for u in forwards)
+    cache_key = (sig, b, int(steps), p_len,
                  float(temperature or 0.0), int(top_k or 0))
     decode = _decode_cached(cache_key, _StepClosure(step))
     return decode(params, buf0, key)
 
 
 class _StepClosure:
-    """Always-equal wrapper: the cache keys on ``cache_key`` (unit
-    ids + batch/lengths/sampler settings) — everything the step
-    closure actually varies over — while the closure itself rides
-    along uncompared."""
+    """Always-equal wrapper: the cache keys on ``cache_key`` (the
+    architecture signature + batch/lengths/sampler settings) —
+    everything the step closure actually varies over — while the
+    closure itself rides along uncompared."""
 
     def __init__(self, fn):
         self.fn = fn
