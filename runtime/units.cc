@@ -811,6 +811,54 @@ void MeanPoolSeqU::Execute(const Tensor& in, Tensor* out,
   });
 }
 
+// -- TokenProjection ----------------------------------------------------------
+
+TokenProjectionU::TokenProjectionU(const Json& config) {
+  vocab_ = config.at("vocab").as_int();
+  if (vocab_ < 1)
+    throw std::runtime_error("TokenProjection: vocab must be >= 1");
+}
+
+void TokenProjectionU::SetParam(const std::string& name, Tensor t) {
+  if (name == "weights")
+    weights_ = std::move(t);
+  else if (name == "bias")
+    bias_ = std::move(t);
+}
+
+std::vector<size_t> TokenProjectionU::OutShape(
+    const std::vector<size_t>& in) const {
+  return {in[0], in[1], static_cast<size_t>(vocab_)};
+}
+
+void TokenProjectionU::Execute(const Tensor& in, Tensor* out,
+                               ThreadPool* pool) const {
+  if (in.shape.size() != 3)
+    throw std::runtime_error("TokenProjection expects [batch, seq, d]");
+  size_t batch = in.dim(0), seq = in.dim(1), d = in.dim(2);
+  size_t v = static_cast<size_t>(vocab_);
+  if (weights_.shape.size() != 2 || weights_.dim(0) != d ||
+      weights_.dim(1) != v || bias_.count() != v)
+    throw std::runtime_error("TokenProjection bad param shapes");
+  out->reshape({batch, seq, v});
+  const float* w = weights_.ptr();
+  const float* b = bias_.ptr();
+  // every (batch, position) row is an independent d x vocab GEMV
+  pool->ParallelFor(batch * seq, [&](size_t r0, size_t r1) {
+    for (size_t r = r0; r < r1; ++r) {
+      const float* x = in.ptr() + r * d;
+      float* y = out->ptr() + r * v;
+      std::memcpy(y, b, v * sizeof(float));
+      for (size_t kk = 0; kk < d; ++kk) {
+        float xv = x[kk];
+        if (xv == 0.0f) continue;
+        const float* wr = w + kk * v;
+        for (size_t j = 0; j < v; ++j) y[j] += xv * wr[j];
+      }
+    }
+  });
+}
+
 // -- factory ------------------------------------------------------------------
 
 std::unique_ptr<Unit> CreateUnit(const std::string& cls, const Json& config) {
@@ -846,6 +894,8 @@ std::unique_ptr<Unit> CreateUnit(const std::string& cls, const Json& config) {
     return std::unique_ptr<Unit>(new TransformerBlockU(config));
   if (cls == "MeanPoolSeq")
     return std::unique_ptr<Unit>(new MeanPoolSeqU());
+  if (cls == "TokenProjection")
+    return std::unique_ptr<Unit>(new TokenProjectionU(config));
   if (cls == "DropoutForward")
     return std::unique_ptr<Unit>(new Identity());
   throw std::runtime_error("unit factory: unknown class " + cls);

@@ -186,6 +186,21 @@ class MeanPoolSeqU : public Unit {  // [b, s, d] -> [b, d]
                ThreadPool* pool) const override;
 };
 
+// per-token LM head matching veles_tpu.models.transformer.TokenProjection:
+// [batch, seq, d] @ W[d, vocab] + bias -> [batch, seq, vocab] logits
+class TokenProjectionU : public Unit {
+ public:
+  explicit TokenProjectionU(const Json& config);
+  std::vector<size_t> OutShape(const std::vector<size_t>& in) const override;
+  void Execute(const Tensor& in, Tensor* out,
+               ThreadPool* pool) const override;
+  void SetParam(const std::string& name, Tensor t) override;
+
+ private:
+  int vocab_;
+  Tensor weights_, bias_;
+};
+
 class Identity : public Unit {  // dropout at inference
  public:
   std::vector<size_t> OutShape(const std::vector<size_t>& in) const override {

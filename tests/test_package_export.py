@@ -370,6 +370,48 @@ def test_plain_packages_stay_v1(mlp_package, tmp_path):
     assert version_of(p2) == 2
 
 
+def test_cpp_runner_lm_head(runner_binary, tmp_path):
+    """The round-5 LM stack (embedding + causal block + per-token
+    TokenProjection head) exports and runs natively: the C++ runner
+    emits [batch, seq, vocab] logits matching the JAX forward."""
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.config import root
+    from veles_tpu.memory import Array
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.package_export import export_package, load_package
+
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    try:
+        wf = AcceleratedWorkflow(None, name="lmpkg")
+        rng = numpy.random.default_rng(33)
+        x = rng.integers(0, 13, (3, 12)).astype(numpy.float32)
+        units = make_forwards(wf, Array(x.astype(numpy.int32)), [
+            {"type": "embedding", "vocab": 13, "dim": 16},
+            {"type": "transformer_block", "heads": 2, "hidden": 24,
+             "causal": True},
+            {"type": "token_logits", "vocab": 13},
+        ])
+        dev = Device(backend="numpy")
+        for u in units:
+            u.initialize(device=dev)
+        path = str(tmp_path / "lm.tar.gz")
+        export_package(units, path, (3, 12), name="lm")
+        y_ref = load_package(path).run(x, mode="python")
+        assert y_ref.shape == (3, 12, 13)
+        numpy.save(tmp_path / "in.npy", x)
+        r = subprocess.run(
+            [runner_binary, path, str(tmp_path / "in.npy"),
+             str(tmp_path / "out.npy")],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        y = numpy.load(tmp_path / "out.npy")
+        assert y.shape == y_ref.shape
+        numpy.testing.assert_allclose(y, y_ref, atol=2e-3)
+    finally:
+        root.common.precision.compute_dtype = saved
+
+
 def test_cpp_runner_transformer(runner_binary, tmp_path):
     """Native transformer inference (embedding + pre-LN MHA block,
     dense AND MoE FFN variants + mean-pool + softmax) agrees with the
