@@ -3,9 +3,19 @@
 // → Workflow::Initialize → Engine run, libVeles/src/engine.cc:30-77):
 //
 //   veles_runner <package.tar.gz> <input.npy> <output.npy> [--repeat N]
+//                [--generate N]
 //
 // Loads the package, runs the forward pass on the input batch, writes
 // the result as npy, and prints one JSON status line with timing.
+//
+// --generate N: autoregressive greedy decode through an LM package
+// (embedding + causal blocks + TokenProjection, [batch, seq] ids →
+// [batch, seq, vocab] logits).  The prompt fills the head of the
+// packaged fixed-seq window; each step runs the full forward and
+// appends argmax(logits[:, t-1, :]) at position t.  Causality makes
+// the zero-filled tail exact — the same fixed-buffer scheme as
+// veles_tpu.models.generate (token-for-token parity when the packaged
+// window equals prompt_len + N).  Output: [batch, prompt_len + N] ids.
 #include <algorithm>
 #include <chrono>
 #include <cstdio>
@@ -20,18 +30,71 @@ int main(int argc, char** argv) {
   if (argc < 4) {
     std::fprintf(stderr,
                  "usage: %s <package.tar.gz> <input.npy> <output.npy> "
-                 "[--repeat N]\n",
+                 "[--repeat N] [--generate N]\n",
                  argv[0]);
     return 2;
   }
-  int repeat = 1;
-  for (int i = 4; i + 1 < argc; ++i)
+  int repeat = 1, generate = 0;
+  for (int i = 4; i + 1 < argc; ++i) {
     if (std::strcmp(argv[i], "--repeat") == 0)
       repeat = std::max(1, std::atoi(argv[i + 1]));
+    if (std::strcmp(argv[i], "--generate") == 0)
+      generate = std::max(0, std::atoi(argv[i + 1]));
+  }
   try {
     auto wf = veles_rt::PackagedWorkflow::Load(argv[1]);
     veles_rt::Tensor input = veles_rt::npy::LoadFile(argv[2]);
     veles_rt::ThreadPool pool;
+    if (generate > 0) {
+      if (input.shape.size() != 2 || input.dim(1) < 1)
+        throw std::runtime_error("--generate expects a non-empty "
+                                 "[batch, prompt] token-id input");
+      if (wf.input_shape().size() != 2)
+        throw std::runtime_error(
+            "--generate needs a [batch, seq] token-id package input");
+      size_t batch = input.dim(0), prompt = input.dim(1);
+      size_t window = wf.input_shape()[1];
+      size_t total = prompt + static_cast<size_t>(generate);
+      if (total > window)
+        throw std::runtime_error(
+            "prompt + generated tokens exceed the packaged seq window");
+      veles_rt::Tensor buf({batch, window});
+      std::fill(buf.data.begin(), buf.data.end(), 0.0f);
+      for (size_t n = 0; n < batch; ++n)
+        std::memcpy(buf.ptr() + n * window, input.ptr() + n * prompt,
+                    prompt * sizeof(float));
+      auto t0 = std::chrono::steady_clock::now();
+      for (size_t t = prompt; t < total; ++t) {
+        veles_rt::Tensor logits = wf.Run(buf, &pool);
+        if (logits.shape.size() != 3 || logits.dim(1) != window)
+          throw std::runtime_error(
+              "--generate needs a per-token-logits package "
+              "(embedding + causal blocks + TokenProjection)");
+        size_t vocab = logits.dim(2);
+        for (size_t n = 0; n < batch; ++n) {
+          const float* row = logits.ptr() + (n * window + t - 1) * vocab;
+          size_t best = 0;
+          for (size_t j = 1; j < vocab; ++j)
+            if (row[j] > row[best]) best = j;
+          buf.ptr()[n * window + t] = static_cast<float>(best);
+        }
+      }
+      double dt = std::chrono::duration<double>(
+                      std::chrono::steady_clock::now() - t0)
+                      .count();
+      veles_rt::Tensor out({batch, total});
+      for (size_t n = 0; n < batch; ++n)
+        std::memcpy(out.ptr() + n * total, buf.ptr() + n * window,
+                    total * sizeof(float));
+      veles_rt::npy::SaveFile(argv[3], out);
+      std::printf(
+          "{\"workflow\": \"%s\", \"units\": %zu, \"batch\": %zu, "
+          "\"generated\": %d, \"sec_total\": %.6f, "
+          "\"tokens_per_sec\": %.1f}\n",
+          wf.name().c_str(), wf.unit_count(), batch, generate, dt,
+          batch * generate / (dt > 0 ? dt : 1e-9));
+      return 0;
+    }
     veles_rt::Tensor out = wf.Run(input, &pool);  // warm (touch pages)
     auto t0 = std::chrono::steady_clock::now();
     for (int i = 0; i < repeat; ++i) out = wf.Run(input, &pool);

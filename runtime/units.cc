@@ -843,19 +843,12 @@ void TokenProjectionU::Execute(const Tensor& in, Tensor* out,
   out->reshape({batch, seq, v});
   const float* w = weights_.ptr();
   const float* b = bias_.ptr();
-  // every (batch, position) row is an independent d x vocab GEMV
+  // every (batch, position) row is an independent d x vocab GEMV:
+  // bias prefill, then the shared row-GEMM helper on each chunk
   pool->ParallelFor(batch * seq, [&](size_t r0, size_t r1) {
-    for (size_t r = r0; r < r1; ++r) {
-      const float* x = in.ptr() + r * d;
-      float* y = out->ptr() + r * v;
-      std::memcpy(y, b, v * sizeof(float));
-      for (size_t kk = 0; kk < d; ++kk) {
-        float xv = x[kk];
-        if (xv == 0.0f) continue;
-        const float* wr = w + kk * v;
-        for (size_t j = 0; j < v; ++j) y[j] += xv * wr[j];
-      }
-    }
+    for (size_t r = r0; r < r1; ++r)
+      std::memcpy(out->ptr() + r * v, b, v * sizeof(float));
+    MatVecRows(in.ptr() + r0 * d, w, out->ptr() + r0 * v, r1 - r0, d, v);
   });
 }
 

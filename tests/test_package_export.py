@@ -412,6 +412,52 @@ def test_cpp_runner_lm_head(runner_binary, tmp_path):
         root.common.precision.compute_dtype = saved
 
 
+def test_cpp_runner_generate_greedy_parity(runner_binary, tmp_path):
+    """Native --generate decode matches models/generate.py greedy
+    token-for-token when the packaged window equals prompt + steps
+    (both use the same fixed causal buffer scheme)."""
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.config import root
+    from veles_tpu.memory import Array
+    from veles_tpu.models.generate import generate
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.package_export import export_package
+
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    try:
+        prompt_len, steps, window = 5, 7, 12
+        wf = AcceleratedWorkflow(None, name="gen")
+        rng = numpy.random.default_rng(17)
+        prompt = rng.integers(1, 19, (2, prompt_len)).astype(numpy.int32)
+        units = make_forwards(
+            wf, Array(numpy.zeros((2, window), numpy.int32)), [
+                {"type": "embedding", "vocab": 19, "dim": 16},
+                {"type": "transformer_block", "heads": 2, "hidden": 24,
+                 "causal": True},
+                {"type": "token_logits", "vocab": 19},
+            ])
+        dev = Device(backend="numpy")
+        for u in units:
+            u.initialize(device=dev)
+        y_ref = numpy.asarray(generate(units, prompt, steps))
+        path = str(tmp_path / "gen.tar.gz")
+        export_package(units, path, (2, window), name="gen")
+        numpy.save(tmp_path / "in.npy", prompt.astype(numpy.float32))
+        r = subprocess.run(
+            [runner_binary, path, str(tmp_path / "in.npy"),
+             str(tmp_path / "out.npy"), "--generate", str(steps)],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        status = json.loads(r.stdout)
+        assert status["generated"] == steps
+        y = numpy.load(tmp_path / "out.npy").astype(numpy.int32)
+        assert y.shape == (2, prompt_len + steps)
+        numpy.testing.assert_array_equal(y, y_ref)
+    finally:
+        root.common.precision.compute_dtype = saved
+
+
 def test_cpp_runner_transformer(runner_binary, tmp_path):
     """Native transformer inference (embedding + pre-LN MHA block,
     dense AND MoE FFN variants + mean-pool + softmax) agrees with the
