@@ -409,6 +409,55 @@ def bench_lm(dev, windows=2, d_model=2048, layers=8, heads=16,
     }
 
 
+def bench_decode(dev, d_model=1024, layers=8, heads=8, window=1024,
+                 prompt_len=32, vocab=32768):
+    """Autoregressive decode throughput (models/generate.py) — the
+    serving-side counterpart of bench_lm's training number: greedy,
+    batch 1, the kv-cached single-token path vs the full-buffer
+    rescan.  Params ride Array.devmem, so the host→device weight
+    upload is paid once across calls, not per decode (through the
+    dev tunnel that upload would otherwise dominate everything)."""
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.memory import Array
+    from veles_tpu.models.generate import generate
+    from veles_tpu.models.standard import make_forwards
+
+    steps = window - prompt_len
+    wf = AcceleratedWorkflow(None, name="bench-decode")
+    spec = [{"type": "embedding", "vocab": vocab, "dim": d_model}]
+    spec += [{"type": "transformer_block", "heads": heads,
+              "causal": True} for _ in range(layers)]
+    spec += [{"type": "token_logits", "vocab": vocab}]
+    fw = make_forwards(wf, Array(numpy.zeros((1, window), numpy.int32)),
+                       spec)
+    for u in fw:
+        u.initialize(device=dev)
+    prompt = numpy.random.default_rng(0).integers(
+        0, vocab, (1, prompt_len)).astype(numpy.int32)
+
+    def timed(kv):
+        numpy.asarray(generate(fw, prompt, steps, kv_cache=kv))
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            # the host readback of the tokens delimits the span
+            numpy.asarray(generate(fw, prompt, steps, kv_cache=kv))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_kv = timed(True)
+    t_full = timed(False)
+    return {
+        "decode_tokens_per_sec": round(steps / t_kv, 1),
+        "decode_uncached_tokens_per_sec": round(steps / t_full, 1),
+        "decode_kv_speedup": round(t_full / t_kv, 2),
+        "decode_config": {
+            "d_model": d_model, "layers": layers, "heads": heads,
+            "window": window, "prompt": prompt_len, "steps": steps,
+            "vocab": vocab, "batch": 1, "sampler": "greedy"},
+    }
+
+
 def bench_longcontext(dev, seq=32768, d_model=512, heads=4, layers=2,
                       batch=1, vocab=256, windows=2):
     """Long-context capability number: a 32k-token causal train step
@@ -715,6 +764,11 @@ def main():
         # less HBM headroom must not lose the whole bench run to it
         lm = {"lm_error": repr(e)[:300]}
     longctx = bench_longcontext(dev)
+    try:
+        decode = bench_decode(dev)
+    except Exception as e:       # same guard as bench_lm: a capability
+        # entry must not take down the primary metrics
+        decode = {"decode_error": repr(e)[:300]}
     mlp_sps, mlp_aud = bench_mlp(dev)
     allreduce = bench_allreduce()
     dp = bench_dp_scaling(dev)
@@ -751,6 +805,7 @@ def main():
     record.update(trx_v32k)
     record.update(lm)
     record.update(longctx)
+    record.update(decode)
     record.update(allreduce)
     if dp:
         record.update(dp)

@@ -148,6 +148,129 @@ def test_generate_greedy_matches_stepwise():
         root.common.precision.compute_dtype = saved
 
 
+def test_generate_kv_cache_greedy_parity():
+    """kv_cache=True single-token decode equals the full-buffer scan
+    token-for-token (f32: bf16 reduction-order near-ties aside, the
+    two paths compute the same math — cache rows past the cursor are
+    zeros the causal mask excludes)."""
+    from veles_tpu.models.generate import generate
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    try:
+        fw = _tiny_lm_units()
+        prompt = jnp.asarray([[3, 1, 4], [5, 9, 2]], jnp.int32)
+        full = generate(fw, prompt, steps=6)
+        cached = generate(fw, prompt, steps=6, kv_cache=True)
+        assert numpy.array_equal(numpy.array(full), numpy.array(cached))
+        # single-token prompt exercises the no-prefill branch
+        p1 = jnp.asarray([[7], [2]], jnp.int32)
+        assert numpy.array_equal(
+            numpy.array(generate(fw, p1, steps=4)),
+            numpy.array(generate(fw, p1, steps=4, kv_cache=True)))
+        # MoE-FFN blocks decode through the same cache path
+        from veles_tpu.accelerated_units import AcceleratedWorkflow
+        from veles_tpu.backends import Device
+        from veles_tpu.memory import Array
+        from veles_tpu.models.standard import make_forwards
+        wfm = AcceleratedWorkflow(None, name="genmoe")
+        fwm = make_forwards(
+            wfm, Array(numpy.zeros((2, 10), numpy.int32)), [
+                {"type": "embedding", "vocab": 12, "dim": 16},
+                {"type": "transformer_block", "heads": 2,
+                 "causal": True, "n_experts": 3, "top_k": 2},
+                {"type": "token_logits", "vocab": 12}])
+        for u in fwm:
+            u.initialize(device=Device(backend="numpy"))
+        assert numpy.array_equal(
+            numpy.array(generate(fwm, prompt, steps=5)),
+            numpy.array(generate(fwm, prompt, steps=5, kv_cache=True)))
+    finally:
+        root.common.precision.compute_dtype = saved
+
+
+def test_generate_kv_cache_sampling_key_schedule():
+    """The cached path draws the same tokens as the uncached path for
+    a given key/settings (one split per decode step in both)."""
+    from veles_tpu.models.generate import generate
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    try:
+        fw = _tiny_lm_units()
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        a = generate(fw, prompt, steps=5, temperature=0.7, top_k=5,
+                     key=jax.random.key(3))
+        c = generate(fw, prompt, steps=5, temperature=0.7, top_k=5,
+                     key=jax.random.key(3), kv_cache=True)
+        assert numpy.array_equal(numpy.array(a), numpy.array(c))
+    finally:
+        root.common.precision.compute_dtype = saved
+
+
+def test_generate_kv_cache_rejects_seq_mixing_without_step():
+    """A chain with a sequence-mixing unit that has no single-token
+    step (raw MultiHeadAttention) must be refused, not silently
+    decoded one position at a time."""
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.backends import Device
+    from veles_tpu.memory import Array
+    from veles_tpu.models.generate import generate
+    from veles_tpu.models.standard import make_forwards
+    wf = AcceleratedWorkflow(None, name="mix")
+    fw = make_forwards(wf, Array(numpy.zeros((1, 6), numpy.int32)), [
+        {"type": "embedding", "vocab": 9, "dim": 8},
+        {"type": "attention", "heads": 2, "causal": True},
+        {"type": "token_logits", "vocab": 9}])
+    for u in fw:
+        u.initialize(device=Device(backend="numpy"))
+    with pytest.raises(ValueError, match="position-wise"):
+        generate(fw, jnp.asarray([[1, 2]], jnp.int32), steps=2,
+                 kv_cache=True)
+
+
+def test_generate_cache_keys_on_compute_dtype():
+    """The compute/precision policy is baked into the traced decode —
+    a dtype toggle between shape-identical calls must MISS the decode
+    cache (a hit would replay the other policy's executable and
+    silently compute in the wrong dtype)."""
+    from veles_tpu.models import generate as gen
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    fw = _tiny_lm_units()
+    prompt = jnp.asarray([[4, 2, 7]], jnp.int32)
+    try:
+        root.common.precision.compute_dtype = "float32"
+        a = gen.generate(fw, prompt, steps=4, kv_cache=True)
+        misses = gen._decode_cached_kv.cache_info().misses
+        root.common.precision.compute_dtype = "bfloat16"
+        gen.generate(fw, prompt, steps=4, kv_cache=True)
+        assert gen._decode_cached_kv.cache_info().misses == misses + 1
+        root.common.precision.compute_dtype = "float32"
+        c = gen.generate(fw, prompt, steps=4, kv_cache=True)
+        # and back: the f32 entry is still cached and still correct
+        assert gen._decode_cached_kv.cache_info().misses == misses + 1
+        assert numpy.array_equal(numpy.array(a), numpy.array(c))
+    finally:
+        root.common.precision.compute_dtype = saved
+
+
+def test_generate_kv_cache_rejects_non_causal():
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.backends import Device
+    from veles_tpu.memory import Array
+    from veles_tpu.models.generate import generate
+    from veles_tpu.models.standard import make_forwards
+    wf = AcceleratedWorkflow(None, name="nc")
+    x = numpy.zeros((1, 6), numpy.int32)
+    fw = make_forwards(wf, Array(x), [
+        {"type": "embedding", "vocab": 9, "dim": 8},
+        {"type": "transformer_block", "heads": 2, "causal": False},
+        {"type": "token_logits", "vocab": 9}])
+    for u in fw:
+        u.initialize(device=Device(backend="numpy"))
+    with pytest.raises(ValueError, match="causal"):
+        generate(fw, jnp.asarray([[1, 2]], jnp.int32), steps=2,
+                 kv_cache=True)
+
+
 def test_generate_sampling_reproducible():
     from veles_tpu.models.generate import generate
     fw = _tiny_lm_units()

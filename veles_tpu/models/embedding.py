@@ -4,6 +4,7 @@ manualrst_veles_algorithms.rst:115-140; the TPU rebuild makes the
 sequence stack first-class per the driver's long-context mandate).
 """
 
+import jax
 import jax.numpy as jnp
 import numpy
 
@@ -68,6 +69,21 @@ class Embedding(ForwardBase):
         if self.learned_positions:
             y = y + params["positions"].astype(cd)[
                 None, :y.shape[1], :]
+        return y
+
+    def apply_step(self, params, x, pos):
+        """Single-position decode (models/generate.py kv_cache path):
+        x [batch, 1] token ids at sequence index ``pos`` (traced
+        scalar) — the positional row is gathered dynamically."""
+        from veles_tpu import dtypes
+        cd = dtypes.compute_dtype()
+        y = jnp.take(params["weights"].astype(cd),
+                     x.astype(jnp.int32), axis=0)
+        if self.learned_positions:
+            row = jax.lax.dynamic_slice(
+                params["positions"].astype(cd), (pos, 0),
+                (1, self.dim))
+            y = y + row[None]
         return y
 
     def export_config(self):

@@ -27,18 +27,44 @@ def _chain_logits(forwards, params, tokens):
     return h
 
 
+def _chain_step(forwards, params, tok, pos, caches):
+    """One-token forward with per-block KV caches: tok [batch, 1] ids
+    at sequence index ``pos`` → ([batch, 1, vocab] logits, caches')."""
+    h = tok
+    out = dict(caches)
+    for i, u in enumerate(forwards):
+        if hasattr(u, "init_cache"):
+            h, out[i] = u.apply_step(params[i], h, pos, caches[i])
+        elif hasattr(u, "apply_step"):
+            h = u.apply_step(params[i], h, pos)
+        else:
+            h = u.apply(params[i], h)
+    return h, out
+
+
 def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
-             key=None):
+             key=None, kv_cache=False):
     """Decode ``steps`` tokens after ``prompt`` [batch, prompt_len]
     (int32) through a forward chain ending in per-token logits
     (Embedding → TransformerBlock × N → TokenProjection).
 
     - ``temperature`` 0 → greedy argmax; otherwise logits/temperature
       categorical sampling (``key`` required);
-    - ``top_k`` > 0 restricts sampling to the k most likely tokens.
+    - ``top_k`` > 0 restricts sampling to the k most likely tokens;
+    - ``kv_cache`` True → single-token decode steps against per-block
+      K/V caches (O(total) per token instead of O(total²) — the
+      layout change the module docstring promises).  Exact for causal
+      chains; greedy parity with the uncached scan is tested
+      token-for-token in f32.  The sampling key schedule matches the
+      uncached path (one split per decode step), so a given
+      ``key``/settings pair draws the same tokens either way.
 
     Returns [batch, prompt_len + steps] tokens."""
-    params = {i: {name: jnp.asarray(arr.map_read().mem)
+    # device-resident params (Array.devmem uploads lazily ONCE and
+    # stays coherent): repeated generate() calls must not re-ship the
+    # weights host→device — through a remote-device tunnel that upload
+    # dwarfs the decode itself
+    params = {i: {name: arr.devmem
                   for name, arr in u.param_arrays().items()}
               for i, u in enumerate(forwards)}
     prompt = jnp.asarray(prompt, jnp.int32)
@@ -88,6 +114,23 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
         buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, pos))
         return (buf, pos + 1, k), None
 
+    def pre_step(params, carry, _):
+        # prompt prefill: consume one prompt token, populate caches
+        buf, pos, caches = carry
+        tok = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
+        _, caches = _chain_step(forwards, params, tok, pos, caches)
+        return (buf, pos + 1, caches), None
+
+    def dec_step(params, carry, _):
+        buf, pos, k, caches = carry
+        tok = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
+        logits, caches = _chain_step(forwards, params, tok, pos, caches)
+        k, sub = jax.random.split(k)
+        nxt = sample(logits[:, 0], sub)
+        buf = jax.lax.dynamic_update_slice(buf, nxt[:, None],
+                                           (0, pos + 1))
+        return (buf, pos + 1, k, caches), None
+
     # params travel as jit ARGUMENTS (constants baked into the trace
     # would bloat the executable) and the compiled decode is cached on
     # the chain's ARCHITECTURE SIGNATURE + every static piece of the
@@ -96,14 +139,46 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
     # identical computation, so sharing the executable across chains
     # is correct — and object ids would be unsound (id reuse after gc
     # replayed a stale chain's executable; caught by the test suite)
+    from veles_tpu import dtypes
     sig = tuple(
         (type(u).__name__,
          repr(sorted(u.export_config().items(), key=str)),
          tuple(sorted((n, tuple(a.mem.shape))
                       for n, a in u.param_arrays().items())))
         for u in forwards)
+    # the compute/precision policy is read from GLOBAL config inside
+    # the trace (the casts are baked into the executable) — it must
+    # key the cache or a dtype toggle would replay the other policy's
+    # program on shape-identical calls
     cache_key = (sig, b, int(steps), p_len,
-                 float(temperature or 0.0), int(top_k or 0))
+                 float(temperature or 0.0), int(top_k or 0),
+                 bool(kv_cache), str(dtypes.compute_dtype()),
+                 str(dtypes.matmul_precision()))
+    if kv_cache:
+        for u in forwards:
+            if hasattr(u, "init_cache"):
+                if not u.causal:
+                    raise ValueError(
+                        "kv_cache decoding needs causal blocks — a "
+                        "non-causal block's past outputs change when "
+                        "future tokens arrive, so single-token steps "
+                        "cannot reproduce them")
+            elif not hasattr(u, "apply_step") \
+                    and not getattr(u, "DECODE_POINTWISE", False):
+                # a sequence-mixing unit without a single-token step
+                # (MultiHeadAttention, RNN/LSTM, pooling heads) would
+                # silently attend/recur over ONE position — refuse
+                # rather than decode garbage
+                raise ValueError(
+                    "kv_cache decoding: %s has no apply_step and is "
+                    "not position-wise — use kv_cache=False for this "
+                    "chain" % type(u).__name__)
+        caches0 = {i: u.init_cache(b, total, dtypes.compute_dtype())
+                   for i, u in enumerate(forwards)
+                   if hasattr(u, "init_cache")}
+        decode = _decode_cached_kv(
+            cache_key, _StepClosure((pre_step, dec_step)))
+        return decode(params, buf0, key, caches0)
     decode = _decode_cached(cache_key, _StepClosure(step))
     return decode(params, buf0, key)
 
@@ -124,6 +199,11 @@ class _StepClosure:
         return isinstance(other, _StepClosure)
 
 
+# NOTE on lifetime: a cached entry's step closure holds the chain's
+# units (and therefore their parameter Arrays, host + device) alive
+# until LRU eviction — a serving process that cycles many large models
+# through decode should call `_decode_cached.cache_clear()` /
+# `_decode_cached_kv.cache_clear()` when it retires one.
 @functools.lru_cache(maxsize=16)
 def _decode_cached(cache_key, step_closure):
     steps, p_len = cache_key[2], cache_key[3]
@@ -133,6 +213,26 @@ def _decode_cached(cache_key, step_closure):
         (buf, _, _), _ = jax.lax.scan(
             functools.partial(step_closure.fn, params),
             (buf, jnp.int32(p_len), key), None, length=steps)
+        return buf
+
+    return decode
+
+
+@functools.lru_cache(maxsize=16)
+def _decode_cached_kv(cache_key, step_closure):
+    steps, p_len = cache_key[2], cache_key[3]
+    pre_step, dec_step = step_closure.fn
+
+    @jax.jit
+    def decode(params, buf, key, caches):
+        if p_len > 1:  # prefill caches over the prompt's predecessors
+            (buf, _, caches), _ = jax.lax.scan(
+                functools.partial(pre_step, params),
+                (buf, jnp.int32(0), caches), None, length=p_len - 1)
+        (buf, _, _, caches), _ = jax.lax.scan(
+            functools.partial(dec_step, params),
+            (buf, jnp.int32(p_len - 1), key, caches), None,
+            length=steps)
         return buf
 
     return decode

@@ -146,6 +146,63 @@ class TransformerBlock(ForwardBase):
         return h + self._ffn(params, _layer_norm(
             h, params["ln2_scale"], params["ln2_bias"]))
 
+    # -- single-token decode (models/generate.py kv_cache path) ---------
+
+    def init_cache(self, batch, max_len, dtype):
+        """Zeroed K/V decode buffers, [batch, max_len, d] each (d from
+        the filled ``wq``; rows are written by :meth:`apply_step`)."""
+        d = self.wq.mem.shape[0]
+        return {"k": jnp.zeros((batch, max_len, d), dtype),
+                "v": jnp.zeros((batch, max_len, d), dtype)}
+
+    def apply_step(self, params, x, pos, cache):
+        """Decode ONE position: x [batch, 1, d] at sequence index
+        ``pos`` (traced scalar); returns (y, cache') with this step's
+        K/V written into the cache — O(max_len) work per token vs
+        re-running :meth:`apply` over the whole buffer (O(seq²)).
+        Exact for causal blocks: cache rows past ``pos`` hold zeros
+        that the mask excludes.  Mirrors mha_apply's dense-core
+        conventions (projection dtypes, 1/sqrt(hd) scaling, softmax
+        over the key axis) so greedy decode is token-for-token
+        identical in f32."""
+        from veles_tpu import dtypes
+        cd = dtypes.compute_dtype()
+        ad = dtypes.accum_dtype()
+        prec = dtypes.matmul_precision()
+        b, _, d = x.shape
+        h = self.heads
+        hd = d // h
+        ln = _layer_norm(x, params["ln1_scale"], params["ln1_bias"])
+
+        def proj(name):
+            y = jnp.einsum("bsd,de->bse", ln.astype(cd),
+                           params[name].astype(cd), precision=prec,
+                           preferred_element_type=ad)
+            return y.astype(cd)
+
+        q, k_new, v_new = proj("wq"), proj("wk"), proj("wv")
+        ck = jax.lax.dynamic_update_slice(
+            cache["k"], k_new.astype(cache["k"].dtype), (0, pos, 0))
+        cv = jax.lax.dynamic_update_slice(
+            cache["v"], v_new.astype(cache["v"].dtype), (0, pos, 0))
+        length = ck.shape[1]
+        qh = q.reshape(b, 1, h, hd)
+        kh = ck.astype(cd).reshape(b, length, h, hd)
+        vh = cv.astype(cd).reshape(b, length, h, hd)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) \
+            * (1.0 / jnp.sqrt(hd))
+        mask = (jnp.arange(length) <= pos)[None, None, None, :]
+        logits = jnp.where(mask, logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs, vh).reshape(b, 1, d)
+        attn = jnp.einsum("bsd,de->bse", o.astype(cd),
+                          params["wo"].astype(cd), precision=prec,
+                          preferred_element_type=ad).astype(x.dtype)
+        y = x + attn
+        out = y + self._ffn(params, _layer_norm(
+            y, params["ln2_scale"], params["ln2_bias"]))
+        return out, {"k": ck, "v": cv}
+
     def export_config(self):
         cfg = {"heads": self.heads, "hidden": int(self.hidden),
                "causal": self.causal, "n_experts": self.n_experts,
@@ -184,6 +241,9 @@ class TokenProjection(ForwardBase):
 
     PARAMS = ("weights", "bias")
     SEQ_DIM1_INPUT = True
+    #: position-wise: safe to apply to a [batch, 1, d] decode step
+    #: unchanged (models/generate.py kv_cache chain dispatch)
+    DECODE_POINTWISE = True
 
     def __init__(self, workflow, vocab=None, **kwargs):
         super(TokenProjection, self).__init__(workflow,
