@@ -153,6 +153,73 @@ def test_interactive_loader_feeds():
     assert loader.closed
 
 
+def test_restful_api_generate_endpoint():
+    """POST /generate on an LM chain decodes autoregressively (greedy
+    deterministic; single-prompt squeeze; no graph loop required —
+    the decode is its own jitted program)."""
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.restful_api import RESTfulAPI, RestfulLoader
+
+    dev = Device(backend="numpy")
+    wf = AcceleratedWorkflow(None, name="lmserve")
+    fw = make_forwards(wf, Array(numpy.zeros((1, 12), numpy.int32)), [
+        {"type": "embedding", "vocab": 11, "dim": 8},
+        {"type": "transformer_block", "heads": 2, "causal": True},
+        {"type": "token_logits", "vocab": 11}])
+    for u in fw:
+        u.initialize(device=dev)
+    loader = RestfulLoader(wf, sample_shape=(12,), minibatch_size=1,
+                           max_wait=10.0)
+    loader.initialize(device=dev)
+    api = RESTfulAPI(wf, loader=loader, forwards=fw, name="lmapi")
+    api.output = fw[-1].output
+    api.initialize()
+    try:
+        def post(payload):
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/generate" % api.port,
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            return json.load(urllib.request.urlopen(req, timeout=30))
+
+        a = post({"prompt": [3, 1, 4], "steps": 5})
+        b = post({"prompt": [3, 1, 4], "steps": 5})
+        assert a["tokens"] == b["tokens"]          # greedy determinism
+        assert len(a["tokens"]) == 8
+        assert a["tokens"][:3] == [3, 1, 4]
+        batched = post({"prompt": [[3, 1, 4], [5, 9, 2]], "steps": 4})
+        assert len(batched["tokens"]) == 2
+        assert len(batched["tokens"][0]) == 7
+        sampled = post({"prompt": [1, 2], "steps": 4,
+                        "temperature": 0.9, "top_k": 5, "seed": 7})
+        assert len(sampled["tokens"]) == 6
+        assert all(0 <= t < 11 for t in sampled["tokens"])
+        # unpinned sampling draws a fresh seed per request (shape-only
+        # assertion — never assert on randomness)
+        unpinned = post({"prompt": [1, 2], "steps": 3,
+                         "temperature": 0.9})
+        assert len(unpinned["tokens"]) == 5
+        # malformed prompts are client errors, not phantom decodes
+        for bad in ({"prompt": [], "steps": 2},
+                    {"prompt": [3, 999], "steps": 2}):
+            try:
+                post(bad)
+                assert False, "expected 400 for %s" % bad
+            except urllib.error.HTTPError as e:
+                assert e.code == 400, bad
+        # a non-LM endpoint 404s instead of decoding garbage
+        api.forwards = None
+        try:
+            post({"prompt": [1], "steps": 1})
+            assert False, "expected HTTPError"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        api.stop()
+        loader.close()
+
+
 def test_restful_api_serves_forward():
     from veles_tpu.accelerated_units import AcceleratedWorkflow
     from veles_tpu.models.all2all import All2AllSoftmax

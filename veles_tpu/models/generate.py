@@ -42,6 +42,21 @@ def _chain_step(forwards, params, tok, pos, caches):
     return h, out
 
 
+def kv_cache_eligible(forwards):
+    """True when :func:`generate` can decode this chain with
+    ``kv_cache=True``: every cacheable block is causal and every other
+    unit either has a single-token step or is position-wise (the same
+    predicate the kv path validates with)."""
+    for u in forwards:
+        if hasattr(u, "init_cache"):
+            if not u.causal:
+                return False
+        elif not hasattr(u, "apply_step") \
+                and not getattr(u, "DECODE_POINTWISE", False):
+            return False
+    return True
+
+
 def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
              key=None, kv_cache=False):
     """Decode ``steps`` tokens after ``prompt`` [batch, prompt_len]

@@ -22,6 +22,15 @@ from veles_tpu.memory import Array
 from veles_tpu.units import Unit
 
 
+def _status_text(e):
+    """Exception → HTTP status-line-safe text: whitespace (incl. the
+    newlines of multi-line JAX errors) collapsed to spaces — a raw
+    newline would split the status line (header injection) — and
+    latin-1 only (send_response_only encodes strict), 200 chars."""
+    line = " ".join(str(e).split())[:200] or type(e).__name__
+    return line.encode("latin-1", "replace").decode("latin-1")
+
+
 class RestfulLoader(InteractiveLoader):
     """Interactive loader whose samples carry reply futures
     (ref: veles/loader/restful.py:52)."""
@@ -63,7 +72,7 @@ class RESTfulAPI(Unit):
     VIEW_GROUP = "SERVICE"
 
     def __init__(self, workflow, loader=None, port=0, host="127.0.0.1",
-                 request_timeout=30.0, **kwargs):
+                 request_timeout=30.0, forwards=None, **kwargs):
         super(RESTfulAPI, self).__init__(workflow, **kwargs)
         self.loader = loader
         self.output = None  # linked from the head forward unit
@@ -73,15 +82,63 @@ class RESTfulAPI(Unit):
         #: optional callable fired by POST /shutdown (serving workflows
         #: wire their stop request here)
         self.shutdown_callback = None
+        #: optional LM forward chain (… → TokenProjection); when set,
+        #: POST /generate decodes autoregressively via models/generate
+        self.forwards = forwards
         self.demand("loader", "output")
+
+    def _validate_prompt(self, prompt):
+        """Reject malformed /generate prompts with a client error
+        (the decode would otherwise return 200 with tokens conditioned
+        on a phantom zero row, or gather a clamped wrong embedding)."""
+        if prompt.ndim != 2 or prompt.shape[1] < 1 or not prompt.size:
+            return "prompt must be a non-empty token list (or a " \
+                   "batch of equal-length lists)"
+        vocab = getattr(self.forwards[0], "vocab", None)
+        if vocab is not None and \
+                (prompt.min() < 0 or prompt.max() >= int(vocab)):
+            return "prompt token ids must be in [0, %d)" % vocab
+        return None
+
+    def _decode(self, prompt, steps, temperature, top_k, seed):
+        """Run the decode for /generate — kv-cached when the chain is
+        eligible, full-buffer rescan otherwise.  Serialized: decode
+        requests share the chain's param Arrays and the compile
+        caches; a novel (batch, prompt_len, steps, sampler) shape
+        compiles a fresh executable on first use (seconds), so
+        variable-shape clients pay per shape, cached thereafter."""
+        import jax
+
+        from veles_tpu.models.generate import generate, \
+            kv_cache_eligible
+        if seed is None:
+            # an unpinned sampling request must draw FRESH tokens per
+            # call — a constant default would replay one "sample"
+            import os
+            seed = int.from_bytes(os.urandom(4), "little")
+        key = jax.random.key(int(seed)) if temperature else None
+        with self._decode_lock_:
+            return generate(self.forwards, prompt, steps,
+                            temperature=temperature, top_k=top_k,
+                            key=key,
+                            kv_cache=kv_cache_eligible(self.forwards))
 
     def init_unpickled(self):
         super(RESTfulAPI, self).init_unpickled()
         self._server_ = None
         self._thread_ = None
+        self._decode_lock_ = threading.Lock()
 
     def initialize(self, **kwargs):
         super(RESTfulAPI, self).initialize(**kwargs)
+        if self.forwards is not None:
+            # warm the device params NOW, single-threaded: Array.devmem
+            # lazily uploads on first touch and is not thread-safe
+            # against the concurrent HTTP handler threads /generate
+            # runs on (the upload nulls the buffer before replacing it)
+            for u in self.forwards:
+                for arr in u.param_arrays().values():
+                    arr.devmem
         if self._server_ is not None:
             return
         api = self
@@ -107,6 +164,43 @@ class RESTfulAPI(Unit):
                     if api.shutdown_callback is not None:
                         api.shutdown_callback()
                     return
+                if self.path.rstrip("/") == "/generate":
+                    if api.forwards is None:
+                        self.send_error(
+                            404, "this endpoint serves no LM chain")
+                        return
+                    try:
+                        length = int(
+                            self.headers.get("Content-Length", 0))
+                        body = json.loads(self.rfile.read(length))
+                        prompt = numpy.asarray(body["prompt"],
+                                               numpy.int32)
+                        squeeze = prompt.ndim == 1
+                        if squeeze:
+                            prompt = prompt[None]
+                        err = api._validate_prompt(prompt)
+                        if err:
+                            self.send_error(400, err)
+                            return
+                        tokens = api._decode(
+                            prompt, int(body["steps"]),
+                            float(body.get("temperature", 0.0)),
+                            int(body.get("top_k", 0)),
+                            body.get("seed"))
+                        tokens = numpy.asarray(tokens).tolist()
+                        blob = json.dumps(
+                            {"tokens": tokens[0] if squeeze
+                             else tokens}).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                        self.send_header("Content-Length",
+                                         str(len(blob)))
+                        self.end_headers()
+                        self.wfile.write(blob)
+                    except Exception as e:
+                        self.send_error(500, _status_text(e))
+                    return
                 if self.path.rstrip("/") != "/api":
                     self.send_error(404)
                     return
@@ -123,7 +217,7 @@ class RESTfulAPI(Unit):
                     self.end_headers()
                     self.wfile.write(blob)
                 except Exception as e:  # one bad request must not kill
-                    self.send_error(500, str(e)[:200])  # the server
+                    self.send_error(500, _status_text(e))  # the server
 
         self._server_ = ThreadingHTTPServer((self.host, self.port),
                                             Handler)

@@ -4,10 +4,13 @@ RESTfulAPI unit the same way; veles/restful_api.py:78).
 
     python -m veles_tpu veles_tpu/samples/serve.py \
         -c "root.serve.snapshot='snapshots/mnist_current.pickle.gz'" \
+        -c "root.serve.workflow='veles_tpu/samples/mnist.py'" \
         -c "root.serve.port=8080"
 
     curl -X POST http://localhost:8080/api \
          -d '{"input": [0.0, 0.1, ...]}'
+    curl -X POST http://localhost:8080/generate \
+         -d '{"prompt": [3, 1, 4], "steps": 32}'  # LM snapshots only
     curl -X POST http://localhost:8080/shutdown   # clean stop
 
 Graph: repeater → restful_loader → [forwards from the snapshot] → api,
@@ -48,6 +51,14 @@ class ServeWorkflow(AcceleratedWorkflow):
             raise ValueError(
                 "set root.serve.snapshot to a trained workflow snapshot")
         from veles_tpu.snapshotter import SnapshotterToFile
+        # a CLI-trained snapshot pickles classes under the workflow
+        # FILE's module name ('lm', 'mnist', …) — that module must be
+        # importable here before unpickling (the reference resumed
+        # through the same re-import, veles/__main__.py:539-589)
+        wf_file = cfg.get("workflow")
+        if wf_file:
+            from veles_tpu.import_file import import_file_as_module
+            import_file_as_module(wf_file)
         trained = SnapshotterToFile.import_file(snapshot)
         self.forwards = trained.forwards  # adopted trained chain
         sample_shape = tuple(trained.loader.minibatch_data.shape[1:])
@@ -71,10 +82,15 @@ class ServeWorkflow(AcceleratedWorkflow):
         for a, b in zip(self.forwards, self.forwards[1:]):
             b.link_from(a)
 
+        from veles_tpu.models.transformer import TokenProjection
         self.api = RESTfulAPI(
             self, loader=self.loader,
             port=int(cfg.get("port", 0)),
-            host=cfg.get("host", "127.0.0.1"))
+            host=cfg.get("host", "127.0.0.1"),
+            # an LM snapshot (per-token logits head) also serves
+            # POST /generate — autoregressive decode off the same chain
+            forwards=self.forwards
+            if isinstance(self.forwards[-1], TokenProjection) else None)
         self.api.output = self.forwards[-1].output
         self.api.gate_skip = self.loader.idle
         self.api.shutdown_callback = self.request_stop
