@@ -98,6 +98,13 @@ def test_lm_trains_pp_dp():
     wf.run()
     wf.gd.loss.map_read()
     assert numpy.isfinite(wf.gd.loss.mem)
+    # decoding straight off the mesh-trained chain must work — the
+    # params ride Array.devmem, whose storage may be a sharded
+    # jax.Array after mesh training (XLA reshards into the decode)
+    from veles_tpu.models.generate import generate
+    out = generate(wf.forwards, numpy.asarray([[3, 1]], numpy.int32),
+                   4, kv_cache=True)
+    assert numpy.asarray(out).shape == (1, 6)
 
 
 def _tiny_lm_units():
