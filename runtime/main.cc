@@ -3,24 +3,37 @@
 // → Workflow::Initialize → Engine run, libVeles/src/engine.cc:30-77):
 //
 //   veles_runner <package.tar.gz> <input.npy> <output.npy> [--repeat N]
-//                [--generate N]
+//                [--generate N] [--temperature T] [--top-k K] [--seed S]
 //
 // Loads the package, runs the forward pass on the input batch, writes
 // the result as npy, and prints one JSON status line with timing.
 //
-// --generate N: autoregressive greedy decode through an LM package
+// --generate N: autoregressive decode through an LM package
 // (embedding + causal blocks + TokenProjection, [batch, seq] ids →
 // [batch, seq, vocab] logits).  The prompt fills the head of the
 // packaged fixed-seq window; each step runs the full forward and
-// appends argmax(logits[:, t-1, :]) at position t.  Causality makes
-// the zero-filled tail exact — the same fixed-buffer scheme as
-// veles_tpu.models.generate (token-for-token parity when the packaged
-// window equals prompt_len + N).  Output: [batch, prompt_len + N] ids.
+// appends the next token from logits[:, t-1, :] at position t.
+// Causality makes the zero-filled tail exact — the same fixed-buffer
+// scheme as veles_tpu.models.generate (greedy is token-for-token with
+// it when the packaged window equals prompt_len + N).  Output:
+// [batch, prompt_len + N] ids.
+//
+// --temperature T (> 0) switches to categorical sampling of
+// softmax(logits / T), --top-k K restricts it to the K most likely
+// tokens (requires a temperature, same contract as models/generate),
+// --seed S pins the sampler (default 0; deterministic — mt19937_64
+// engine bits mapped to [0,1) directly, so streams reproduce across
+// builds; NOT the Python side's threefry, so they do not match across
+// runtimes).  top-k 1 reduces to greedy.
 #include <algorithm>
 #include <chrono>
+#include <cmath>
 #include <cstdio>
 #include <cstring>
+#include <limits>
+#include <random>
 #include <string>
+#include <vector>
 
 #include "engine.h"
 #include "npy.h"
@@ -30,16 +43,25 @@ int main(int argc, char** argv) {
   if (argc < 4) {
     std::fprintf(stderr,
                  "usage: %s <package.tar.gz> <input.npy> <output.npy> "
-                 "[--repeat N] [--generate N]\n",
+                 "[--repeat N] [--generate N] [--temperature T] "
+                 "[--top-k K] [--seed S]\n",
                  argv[0]);
     return 2;
   }
-  int repeat = 1, generate = 0;
+  int repeat = 1, generate = 0, top_k = 0;
+  double temperature = 0.0;
+  unsigned long long seed = 0;
   for (int i = 4; i + 1 < argc; ++i) {
     if (std::strcmp(argv[i], "--repeat") == 0)
       repeat = std::max(1, std::atoi(argv[i + 1]));
     if (std::strcmp(argv[i], "--generate") == 0)
       generate = std::max(0, std::atoi(argv[i + 1]));
+    if (std::strcmp(argv[i], "--temperature") == 0)
+      temperature = std::max(0.0, std::atof(argv[i + 1]));
+    if (std::strcmp(argv[i], "--top-k") == 0)
+      top_k = std::max(0, std::atoi(argv[i + 1]));
+    if (std::strcmp(argv[i], "--seed") == 0)
+      seed = std::strtoull(argv[i + 1], nullptr, 10);
   }
   try {
     auto wf = veles_rt::PackagedWorkflow::Load(argv[1]);
@@ -63,6 +85,51 @@ int main(int argc, char** argv) {
       for (size_t n = 0; n < batch; ++n)
         std::memcpy(buf.ptr() + n * window, input.ptr() + n * prompt,
                     prompt * sizeof(float));
+      if (top_k > 0 && temperature <= 0.0)
+        throw std::runtime_error(  // same contract as models/generate
+            "--top-k only applies to sampling - set --temperature > 0");
+      std::mt19937_64 rng(seed);
+      std::vector<double> probs;
+      std::vector<float> scratch;
+      auto next_token = [&](const float* row, size_t vocab) -> size_t {
+        if (top_k > 0 && static_cast<size_t>(top_k) > vocab)
+          throw std::runtime_error("--top-k exceeds the model vocab");
+        size_t best = 0;
+        for (size_t j = 1; j < vocab; ++j)
+          if (row[j] > row[best]) best = j;
+        if (temperature <= 0.0 || top_k == 1) return best;
+        // categorical sample of softmax(row / T), optionally top-k
+        // restricted (ties with the k-th value stay in, matching the
+        // Python sampler's `z < kth` masking)
+        double thresh = -std::numeric_limits<double>::infinity();
+        if (top_k > 0 && static_cast<size_t>(top_k) < vocab) {
+          scratch.assign(row, row + vocab);
+          std::nth_element(scratch.begin(),
+                           scratch.begin() + (top_k - 1),
+                           scratch.end(), std::greater<float>());
+          thresh = scratch[top_k - 1];
+        }
+        double mx = row[best];
+        double denom = 0;
+        probs.assign(vocab, 0.0);
+        for (size_t j = 0; j < vocab; ++j) {
+          if (row[j] >= thresh) {
+            probs[j] = std::exp((row[j] - mx) / temperature);
+            denom += probs[j];
+          }
+        }
+        // uniform in [0, 1) straight from the engine bits — the
+        // std <random> DISTRIBUTIONS are implementation-defined, and
+        // per-seed reproducibility across builds is the contract here
+        double r = (rng() >> 11) * 0x1p-53 * denom;
+        for (size_t j = 0; j < vocab; ++j) {
+          if (probs[j] > 0) {  // a masked token must never win on r==0
+            r -= probs[j];
+            if (r <= 0) return j;
+          }
+        }
+        return best;  // numeric tail: fall back to the mode
+      };
       auto t0 = std::chrono::steady_clock::now();
       for (size_t t = prompt; t < total; ++t) {
         veles_rt::Tensor logits = wf.Run(buf, &pool);
@@ -73,10 +140,8 @@ int main(int argc, char** argv) {
         size_t vocab = logits.dim(2);
         for (size_t n = 0; n < batch; ++n) {
           const float* row = logits.ptr() + (n * window + t - 1) * vocab;
-          size_t best = 0;
-          for (size_t j = 1; j < vocab; ++j)
-            if (row[j] > row[best]) best = j;
-          buf.ptr()[n * window + t] = static_cast<float>(best);
+          buf.ptr()[n * window + t] =
+              static_cast<float>(next_token(row, vocab));
         }
       }
       double dt = std::chrono::duration<double>(
@@ -89,9 +154,10 @@ int main(int argc, char** argv) {
       veles_rt::npy::SaveFile(argv[3], out);
       std::printf(
           "{\"workflow\": \"%s\", \"units\": %zu, \"batch\": %zu, "
-          "\"generated\": %d, \"sec_total\": %.6f, "
-          "\"tokens_per_sec\": %.1f}\n",
-          wf.name().c_str(), wf.unit_count(), batch, generate, dt,
+          "\"generated\": %d, \"temperature\": %.3f, \"top_k\": %d, "
+          "\"sec_total\": %.6f, \"tokens_per_sec\": %.1f}\n",
+          wf.name().c_str(), wf.unit_count(), batch, generate,
+          temperature, top_k, dt,
           batch * generate / (dt > 0 ? dt : 1e-9));
       return 0;
     }

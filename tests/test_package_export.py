@@ -458,6 +458,69 @@ def test_cpp_runner_generate_greedy_parity(runner_binary, tmp_path):
         root.common.precision.compute_dtype = saved
 
 
+def test_cpp_runner_generate_sampling(runner_binary, tmp_path):
+    """Native sampled decode: deterministic per seed, tokens in-vocab,
+    and --top-k 1 reduces to greedy exactly."""
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.config import root
+    from veles_tpu.memory import Array
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.package_export import export_package
+
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    try:
+        wf = AcceleratedWorkflow(None, name="gensamp")
+        rng = numpy.random.default_rng(8)
+        prompt = rng.integers(1, 15, (2, 4)).astype(numpy.float32)
+        units = make_forwards(
+            wf, Array(numpy.zeros((2, 12), numpy.int32)), [
+                {"type": "embedding", "vocab": 15, "dim": 16},
+                {"type": "transformer_block", "heads": 2, "hidden": 24,
+                 "causal": True},
+                {"type": "token_logits", "vocab": 15},
+            ])
+        dev = Device(backend="numpy")
+        for u in units:
+            u.initialize(device=dev)
+        path = str(tmp_path / "gs.tar.gz")
+        export_package(units, path, (2, 12), name="gs")
+        numpy.save(tmp_path / "in.npy", prompt)
+
+        def decode(*extra):
+            out = str(tmp_path / "out.npy")
+            r = subprocess.run(
+                [runner_binary, path, str(tmp_path / "in.npy"), out,
+                 "--generate", "8"] + list(extra),
+                capture_output=True, text=True)
+            assert r.returncode == 0, r.stderr
+            return numpy.load(out).astype(numpy.int32)
+
+        greedy = decode()
+        a = decode("--temperature", "0.9", "--top-k", "5",
+                   "--seed", "11")
+        b = decode("--temperature", "0.9", "--top-k", "5",
+                   "--seed", "11")
+        numpy.testing.assert_array_equal(a, b)   # per-seed determinism
+        assert a.shape == (2, 12)
+        assert (a >= 0).all() and (a < 15).all()
+        numpy.testing.assert_array_equal(a[:, :4],
+                                         prompt.astype(numpy.int32))
+        # top-k 1 is greedy no matter the temperature
+        k1 = decode("--temperature", "5.0", "--top-k", "1")
+        numpy.testing.assert_array_equal(k1, greedy)
+        # --top-k without a temperature is an error (models/generate's
+        # contract), not silent greedy
+        r = subprocess.run(
+            [runner_binary, path, str(tmp_path / "in.npy"),
+             str(tmp_path / "out.npy"), "--generate", "4",
+             "--top-k", "5"],
+            capture_output=True, text=True)
+        assert r.returncode == 1 and "--temperature" in r.stderr
+    finally:
+        root.common.precision.compute_dtype = saved
+
+
 def test_cpp_runner_transformer(runner_binary, tmp_path):
     """Native transformer inference (embedding + pre-LN MHA block,
     dense AND MoE FFN variants + mean-pool + softmax) agrees with the
