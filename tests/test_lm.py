@@ -195,6 +195,41 @@ def test_generate_kv_cache_greedy_parity():
         root.common.precision.compute_dtype = saved
 
 
+def test_generate_variable_length_prompts():
+    """prompt_lens decodes a ragged batch in lockstep: each row's
+    greedy continuation equals a single-row decode of that prompt
+    alone (f32), on BOTH the kv-cached and full-rescan paths; and the
+    lens ride as a traced argument — a second length mix at the same
+    shapes must HIT the compiled-decode cache."""
+    from veles_tpu.models import generate as gen
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    try:
+        fw = _tiny_lm_units()
+        padded = jnp.asarray([[3, 1, 4, 1], [5, 9, 0, 0]], jnp.int32)
+        lens = [4, 2]
+        for kv in (False, True):
+            out = numpy.asarray(gen.generate(
+                fw, padded, 3, kv_cache=kv, prompt_lens=lens))
+            assert out.shape == (2, 7)
+            for n, ln in enumerate(lens):
+                solo = numpy.asarray(gen.generate(
+                    fw, padded[n:n + 1, :ln], 7 - ln, kv_cache=kv))
+                numpy.testing.assert_array_equal(
+                    out[n], solo[0], err_msg="row %d kv=%s" % (n, kv))
+        misses = gen._decode_cached_kv_varlen.cache_info().misses
+        gen.generate(fw, padded, 3, kv_cache=True,
+                     prompt_lens=[3, 1])  # new mix, same shapes
+        assert gen._decode_cached_kv_varlen.cache_info().misses \
+            == misses
+        with pytest.raises(ValueError, match="prompt_lens"):
+            gen.generate(fw, padded, 3, prompt_lens=[5, 2])
+        with pytest.raises(ValueError, match="prompt_lens"):
+            gen.generate(fw, padded, 3, prompt_lens=[4])
+    finally:
+        root.common.precision.compute_dtype = saved
+
+
 def test_generate_kv_cache_sampling_key_schedule():
     """The cached path draws the same tokens as the uncached path for
     a given key/settings (one split per decode step in both)."""

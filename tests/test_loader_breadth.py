@@ -191,6 +191,21 @@ def test_restful_api_generate_endpoint():
         batched = post({"prompt": [[3, 1, 4], [5, 9, 2]], "steps": 4})
         assert len(batched["tokens"]) == 2
         assert len(batched["tokens"][0]) == 7
+        # RAGGED batch: each row answers with its own prompt + steps
+        # tokens, and each greedy row equals its solo decode (f32 so
+        # bf16 near-tie reduction-order flips can't fail the parity)
+        from veles_tpu.config import root as _root
+        _saved = _root.common.precision.get("compute_dtype", "bfloat16")
+        _root.common.precision.compute_dtype = "float32"
+        try:
+            ragged = post({"prompt": [[3, 1, 4], [5]], "steps": 4})
+            assert [len(r) for r in ragged["tokens"]] == [7, 5]
+            solo0 = post({"prompt": [3, 1, 4], "steps": 4})
+            solo1 = post({"prompt": [5], "steps": 4})
+            assert ragged["tokens"][0] == solo0["tokens"]
+            assert ragged["tokens"][1] == solo1["tokens"]
+        finally:
+            _root.common.precision.compute_dtype = _saved
         sampled = post({"prompt": [1, 2], "steps": 4,
                         "temperature": 0.9, "top_k": 5, "seed": 7})
         assert len(sampled["tokens"]) == 6
@@ -202,7 +217,9 @@ def test_restful_api_generate_endpoint():
         assert len(unpinned["tokens"]) == 5
         # malformed prompts are client errors, not phantom decodes
         for bad in ({"prompt": [], "steps": 2},
-                    {"prompt": [3, 999], "steps": 2}):
+                    {"prompt": [3, 999], "steps": 2},
+                    {"prompt": [[[3, 1], [4, 5]]], "steps": 2},
+                    {"prompt": [[3, 1], []], "steps": 2}):
             try:
                 post(bad)
                 assert False, "expected 400 for %s" % bad

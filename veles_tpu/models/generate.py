@@ -18,6 +18,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy
 
 
 def _chain_logits(forwards, params, tokens):
@@ -58,7 +59,7 @@ def kv_cache_eligible(forwards):
 
 
 def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
-             key=None, kv_cache=False):
+             key=None, kv_cache=False, prompt_lens=None):
     """Decode ``steps`` tokens after ``prompt`` [batch, prompt_len]
     (int32) through a forward chain ending in per-token logits
     (Embedding → TransformerBlock × N → TokenProjection).
@@ -72,7 +73,22 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
       chains; greedy parity with the uncached scan is tested
       token-for-token in f32.  The sampling key schedule matches the
       uncached path (one split per decode step), so a given
-      ``key``/settings pair draws the same tokens either way.
+      ``key``/settings pair draws the same tokens either way;
+    - ``prompt_lens`` (optional, [batch] ints) — VARIABLE-LENGTH
+      batched prompts: row ``n``'s prompt occupies its first
+      ``prompt_lens[n]`` positions (front-aligned; pad the rest of the
+      [batch, prompt_len] array arbitrarily — generation overwrites
+      the padding in place as it reaches it) and its generated region
+      starts right after.  Every row decodes to the shared buffer end
+      ``prompt_len + steps``, so row ``n`` gets
+      ``prompt_len + steps - prompt_lens[n]`` ≥ ``steps`` new tokens;
+      slice ``out[n, :prompt_lens[n] + k]`` for exactly ``k``.
+      Greedy per-row results equal a single-row decode of the same
+      prompt (tested).  The lens ride the compiled decode as a traced
+      argument — one executable serves ANY length mix at the same
+      (batch, prompt_len, steps).  Key schedule: one split per buffer
+      position (all rows advance in lockstep), so sampled streams
+      differ from the uniform-length path's.
 
     Returns [batch, prompt_len + steps] tokens."""
     # device-resident params (Array.devmem uploads lazily ONCE and
@@ -85,6 +101,16 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p_len = prompt.shape
     total = p_len + int(steps)
+    lens = None
+    if prompt_lens is not None:
+        lens_np = numpy.asarray(prompt_lens, numpy.int32)
+        if lens_np.shape != (b,):
+            raise ValueError("prompt_lens must be [batch] ints")
+        if lens_np.min() < 1 or lens_np.max() > p_len:
+            raise ValueError(
+                "prompt_lens must be in [1, %d] (the prompt width)"
+                % p_len)
+        lens = jnp.asarray(lens_np)
     if temperature and key is None:
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
     if key is None:
@@ -146,6 +172,35 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
                                            (0, pos + 1))
         return (buf, pos + 1, k, caches), None
 
+    def var_step(params, carry, _):
+        # variable-length lockstep (kv): consume position pos, write
+        # pos+1 only for rows whose prompt has ended — prompt tokens
+        # pass through untouched, padding is overwritten in place
+        buf, pos, k, caches, row_lens = carry
+        tok = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
+        logits, caches = _chain_step(forwards, params, tok, pos, caches)
+        k, sub = jax.random.split(k)
+        nxt = sample(logits[:, 0], sub)
+        cur = jax.lax.dynamic_slice(buf, (0, pos + 1), (b, 1))[:, 0]
+        write = jnp.where(pos + 1 >= row_lens, nxt, cur)
+        buf = jax.lax.dynamic_update_slice(buf, write[:, None],
+                                           (0, pos + 1))
+        return (buf, pos + 1, k, caches, row_lens), None
+
+    def var_step_full(params, carry, _):
+        # variable-length lockstep, full-buffer rescan variant
+        buf, pos, k, row_lens = carry
+        logits = _chain_logits(forwards, params, buf)
+        row = jax.lax.dynamic_slice(
+            logits, (0, pos, 0), (b, 1, logits.shape[-1]))[:, 0]
+        k, sub = jax.random.split(k)
+        nxt = sample(row, sub)
+        cur = jax.lax.dynamic_slice(buf, (0, pos + 1), (b, 1))[:, 0]
+        write = jnp.where(pos + 1 >= row_lens, nxt, cur)
+        buf = jax.lax.dynamic_update_slice(buf, write[:, None],
+                                           (0, pos + 1))
+        return (buf, pos + 1, k, row_lens), None
+
     # params travel as jit ARGUMENTS (constants baked into the trace
     # would bloat the executable) and the compiled decode is cached on
     # the chain's ARCHITECTURE SIGNATURE + every static piece of the
@@ -167,7 +222,8 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
     # program on shape-identical calls
     cache_key = (sig, b, int(steps), p_len,
                  float(temperature or 0.0), int(top_k or 0),
-                 bool(kv_cache), str(dtypes.compute_dtype()),
+                 bool(kv_cache), lens is not None,
+                 str(dtypes.compute_dtype()),
                  str(dtypes.matmul_precision()))
     if kv_cache:
         for u in forwards:
@@ -191,9 +247,21 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
         caches0 = {i: u.init_cache(b, total, dtypes.compute_dtype())
                    for i, u in enumerate(forwards)
                    if hasattr(u, "init_cache")}
+        if lens is not None:
+            decode = _decode_cached_kv_varlen(
+                cache_key, _StepClosure(var_step))
+            return decode(params, buf0, key, caches0, lens)
         decode = _decode_cached_kv(
             cache_key, _StepClosure((pre_step, dec_step)))
         return decode(params, buf0, key, caches0)
+    if lens is not None:
+        # positions before every row's prompt end need no forward at
+        # all on the rescan path — start at the host-known min length
+        # (part of the key: the scan length is baked into the trace)
+        vmin = int(lens_np.min())
+        decode = _decode_cached_varlen(
+            cache_key + (vmin,), _StepClosure(var_step_full))
+        return decode(params, buf0, key, lens)
     decode = _decode_cached(cache_key, _StepClosure(step))
     return decode(params, buf0, key)
 
@@ -214,11 +282,20 @@ class _StepClosure:
         return isinstance(other, _StepClosure)
 
 
+def clear_decode_caches():
+    """Drop EVERY compiled-decode cache (all four LRUs below), freeing
+    the parameter Arrays their step closures pin.  A serving process
+    that cycles many large models through decode should call this when
+    it retires one — entries otherwise hold the retired chain's units
+    (host + device memory) alive until LRU eviction at 16 entries."""
+    for cache in (_decode_cached, _decode_cached_kv,
+                  _decode_cached_varlen, _decode_cached_kv_varlen):
+        cache.cache_clear()
+
+
 # NOTE on lifetime: a cached entry's step closure holds the chain's
 # units (and therefore their parameter Arrays, host + device) alive
-# until LRU eviction — a serving process that cycles many large models
-# through decode should call `_decode_cached.cache_clear()` /
-# `_decode_cached_kv.cache_clear()` when it retires one.
+# until LRU eviction — retire models with clear_decode_caches().
 @functools.lru_cache(maxsize=16)
 def _decode_cached(cache_key, step_closure):
     steps, p_len = cache_key[2], cache_key[3]
@@ -248,6 +325,37 @@ def _decode_cached_kv(cache_key, step_closure):
             functools.partial(dec_step, params),
             (buf, jnp.int32(p_len - 1), key, caches), None,
             length=steps)
+        return buf
+
+    return decode
+
+
+@functools.lru_cache(maxsize=16)
+def _decode_cached_varlen(cache_key, step_closure):
+    total = cache_key[2] + cache_key[3]  # steps + p_len
+    vmin = cache_key[-1]                 # min prompt length
+
+    @jax.jit
+    def decode(params, buf, key, lens):
+        (buf, _, _, _), _ = jax.lax.scan(
+            functools.partial(step_closure.fn, params),
+            (buf, jnp.int32(vmin - 1), key, lens), None,
+            length=total - vmin)
+        return buf
+
+    return decode
+
+
+@functools.lru_cache(maxsize=16)
+def _decode_cached_kv_varlen(cache_key, step_closure):
+    total = cache_key[2] + cache_key[3]  # steps + p_len
+
+    @jax.jit
+    def decode(params, buf, key, caches, lens):
+        (buf, _, _, _, _), _ = jax.lax.scan(
+            functools.partial(step_closure.fn, params),
+            (buf, jnp.int32(0), key, caches, lens), None,
+            length=total - 1)
         return buf
 
     return decode

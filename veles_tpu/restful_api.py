@@ -93,20 +93,23 @@ class RESTfulAPI(Unit):
         on a phantom zero row, or gather a clamped wrong embedding)."""
         if prompt.ndim != 2 or prompt.shape[1] < 1 or not prompt.size:
             return "prompt must be a non-empty token list (or a " \
-                   "batch of equal-length lists)"
+                   "batch of non-empty lists — ragged is fine)"
         vocab = getattr(self.forwards[0], "vocab", None)
         if vocab is not None and \
                 (prompt.min() < 0 or prompt.max() >= int(vocab)):
             return "prompt token ids must be in [0, %d)" % vocab
         return None
 
-    def _decode(self, prompt, steps, temperature, top_k, seed):
+    def _decode(self, prompt, steps, temperature, top_k, seed,
+                prompt_lens=None):
         """Run the decode for /generate — kv-cached when the chain is
         eligible, full-buffer rescan otherwise.  Serialized: decode
         requests share the chain's param Arrays and the compile
         caches; a novel (batch, prompt_len, steps, sampler) shape
         compiles a fresh executable on first use (seconds), so
-        variable-shape clients pay per shape, cached thereafter."""
+        variable-shape clients pay per shape, cached thereafter
+        (ragged lengths within one shape reuse the same executable —
+        the lens are a traced argument)."""
         import jax
 
         from veles_tpu.models.generate import generate, \
@@ -121,7 +124,8 @@ class RESTfulAPI(Unit):
             return generate(self.forwards, prompt, steps,
                             temperature=temperature, top_k=top_k,
                             key=key,
-                            kv_cache=kv_cache_eligible(self.forwards))
+                            kv_cache=kv_cache_eligible(self.forwards),
+                            prompt_lens=prompt_lens)
 
     def init_unpickled(self):
         super(RESTfulAPI, self).init_unpickled()
@@ -173,21 +177,52 @@ class RESTfulAPI(Unit):
                         length = int(
                             self.headers.get("Content-Length", 0))
                         body = json.loads(self.rfile.read(length))
-                        prompt = numpy.asarray(body["prompt"],
-                                               numpy.int32)
-                        squeeze = prompt.ndim == 1
-                        if squeeze:
-                            prompt = prompt[None]
+                        raw = body["prompt"]
+                        squeeze = bool(raw) and \
+                            not isinstance(raw[0], list)
+                        rows = [raw] if squeeze else list(raw)
+                        lens = [len(r) for r in rows]
+                        if not rows or min(lens, default=0) < 1:
+                            self.send_error(
+                                400, "prompt rows must be non-empty "
+                                "token lists")
+                            return
+                        # rows may be RAGGED: pad to the widest and
+                        # hand the true lengths to the decode
+                        width = max(lens)
+                        prompt = numpy.zeros((len(rows), width),
+                                             numpy.int32)
+                        for i, r in enumerate(rows):
+                            try:
+                                row = numpy.asarray(r, numpy.int32)
+                                if row.ndim != 1:
+                                    raise ValueError(row.ndim)
+                            except (TypeError, ValueError):
+                                # nested/mixed rows are CLIENT errors,
+                                # not server faults
+                                self.send_error(
+                                    400, "prompt rows must be flat "
+                                    "lists of token ids")
+                                return
+                            prompt[i, :len(r)] = row
                         err = api._validate_prompt(prompt)
                         if err:
                             self.send_error(400, err)
                             return
+                        steps = int(body["steps"])
+                        ragged = min(lens) != width
                         tokens = api._decode(
-                            prompt, int(body["steps"]),
+                            prompt, steps,
                             float(body.get("temperature", 0.0)),
                             int(body.get("top_k", 0)),
-                            body.get("seed"))
-                        tokens = numpy.asarray(tokens).tolist()
+                            body.get("seed"),
+                            prompt_lens=lens if ragged else None)
+                        tokens = numpy.asarray(tokens)
+                        # each row answers with ITS prompt + steps
+                        # tokens (shorter rows decode past their quota
+                        # in lockstep; the surplus is sliced off)
+                        tokens = [tokens[i, :lens[i] + steps].tolist()
+                                  for i in range(len(rows))]
                         blob = json.dumps(
                             {"tokens": tokens[0] if squeeze
                              else tokens}).encode()
