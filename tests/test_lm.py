@@ -230,6 +230,54 @@ def test_generate_variable_length_prompts():
         root.common.precision.compute_dtype = saved
 
 
+def test_generate_beam_search():
+    """Beam decode: beam=1 equals greedy; every returned score is the
+    sequence's exact teacher-forced log-prob (re-scored by the full
+    forward); beams come back best-first."""
+    from veles_tpu.models.generate import (_chain_logits, generate,
+                                           generate_beam)
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    try:
+        fw = _tiny_lm_units()
+        params = {i: {n: jnp.asarray(a.map_read().mem)
+                      for n, a in u.param_arrays().items()}
+                  for i, u in enumerate(fw)}
+        prompt = jnp.asarray([[3, 1, 4], [5, 9, 2]], jnp.int32)
+        steps, p_len = 5, 3
+        b1_tokens, _ = generate_beam(fw, prompt, steps, beam=1)
+        greedy = generate(fw, prompt, steps, kv_cache=True)
+        numpy.testing.assert_array_equal(
+            numpy.asarray(b1_tokens)[:, 0], numpy.asarray(greedy))
+
+        tokens, scores = generate_beam(fw, prompt, steps, beam=4)
+        tokens = numpy.asarray(tokens)
+        scores = numpy.asarray(scores)
+        assert tokens.shape == (2, 4, 8) and scores.shape == (2, 4)
+        assert (numpy.diff(scores, axis=1) <= 1e-6).all()  # best-first
+        # exact re-score: sum of log p(token_{t+1} | prefix) over the
+        # generated region must equal the reported cumulative score
+        for n in range(2):
+            assert len({tuple(r) for r in tokens[n]}) == 4  # distinct
+            for k in range(4):
+                logits = numpy.asarray(_chain_logits(
+                    fw, params, jnp.asarray(tokens[n, k][None])))[0]
+                logp = logits - numpy.log(
+                    numpy.exp(logits - logits.max(-1, keepdims=True)
+                              ).sum(-1, keepdims=True)) \
+                    - logits.max(-1, keepdims=True)
+                total_lp = sum(
+                    logp[t, tokens[n, k, t + 1]]
+                    for t in range(p_len - 1, p_len + steps - 1))
+                numpy.testing.assert_allclose(
+                    scores[n, k], total_lp, atol=1e-4,
+                    err_msg="row %d beam %d" % (n, k))
+        with pytest.raises(ValueError, match="beam"):
+            generate_beam(fw, prompt, 2, beam=0)
+    finally:
+        root.common.precision.compute_dtype = saved
+
+
 def test_generate_kv_cache_sampling_key_schedule():
     """The cached path draws the same tokens as the uncached path for
     a given key/settings (one split per decode step in both)."""

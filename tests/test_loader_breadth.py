@@ -215,6 +215,22 @@ def test_restful_api_generate_endpoint():
         unpinned = post({"prompt": [1, 2], "steps": 3,
                          "temperature": 0.9})
         assert len(unpinned["tokens"]) == 5
+        # beam search over REST: best-first beams with scores; the
+        # top beam is the answer in "tokens"
+        bm = post({"prompt": [3, 1, 4], "steps": 3, "beam": 3})
+        assert len(bm["beams"]) == 3 and len(bm["scores"]) == 3
+        assert bm["tokens"] == bm["beams"][0]
+        assert all(len(r) == 6 for r in bm["beams"])
+        assert sorted(bm["scores"], reverse=True) == bm["scores"]
+        for bad_beam in ({"prompt": [3, 1], "steps": 2, "beam": 2,
+                          "temperature": 0.5},
+                         {"prompt": [3, 1], "steps": 2, "beam": -1},
+                         {"prompt": [3, 1], "steps": 2, "beam": 99}):
+            try:
+                post(bad_beam)
+                assert False, "expected 400 for %s" % bad_beam
+            except urllib.error.HTTPError as e:
+                assert e.code == 400, bad_beam
         # malformed prompts are client errors, not phantom decodes
         for bad in ({"prompt": [], "steps": 2},
                     {"prompt": [3, 999], "steps": 2},

@@ -43,6 +43,53 @@ def _chain_step(forwards, params, tok, pos, caches):
     return h, out
 
 
+def _device_params(forwards):
+    # device-resident params (Array.devmem uploads lazily ONCE and
+    # stays coherent): repeated decode calls must not re-ship the
+    # weights host→device — through a remote-device tunnel that upload
+    # dwarfs the decode itself
+    return {i: {name: arr.devmem
+                for name, arr in u.param_arrays().items()}
+            for i, u in enumerate(forwards)}
+
+
+def _check_positions(forwards, total):
+    for u in forwards:
+        pos_table = getattr(u, "positions", None)
+        if pos_table is not None and hasattr(pos_table, "shape") \
+                and len(pos_table.shape) == 2 \
+                and total > pos_table.shape[0]:
+            raise ValueError(
+                "prompt_len + steps = %d exceeds the model's learned "
+                "positional table (%d — the training sequence length)"
+                % (total, pos_table.shape[0]))
+
+
+def _arch_sig(forwards):
+    # the architecture signature the compiled-decode caches key on
+    # (identical signatures define the identical computation, so
+    # sharing the executable across chains is correct — and object ids
+    # would be unsound: id reuse after gc replayed a stale chain's
+    # executable; caught by the test suite)
+    return tuple(
+        (type(u).__name__,
+         repr(sorted(u.export_config().items(), key=str)),
+         tuple(sorted((n, tuple(a.mem.shape))
+                      for n, a in u.param_arrays().items())))
+        for u in forwards)
+
+
+def _make_pre_step(forwards, b):
+    """Prompt-prefill step builder: consume one prompt token at
+    ``pos``, populate the KV caches, sample nothing."""
+    def pre_step(params, carry, _):
+        buf, pos, caches = carry
+        tok = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
+        _, caches = _chain_step(forwards, params, tok, pos, caches)
+        return (buf, pos + 1, caches), None
+    return pre_step
+
+
 def kv_cache_eligible(forwards):
     """True when :func:`generate` can decode this chain with
     ``kv_cache=True``: every cacheable block is causal and every other
@@ -91,13 +138,7 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
       differ from the uniform-length path's.
 
     Returns [batch, prompt_len + steps] tokens."""
-    # device-resident params (Array.devmem uploads lazily ONCE and
-    # stays coherent): repeated generate() calls must not re-ship the
-    # weights host→device — through a remote-device tunnel that upload
-    # dwarfs the decode itself
-    params = {i: {name: arr.devmem
-                  for name, arr in u.param_arrays().items()}
-              for i, u in enumerate(forwards)}
+    params = _device_params(forwards)
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p_len = prompt.shape
     total = p_len + int(steps)
@@ -115,15 +156,7 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
     if key is None:
         key = jax.random.key(0)
-    for u in forwards:
-        pos_table = getattr(u, "positions", None)
-        if pos_table is not None and hasattr(pos_table, "shape") \
-                and len(pos_table.shape) == 2 \
-                and total > pos_table.shape[0]:
-            raise ValueError(
-                "prompt_len + steps = %d exceeds the model's learned "
-                "positional table (%d — the training sequence length)"
-                % (total, pos_table.shape[0]))
+    _check_positions(forwards, total)
     vocab = getattr(forwards[-1], "vocab", None)
     if top_k and vocab is not None and int(top_k) > int(vocab):
         raise ValueError("top_k %d > vocab %d" % (top_k, vocab))
@@ -155,12 +188,7 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
         buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, pos))
         return (buf, pos + 1, k), None
 
-    def pre_step(params, carry, _):
-        # prompt prefill: consume one prompt token, populate caches
-        buf, pos, caches = carry
-        tok = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
-        _, caches = _chain_step(forwards, params, tok, pos, caches)
-        return (buf, pos + 1, caches), None
+    pre_step = _make_pre_step(forwards, b)
 
     def dec_step(params, carry, _):
         buf, pos, k, caches = carry
@@ -203,19 +231,11 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
 
     # params travel as jit ARGUMENTS (constants baked into the trace
     # would bloat the executable) and the compiled decode is cached on
-    # the chain's ARCHITECTURE SIGNATURE + every static piece of the
-    # decode config (batch, lengths, sampler settings — they are
-    # baked into the step closure).  Identical signatures define the
-    # identical computation, so sharing the executable across chains
-    # is correct — and object ids would be unsound (id reuse after gc
-    # replayed a stale chain's executable; caught by the test suite)
+    # the chain's ARCHITECTURE SIGNATURE (_arch_sig) + every static
+    # piece of the decode config (batch, lengths, sampler settings —
+    # they are baked into the step closure)
     from veles_tpu import dtypes
-    sig = tuple(
-        (type(u).__name__,
-         repr(sorted(u.export_config().items(), key=str)),
-         tuple(sorted((n, tuple(a.mem.shape))
-                      for n, a in u.param_arrays().items())))
-        for u in forwards)
+    sig = _arch_sig(forwards)
     # the compute/precision policy is read from GLOBAL config inside
     # the trace (the casts are baked into the executable) — it must
     # key the cache or a dtype toggle would replay the other policy's
@@ -266,6 +286,83 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
     return decode(params, buf0, key)
 
 
+def generate_beam(forwards, prompt, steps, beam):
+    """Beam-search decode: keep the ``beam`` highest-cumulative-log-
+    probability continuations at every step (deterministic; the
+    sampling knobs live in :func:`generate`).  Rides the kv-cache
+    machinery — caches carry ``batch·beam`` rows and are re-gathered
+    to each step's surviving parents.
+
+    Returns ``(tokens, scores)``: tokens [batch, beam, prompt_len +
+    steps] best-first, scores [batch, beam] — the cumulative log-prob
+    of each generated region under the model, exactly re-scorable by
+    a teacher-forced forward (tested).  ``beam=1`` equals greedy
+    :func:`generate`."""
+    from veles_tpu import dtypes
+    if not kv_cache_eligible(forwards):
+        raise ValueError(
+            "beam search decodes on the kv-cache path — this chain "
+            "is not cacheable (see kv_cache_eligible)")
+    beam = int(beam)
+    if beam < 1:
+        raise ValueError("beam must be >= 1")
+    params = _device_params(forwards)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p_len = prompt.shape
+    total = p_len + int(steps)
+    _check_positions(forwards, total)
+    vocab = getattr(forwards[-1], "vocab", None)
+    if vocab is not None and beam > int(vocab):
+        raise ValueError("beam %d > vocab %d" % (beam, vocab))
+
+    buf0 = jnp.zeros((b, total), jnp.int32)
+    buf0 = jax.lax.dynamic_update_slice(buf0, prompt, (0, 0))
+    caches0 = {i: u.init_cache(b, total, dtypes.compute_dtype())
+               for i, u in enumerate(forwards)
+               if hasattr(u, "init_cache")}
+
+    pre_step = _make_pre_step(forwards, b)
+
+    def beam_step(params, carry, _):
+        bufs, scores, pos, caches = carry        # bufs [b, beam, total]
+        tok = jax.lax.dynamic_slice(
+            bufs, (0, 0, pos), (b, beam, 1)).reshape(b * beam, 1)
+        logits, caches = _chain_step(forwards, params, tok, pos, caches)
+        logp = jax.nn.log_softmax(
+            logits[:, 0].astype(jnp.float32)).reshape(b, beam, -1)
+        # the first expansion starts from `beam` IDENTICAL rows — mask
+        # all but row 0 or the top-k would pick the same token k times
+        first = pos == jnp.int32(p_len - 1)
+        dup_pen = jnp.where(
+            first & (jnp.arange(beam)[None, :, None] > 0),
+            -jnp.inf, 0.0)
+        cand = scores[:, :, None] + logp + dup_pen
+        nv = cand.shape[-1]
+        scores, flat = jax.lax.top_k(cand.reshape(b, beam * nv), beam)
+        parent = flat // nv                       # [b, beam]
+        token = (flat % nv).astype(jnp.int32)
+        bufs = jnp.take_along_axis(bufs, parent[:, :, None], axis=1)
+        bufs = jax.lax.dynamic_update_slice(
+            bufs, token[:, :, None], (0, 0, pos + 1))
+
+        def regather(leaf):                       # [b·beam, ...]
+            shaped = leaf.reshape((b, beam) + leaf.shape[1:])
+            idx = parent.reshape(
+                (b, beam) + (1,) * (len(leaf.shape) - 1))
+            return jnp.take_along_axis(shaped, idx,
+                                       axis=1).reshape(leaf.shape)
+
+        caches = jax.tree_util.tree_map(regather, caches)
+        return (bufs, scores, pos + 1, caches), None
+
+    cache_key = (_arch_sig(forwards), b, int(steps), p_len, beam,
+                 "beam", str(dtypes.compute_dtype()),
+                 str(dtypes.matmul_precision()))
+    decode = _decode_cached_beam(
+        cache_key, _StepClosure((pre_step, beam_step, beam)))
+    return decode(params, buf0, caches0)
+
+
 class _StepClosure:
     """Always-equal wrapper: the cache keys on ``cache_key`` (the
     architecture signature + batch/lengths/sampler settings) —
@@ -283,13 +380,14 @@ class _StepClosure:
 
 
 def clear_decode_caches():
-    """Drop EVERY compiled-decode cache (all four LRUs below), freeing
+    """Drop EVERY compiled-decode cache (all five LRUs below), freeing
     the parameter Arrays their step closures pin.  A serving process
     that cycles many large models through decode should call this when
     it retires one — entries otherwise hold the retired chain's units
     (host + device memory) alive until LRU eviction at 16 entries."""
     for cache in (_decode_cached, _decode_cached_kv,
-                  _decode_cached_varlen, _decode_cached_kv_varlen):
+                  _decode_cached_varlen, _decode_cached_kv_varlen,
+                  _decode_cached_beam):
         cache.cache_clear()
 
 
@@ -342,6 +440,31 @@ def _decode_cached_varlen(cache_key, step_closure):
             (buf, jnp.int32(vmin - 1), key, lens), None,
             length=total - vmin)
         return buf
+
+    return decode
+
+
+@functools.lru_cache(maxsize=16)
+def _decode_cached_beam(cache_key, step_closure):
+    steps, p_len = cache_key[2], cache_key[3]
+    pre_step, beam_step, beam = step_closure.fn
+
+    @jax.jit
+    def decode(params, buf, caches):
+        if p_len > 1:  # prefill at batch b, then tile beam-ways
+            (buf, _, caches), _ = jax.lax.scan(
+                functools.partial(pre_step, params),
+                (buf, jnp.int32(0), caches), None, length=p_len - 1)
+        b, total = buf.shape
+        bufs = jnp.repeat(buf[:, None, :], beam, axis=1)
+        caches = jax.tree_util.tree_map(
+            lambda x: jnp.repeat(x, beam, axis=0), caches)
+        scores = jnp.zeros((b, beam), jnp.float32)
+        (bufs, scores, _, _), _ = jax.lax.scan(
+            functools.partial(beam_step, params),
+            (bufs, scores, jnp.int32(p_len - 1), caches), None,
+            length=steps)
+        return bufs, scores
 
     return decode
 

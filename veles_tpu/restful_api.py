@@ -100,6 +100,13 @@ class RESTfulAPI(Unit):
             return "prompt token ids must be in [0, %d)" % vocab
         return None
 
+    def _decode_beam(self, prompt, steps, beam):
+        """Beam-search decode for /generate (serialized like
+        :meth:`_decode`)."""
+        from veles_tpu.models.generate import generate_beam
+        with self._decode_lock_:
+            return generate_beam(self.forwards, prompt, steps, beam)
+
     def _decode(self, prompt, steps, temperature, top_k, seed,
                 prompt_lens=None):
         """Run the decode for /generate — kv-cached when the chain is
@@ -151,6 +158,14 @@ class RESTfulAPI(Unit):
             def log_message(self, *args):
                 pass
 
+            def _reply_json(self, obj):
+                blob = json.dumps(obj).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
             def do_POST(self):
                 if self.path.rstrip("/") == "/shutdown":
                     # control-plane guard: when serving beyond loopback,
@@ -160,11 +175,7 @@ class RESTfulAPI(Unit):
                     if peer not in ("127.0.0.1", "::1", "localhost"):
                         self.send_error(403, "shutdown is loopback-only")
                         return
-                    blob = b'{"ok": true}'
-                    self.send_response(200)
-                    self.send_header("Content-Length", str(len(blob)))
-                    self.end_headers()
-                    self.wfile.write(blob)
+                    self._reply_json({"ok": True})
                     if api.shutdown_callback is not None:
                         api.shutdown_callback()
                     return
@@ -211,6 +222,44 @@ class RESTfulAPI(Unit):
                             return
                         steps = int(body["steps"])
                         ragged = min(lens) != width
+                        try:
+                            beam = int(body.get("beam", 0))
+                        except (TypeError, ValueError):
+                            self.send_error(400, "beam must be an int")
+                            return
+                        if beam < 0:
+                            self.send_error(400, "beam must be >= 1")
+                            return
+                        if beam:
+                            if float(body.get("temperature", 0.0)) \
+                                    or int(body.get("top_k", 0)):
+                                self.send_error(
+                                    400, "beam search is deterministic"
+                                    " - drop temperature/top_k")
+                                return
+                            if ragged:
+                                self.send_error(
+                                    400, "beam search needs equal-"
+                                    "length prompts")
+                                return
+                            try:
+                                toks, scores = api._decode_beam(
+                                    prompt, steps, beam)
+                            except ValueError as e:
+                                # beam > vocab / non-cacheable chain:
+                                # the client's request, not our fault
+                                self.send_error(400, _status_text(e))
+                                return
+                            toks = numpy.asarray(toks).tolist()
+                            scores = numpy.asarray(scores).tolist()
+                            reply = {"tokens": [r[0] for r in toks],
+                                     "beams": toks, "scores": scores}
+                            if squeeze:
+                                reply = {"tokens": toks[0][0],
+                                         "beams": toks[0],
+                                         "scores": scores[0]}
+                            self._reply_json(reply)
+                            return
                         tokens = api._decode(
                             prompt, steps,
                             float(body.get("temperature", 0.0)),
@@ -223,16 +272,9 @@ class RESTfulAPI(Unit):
                         # in lockstep; the surplus is sliced off)
                         tokens = [tokens[i, :lens[i] + steps].tolist()
                                   for i in range(len(rows))]
-                        blob = json.dumps(
+                        self._reply_json(
                             {"tokens": tokens[0] if squeeze
-                             else tokens}).encode()
-                        self.send_response(200)
-                        self.send_header("Content-Type",
-                                         "application/json")
-                        self.send_header("Content-Length",
-                                         str(len(blob)))
-                        self.end_headers()
-                        self.wfile.write(blob)
+                             else tokens})
                     except Exception as e:
                         self.send_error(500, _status_text(e))
                     return
@@ -245,12 +287,7 @@ class RESTfulAPI(Unit):
                     sample = numpy.asarray(body["input"], numpy.float32)
                     future = api.loader.feed_request(sample)
                     result = future.result(api.request_timeout)
-                    blob = json.dumps({"result": result}).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(blob)))
-                    self.end_headers()
-                    self.wfile.write(blob)
+                    self._reply_json({"result": result})
                 except Exception as e:  # one bad request must not kill
                     self.send_error(500, _status_text(e))  # the server
 
