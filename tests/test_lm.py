@@ -230,6 +230,58 @@ def test_generate_variable_length_prompts():
         root.common.precision.compute_dtype = saved
 
 
+def test_generate_stop_token():
+    """A generated stop token freezes its row: output matches the
+    unstopped decode up to and including the first generated stop,
+    then repeats it; prompt occurrences do not stop a row.  All three
+    sampling paths (full rescan, kv, varlen)."""
+    from veles_tpu.models.generate import generate
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    try:
+        fw = _tiny_lm_units()
+        prompt = jnp.asarray([[3, 1, 4], [5, 9, 2]], jnp.int32)
+        steps, p_len = 6, 3
+        free = numpy.asarray(generate(fw, prompt, steps))
+        # choose a token the unstopped decode actually emits mid-way
+        stop = int(free[0, p_len + 1])
+        first = {n: next(
+            (t for t in range(p_len, p_len + steps)
+             if free[n, t] == stop), None) for n in range(2)}
+        for kv in (False, True):
+            out = numpy.asarray(generate(fw, prompt, steps,
+                                         kv_cache=kv, stop_token=stop))
+            for n in range(2):
+                f = first[n]
+                if f is None:
+                    numpy.testing.assert_array_equal(out[n], free[n])
+                else:
+                    numpy.testing.assert_array_equal(
+                        out[n, :f + 1], free[n, :f + 1])
+                    assert (out[n, f:] == stop).all(), (n, kv)
+        # prompt containing the stop token still decodes
+        p2 = jnp.asarray([[stop, 1, 4]], jnp.int32)
+        out2 = numpy.asarray(generate(fw, p2, 4, stop_token=stop))
+        assert out2.shape == (1, 7) and out2[0, 0] == stop
+        # varlen path: same freeze semantics per row
+        outv = numpy.asarray(generate(
+            fw, prompt, steps, kv_cache=True, stop_token=stop,
+            prompt_lens=[3, 3]))
+        numpy.testing.assert_array_equal(
+            outv, numpy.asarray(generate(fw, prompt, steps,
+                                         kv_cache=True,
+                                         stop_token=stop)))
+        # the stop VALUE is traced — a different id at the same shapes
+        # must HIT the compiled-decode cache
+        from veles_tpu.models import generate as gen
+        misses = gen._decode_cached_kv.cache_info().misses
+        gen.generate(fw, prompt, steps, kv_cache=True,
+                     stop_token=(stop + 1) % 12)
+        assert gen._decode_cached_kv.cache_info().misses == misses
+    finally:
+        root.common.precision.compute_dtype = saved
+
+
 def test_generate_beam_search():
     """Beam decode: beam=1 equals greedy; every returned score is the
     sequence's exact teacher-forced log-prob (re-scored by the full

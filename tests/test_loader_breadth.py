@@ -215,6 +215,13 @@ def test_restful_api_generate_endpoint():
         unpinned = post({"prompt": [1, 2], "steps": 3,
                          "temperature": 0.9})
         assert len(unpinned["tokens"]) == 5
+        # "stop": a generated stop token truncates the reply there
+        # (deterministic: greedy repeats, so pick a token greedy emits)
+        g = post({"prompt": [3, 1, 4], "steps": 5})
+        stop_tok = g["tokens"][4]
+        st = post({"prompt": [3, 1, 4], "steps": 5, "stop": stop_tok})
+        first = g["tokens"].index(stop_tok, 3)
+        assert st["tokens"] == g["tokens"][:first + 1]
         # beam search over REST: best-first beams with scores; the
         # top beam is the answer in "tokens"
         bm = post({"prompt": [3, 1, 4], "steps": 3, "beam": 3})
@@ -224,6 +231,8 @@ def test_restful_api_generate_endpoint():
         assert sorted(bm["scores"], reverse=True) == bm["scores"]
         for bad_beam in ({"prompt": [3, 1], "steps": 2, "beam": 2,
                           "temperature": 0.5},
+                         {"prompt": [3, 1], "steps": 2, "beam": 2,
+                          "stop": 1},
                          {"prompt": [3, 1], "steps": 2, "beam": -1},
                          {"prompt": [3, 1], "steps": 2, "beam": 99}):
             try:

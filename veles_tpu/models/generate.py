@@ -106,7 +106,8 @@ def kv_cache_eligible(forwards):
 
 
 def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
-             key=None, kv_cache=False, prompt_lens=None):
+             key=None, kv_cache=False, prompt_lens=None,
+             stop_token=None):
     """Decode ``steps`` tokens after ``prompt`` [batch, prompt_len]
     (int32) through a forward chain ending in per-token logits
     (Embedding → TransformerBlock × N → TokenProjection).
@@ -135,7 +136,11 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
       argument — one executable serves ANY length mix at the same
       (batch, prompt_len, steps).  Key schedule: one split per buffer
       position (all rows advance in lockstep), so sampled streams
-      differ from the uniform-length path's.
+      differ from the uniform-length path's;
+    - ``stop_token`` (optional int) — a row that GENERATES this token
+      freezes: every later position repeats it (the shapes stay
+      static; trim at the first occurrence).  Prompt occurrences do
+      not stop a row — only generated ones count.
 
     Returns [batch, prompt_len + steps] tokens."""
     params = _device_params(forwards)
@@ -177,57 +182,79 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
             return jax.random.categorical(k, z).astype(jnp.int32)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
+    # stop PRESENCE is static (no freeze ops compiled when absent);
+    # the stop VALUE rides the carry as a traced scalar, so every
+    # stop id shares one executable — same design as prompt_lens
+    use_stop = stop_token is not None
+    stop0 = jnp.int32(int(stop_token) if use_stop else -1)
+
+    def freeze(nxt, consumed, consumed_pos, gen_start, stop_val):
+        # a row whose last GENERATED token was the stop token repeats
+        # it forever (consumed_pos >= gen_start ⇔ the consumed token
+        # was generated, so prompt occurrences never freeze a row)
+        if not use_stop:
+            return nxt
+        frozen = (consumed == stop_val) & (consumed_pos >= gen_start)
+        return jnp.where(frozen, stop_val, nxt)
+
     def step(params, carry, _):
-        buf, pos, k = carry
+        buf, pos, k, stop_val = carry
         logits = _chain_logits(forwards, params, buf)
         # logits at the cursor's predecessor predict the cursor token
         row = jax.lax.dynamic_slice(
             logits, (0, pos - 1, 0), (b, 1, logits.shape[-1]))[:, 0]
         k, sub = jax.random.split(k)
         nxt = sample(row, sub)
+        consumed = jax.lax.dynamic_slice(
+            buf, (0, pos - 1), (b, 1))[:, 0]
+        nxt = freeze(nxt, consumed, pos - 1, p_len, stop_val)
         buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, pos))
-        return (buf, pos + 1, k), None
+        return (buf, pos + 1, k, stop_val), None
 
     pre_step = _make_pre_step(forwards, b)
 
     def dec_step(params, carry, _):
-        buf, pos, k, caches = carry
+        buf, pos, k, caches, stop_val = carry
         tok = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
         logits, caches = _chain_step(forwards, params, tok, pos, caches)
         k, sub = jax.random.split(k)
         nxt = sample(logits[:, 0], sub)
+        nxt = freeze(nxt, tok[:, 0], pos, p_len, stop_val)
         buf = jax.lax.dynamic_update_slice(buf, nxt[:, None],
                                            (0, pos + 1))
-        return (buf, pos + 1, k, caches), None
+        return (buf, pos + 1, k, caches, stop_val), None
 
     def var_step(params, carry, _):
         # variable-length lockstep (kv): consume position pos, write
         # pos+1 only for rows whose prompt has ended — prompt tokens
         # pass through untouched, padding is overwritten in place
-        buf, pos, k, caches, row_lens = carry
+        buf, pos, k, caches, row_lens, stop_val = carry
         tok = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))
         logits, caches = _chain_step(forwards, params, tok, pos, caches)
         k, sub = jax.random.split(k)
         nxt = sample(logits[:, 0], sub)
+        nxt = freeze(nxt, tok[:, 0], pos, row_lens, stop_val)
         cur = jax.lax.dynamic_slice(buf, (0, pos + 1), (b, 1))[:, 0]
         write = jnp.where(pos + 1 >= row_lens, nxt, cur)
         buf = jax.lax.dynamic_update_slice(buf, write[:, None],
                                            (0, pos + 1))
-        return (buf, pos + 1, k, caches, row_lens), None
+        return (buf, pos + 1, k, caches, row_lens, stop_val), None
 
     def var_step_full(params, carry, _):
         # variable-length lockstep, full-buffer rescan variant
-        buf, pos, k, row_lens = carry
+        buf, pos, k, row_lens, stop_val = carry
         logits = _chain_logits(forwards, params, buf)
         row = jax.lax.dynamic_slice(
             logits, (0, pos, 0), (b, 1, logits.shape[-1]))[:, 0]
         k, sub = jax.random.split(k)
         nxt = sample(row, sub)
+        consumed = jax.lax.dynamic_slice(buf, (0, pos), (b, 1))[:, 0]
+        nxt = freeze(nxt, consumed, pos, row_lens, stop_val)
         cur = jax.lax.dynamic_slice(buf, (0, pos + 1), (b, 1))[:, 0]
         write = jnp.where(pos + 1 >= row_lens, nxt, cur)
         buf = jax.lax.dynamic_update_slice(buf, write[:, None],
                                            (0, pos + 1))
-        return (buf, pos + 1, k, row_lens), None
+        return (buf, pos + 1, k, row_lens, stop_val), None
 
     # params travel as jit ARGUMENTS (constants baked into the trace
     # would bloat the executable) and the compiled decode is cached on
@@ -242,7 +269,7 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
     # program on shape-identical calls
     cache_key = (sig, b, int(steps), p_len,
                  float(temperature or 0.0), int(top_k or 0),
-                 bool(kv_cache), lens is not None,
+                 bool(kv_cache), lens is not None, use_stop,
                  str(dtypes.compute_dtype()),
                  str(dtypes.matmul_precision()))
     if kv_cache:
@@ -270,10 +297,11 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
         if lens is not None:
             decode = _decode_cached_kv_varlen(
                 cache_key, _StepClosure(var_step))
-            return decode(params, buf0, key, caches0, lens)
+            return decode(params, buf0, key, caches0, lens,
+                          stop0)
         decode = _decode_cached_kv(
             cache_key, _StepClosure((pre_step, dec_step)))
-        return decode(params, buf0, key, caches0)
+        return decode(params, buf0, key, caches0, stop0)
     if lens is not None:
         # positions before every row's prompt end need no forward at
         # all on the rescan path — start at the host-known min length
@@ -281,9 +309,9 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
         vmin = int(lens_np.min())
         decode = _decode_cached_varlen(
             cache_key + (vmin,), _StepClosure(var_step_full))
-        return decode(params, buf0, key, lens)
+        return decode(params, buf0, key, lens, stop0)
     decode = _decode_cached(cache_key, _StepClosure(step))
-    return decode(params, buf0, key)
+    return decode(params, buf0, key, stop0)
 
 
 def generate_beam(forwards, prompt, steps, beam):
@@ -399,10 +427,10 @@ def _decode_cached(cache_key, step_closure):
     steps, p_len = cache_key[2], cache_key[3]
 
     @jax.jit
-    def decode(params, buf, key):
-        (buf, _, _), _ = jax.lax.scan(
+    def decode(params, buf, key, stop):
+        (buf, _, _, _), _ = jax.lax.scan(
             functools.partial(step_closure.fn, params),
-            (buf, jnp.int32(p_len), key), None, length=steps)
+            (buf, jnp.int32(p_len), key, stop), None, length=steps)
         return buf
 
     return decode
@@ -414,14 +442,14 @@ def _decode_cached_kv(cache_key, step_closure):
     pre_step, dec_step = step_closure.fn
 
     @jax.jit
-    def decode(params, buf, key, caches):
+    def decode(params, buf, key, caches, stop):
         if p_len > 1:  # prefill caches over the prompt's predecessors
             (buf, _, caches), _ = jax.lax.scan(
                 functools.partial(pre_step, params),
                 (buf, jnp.int32(0), caches), None, length=p_len - 1)
-        (buf, _, _, caches), _ = jax.lax.scan(
+        (buf, _, _, caches, _), _ = jax.lax.scan(
             functools.partial(dec_step, params),
-            (buf, jnp.int32(p_len - 1), key, caches), None,
+            (buf, jnp.int32(p_len - 1), key, caches, stop), None,
             length=steps)
         return buf
 
@@ -434,10 +462,10 @@ def _decode_cached_varlen(cache_key, step_closure):
     vmin = cache_key[-1]                 # min prompt length
 
     @jax.jit
-    def decode(params, buf, key, lens):
-        (buf, _, _, _), _ = jax.lax.scan(
+    def decode(params, buf, key, lens, stop):
+        (buf, _, _, _, _), _ = jax.lax.scan(
             functools.partial(step_closure.fn, params),
-            (buf, jnp.int32(vmin - 1), key, lens), None,
+            (buf, jnp.int32(vmin - 1), key, lens, stop), None,
             length=total - vmin)
         return buf
 
@@ -474,10 +502,10 @@ def _decode_cached_kv_varlen(cache_key, step_closure):
     total = cache_key[2] + cache_key[3]  # steps + p_len
 
     @jax.jit
-    def decode(params, buf, key, caches, lens):
-        (buf, _, _, _, _), _ = jax.lax.scan(
+    def decode(params, buf, key, caches, lens, stop):
+        (buf, _, _, _, _, _), _ = jax.lax.scan(
             functools.partial(step_closure.fn, params),
-            (buf, jnp.int32(0), key, caches, lens), None,
+            (buf, jnp.int32(0), key, caches, lens, stop), None,
             length=total - 1)
         return buf
 

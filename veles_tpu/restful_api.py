@@ -108,7 +108,7 @@ class RESTfulAPI(Unit):
             return generate_beam(self.forwards, prompt, steps, beam)
 
     def _decode(self, prompt, steps, temperature, top_k, seed,
-                prompt_lens=None):
+                prompt_lens=None, stop_token=None):
         """Run the decode for /generate — kv-cached when the chain is
         eligible, full-buffer rescan otherwise.  Serialized: decode
         requests share the chain's param Arrays and the compile
@@ -132,7 +132,8 @@ class RESTfulAPI(Unit):
                             temperature=temperature, top_k=top_k,
                             key=key,
                             kv_cache=kv_cache_eligible(self.forwards),
-                            prompt_lens=prompt_lens)
+                            prompt_lens=prompt_lens,
+                            stop_token=stop_token)
 
     def init_unpickled(self):
         super(RESTfulAPI, self).init_unpickled()
@@ -237,6 +238,11 @@ class RESTfulAPI(Unit):
                                     400, "beam search is deterministic"
                                     " - drop temperature/top_k")
                                 return
+                            if body.get("stop") is not None:
+                                self.send_error(
+                                    400, "beam search decodes fixed "
+                                    "length - drop stop")
+                                return
                             if ragged:
                                 self.send_error(
                                     400, "beam search needs equal-"
@@ -260,21 +266,31 @@ class RESTfulAPI(Unit):
                                          "scores": scores[0]}
                             self._reply_json(reply)
                             return
+                        stop = body.get("stop")
                         tokens = api._decode(
                             prompt, steps,
                             float(body.get("temperature", 0.0)),
                             int(body.get("top_k", 0)),
                             body.get("seed"),
-                            prompt_lens=lens if ragged else None)
+                            prompt_lens=lens if ragged else None,
+                            stop_token=stop)
                         tokens = numpy.asarray(tokens)
                         # each row answers with ITS prompt + steps
                         # tokens (shorter rows decode past their quota
-                        # in lockstep; the surplus is sliced off)
-                        tokens = [tokens[i, :lens[i] + steps].tolist()
-                                  for i in range(len(rows))]
+                        # in lockstep; the surplus is sliced off), cut
+                        # at the first GENERATED stop token if one was
+                        # requested (the stop itself stays in)
+                        out = []
+                        for i in range(len(rows)):
+                            row = tokens[i, :lens[i] + steps]
+                            if stop is not None:
+                                hits = numpy.nonzero(
+                                    row[lens[i]:] == int(stop))[0]
+                                if hits.size:
+                                    row = row[:lens[i] + hits[0] + 1]
+                            out.append(row.tolist())
                         self._reply_json(
-                            {"tokens": tokens[0] if squeeze
-                             else tokens})
+                            {"tokens": out[0] if squeeze else out})
                     except Exception as e:
                         self.send_error(500, _status_text(e))
                     return
