@@ -4,6 +4,7 @@
 //
 //   veles_runner <package.tar.gz> <input.npy> <output.npy> [--repeat N]
 //                [--generate N] [--temperature T] [--top-k K] [--seed S]
+//                [--stop ID]
 //
 // Loads the package, runs the forward pass on the input batch, writes
 // the result as npy, and prints one JSON status line with timing.
@@ -24,7 +25,10 @@
 // --seed S pins the sampler (default 0; deterministic — mt19937_64
 // engine bits mapped to [0,1) directly, so streams reproduce across
 // builds; NOT the Python side's threefry, so they do not match across
-// runtimes).  top-k 1 reduces to greedy.
+// runtimes).  top-k 1 reduces to greedy.  --stop ID freezes a row
+// once it GENERATES that token: later positions repeat it (same
+// semantics as generate(stop_token=); trim at the first occurrence;
+// prompt occurrences do not stop a row).
 #include <algorithm>
 #include <chrono>
 #include <cmath>
@@ -44,11 +48,11 @@ int main(int argc, char** argv) {
     std::fprintf(stderr,
                  "usage: %s <package.tar.gz> <input.npy> <output.npy> "
                  "[--repeat N] [--generate N] [--temperature T] "
-                 "[--top-k K] [--seed S]\n",
+                 "[--top-k K] [--seed S] [--stop ID]\n",
                  argv[0]);
     return 2;
   }
-  int repeat = 1, generate = 0, top_k = 0;
+  int repeat = 1, generate = 0, top_k = 0, stop_id = -1;
   double temperature = 0.0;
   unsigned long long seed = 0;
   for (int i = 4; i + 1 < argc; ++i) {
@@ -62,6 +66,8 @@ int main(int argc, char** argv) {
       top_k = std::max(0, std::atoi(argv[i + 1]));
     if (std::strcmp(argv[i], "--seed") == 0)
       seed = std::strtoull(argv[i + 1], nullptr, 10);
+    if (std::strcmp(argv[i], "--stop") == 0)
+      stop_id = std::atoi(argv[i + 1]);
   }
   try {
     auto wf = veles_rt::PackagedWorkflow::Load(argv[1]);
@@ -130,6 +136,7 @@ int main(int argc, char** argv) {
         }
         return best;  // numeric tail: fall back to the mode
       };
+      std::vector<char> done(batch, 0);
       auto t0 = std::chrono::steady_clock::now();
       for (size_t t = prompt; t < total; ++t) {
         veles_rt::Tensor logits = wf.Run(buf, &pool);
@@ -140,8 +147,28 @@ int main(int argc, char** argv) {
         size_t vocab = logits.dim(2);
         for (size_t n = 0; n < batch; ++n) {
           const float* row = logits.ptr() + (n * window + t - 1) * vocab;
-          buf.ptr()[n * window + t] =
-              static_cast<float>(next_token(row, vocab));
+          // always draw, then override frozen rows — the sampler's
+          // stream stays identical to an unstopped run, so other
+          // rows' tokens are unaffected by one row finishing
+          size_t tok = next_token(row, vocab);
+          if (done[n]) tok = static_cast<size_t>(stop_id);
+          else if (stop_id >= 0 && tok == static_cast<size_t>(stop_id))
+            done[n] = 1;  // a GENERATED stop freezes the row
+          buf.ptr()[n * window + t] = static_cast<float>(tok);
+        }
+        if (stop_id >= 0) {
+          // every row frozen: the remaining tokens are all determined
+          // — fill and skip the dead forward passes
+          bool all_done = true;
+          for (size_t n = 0; n < batch; ++n)
+            all_done = all_done && done[n];
+          if (all_done) {
+            for (size_t tt = t + 1; tt < total; ++tt)
+              for (size_t n = 0; n < batch; ++n)
+                buf.ptr()[n * window + tt] =
+                    static_cast<float>(stop_id);
+            break;
+          }
         }
       }
       double dt = std::chrono::duration<double>(

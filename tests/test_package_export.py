@@ -517,6 +517,19 @@ def test_cpp_runner_generate_sampling(runner_binary, tmp_path):
              "--top-k", "5"],
             capture_output=True, text=True)
         assert r.returncode == 1 and "--temperature" in r.stderr
+        # --stop freezes a row at its first GENERATED stop token
+        # (same semantics as generate(stop_token=))
+        stop_tok = int(greedy[0, 5])
+        st = decode("--stop", str(stop_tok))
+        for n in range(2):
+            hits = numpy.nonzero(greedy[n, 4:] == stop_tok)[0]
+            if hits.size:
+                f = 4 + int(hits[0])
+                numpy.testing.assert_array_equal(st[n, :f + 1],
+                                                 greedy[n, :f + 1])
+                assert (st[n, f:] == stop_tok).all()
+            else:
+                numpy.testing.assert_array_equal(st[n], greedy[n])
     finally:
         root.common.precision.compute_dtype = saved
 
