@@ -137,8 +137,11 @@ int main(int argc, char** argv) {
         return best;  // numeric tail: fall back to the mode
       };
       std::vector<char> done(batch, 0);
+      size_t decoded = 0;  // forward passes actually run (the --stop
+                           // early-exit fill is not decode work)
       auto t0 = std::chrono::steady_clock::now();
       for (size_t t = prompt; t < total; ++t) {
+        ++decoded;
         veles_rt::Tensor logits = wf.Run(buf, &pool);
         if (logits.shape.size() != 3 || logits.dim(1) != window)
           throw std::runtime_error(
@@ -181,11 +184,12 @@ int main(int argc, char** argv) {
       veles_rt::npy::SaveFile(argv[3], out);
       std::printf(
           "{\"workflow\": \"%s\", \"units\": %zu, \"batch\": %zu, "
-          "\"generated\": %d, \"temperature\": %.3f, \"top_k\": %d, "
+          "\"generated\": %d, \"decoded_steps\": %zu, "
+          "\"temperature\": %.3f, \"top_k\": %d, "
           "\"sec_total\": %.6f, \"tokens_per_sec\": %.1f}\n",
           wf.name().c_str(), wf.unit_count(), batch, generate,
-          temperature, top_k, dt,
-          batch * generate / (dt > 0 ? dt : 1e-9));
+          decoded, temperature, top_k, dt,
+          batch * decoded / (dt > 0 ? dt : 1e-9));
       return 0;
     }
     veles_rt::Tensor out = wf.Run(input, &pool);  // warm (touch pages)

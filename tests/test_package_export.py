@@ -518,18 +518,23 @@ def test_cpp_runner_generate_sampling(runner_binary, tmp_path):
             capture_output=True, text=True)
         assert r.returncode == 1 and "--temperature" in r.stderr
         # --stop freezes a row at its first GENERATED stop token
-        # (same semantics as generate(stop_token=))
-        stop_tok = int(greedy[0, 5])
-        st = decode("--stop", str(stop_tok))
-        for n in range(2):
-            hits = numpy.nonzero(greedy[n, 4:] == stop_tok)[0]
-            if hits.size:
-                f = 4 + int(hits[0])
-                numpy.testing.assert_array_equal(st[n, :f + 1],
-                                                 greedy[n, :f + 1])
-                assert (st[n, f:] == stop_tok).all()
-            else:
-                numpy.testing.assert_array_equal(st[n], greedy[n])
+        # (same semantics as generate(stop_token=)); draw-then-
+        # override means the stopped run equals the unstopped run
+        # with post-stop positions replaced — for SAMPLING too (a
+        # refactor that skips frozen rows' draws would shift the rng
+        # stream and break the elementwise match below)
+        for extra in ((), ("--temperature", "0.9", "--top-k", "5",
+                           "--seed", "11")):
+            ref = decode(*extra)
+            stop_tok = int(ref[0, 5])
+            st = decode("--stop", str(stop_tok), *extra)
+            for n in range(2):
+                hits = numpy.nonzero(ref[n, 4:] == stop_tok)[0]
+                expect = ref[n].copy()
+                if hits.size:
+                    expect[4 + int(hits[0]):] = stop_tok
+                numpy.testing.assert_array_equal(
+                    st[n], expect, err_msg=str((n, extra)))
     finally:
         root.common.precision.compute_dtype = saved
 

@@ -40,7 +40,16 @@ from veles_tpu.models.recurrent import (  # noqa: F401
 from veles_tpu.models.rbm import BernoulliRBM  # noqa: F401
 from veles_tpu.models.kohonen import (  # noqa: F401
     KohonenDecision, KohonenForward, KohonenTrainer)
+from veles_tpu.models.embedding import Embedding  # noqa: F401
+from veles_tpu.models.moe import MoE  # noqa: F401
+from veles_tpu.models.transformer import (  # noqa: F401
+    MeanPoolSeq, TokenProjection, TransformerBlock)
 from veles_tpu.models.evaluator import (  # noqa: F401
-    EvaluatorMSE, EvaluatorSoftmax)
+    EvaluatorMSE, EvaluatorNextToken, EvaluatorSoftmax)
+# NOTE: the decode FUNCTION ``generate`` is deliberately not re-bound
+# here — it would shadow the ``veles_tpu.models.generate`` MODULE
+# attribute; reach it as ``veles_tpu.models.generate.generate``
+from veles_tpu.models.generate import (  # noqa: F401
+    clear_decode_caches, generate_beam, kv_cache_eligible)
 from veles_tpu.models.gd import GradientDescent  # noqa: F401
 from veles_tpu.models.decision import DecisionGD, Rollback  # noqa: F401
