@@ -153,16 +153,16 @@ def test_interactive_loader_feeds():
     assert loader.closed
 
 
-def test_restful_api_generate_endpoint():
-    """POST /generate on an LM chain decodes autoregressively (greedy
-    deterministic; single-prompt squeeze; no graph loop required —
-    the decode is its own jitted program)."""
+def _lm_api(name, timeout=30):
+    """A served tiny-LM /generate endpoint + poster — shared by the
+    endpoint-semantics test and the concurrency soak.  Returns
+    (api, loader, post); callers stop both in a finally."""
     from veles_tpu.accelerated_units import AcceleratedWorkflow
     from veles_tpu.models.standard import make_forwards
     from veles_tpu.restful_api import RESTfulAPI, RestfulLoader
 
     dev = Device(backend="numpy")
-    wf = AcceleratedWorkflow(None, name="lmserve")
+    wf = AcceleratedWorkflow(None, name=name)
     fw = make_forwards(wf, Array(numpy.zeros((1, 12), numpy.int32)), [
         {"type": "embedding", "vocab": 11, "dim": 8},
         {"type": "transformer_block", "heads": 2, "causal": True},
@@ -172,16 +172,27 @@ def test_restful_api_generate_endpoint():
     loader = RestfulLoader(wf, sample_shape=(12,), minibatch_size=1,
                            max_wait=10.0)
     loader.initialize(device=dev)
-    api = RESTfulAPI(wf, loader=loader, forwards=fw, name="lmapi")
+    api = RESTfulAPI(wf, loader=loader, forwards=fw,
+                     name=name + "-api")
     api.output = fw[-1].output
     api.initialize()
+
+    def post(payload):
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/generate" % api.port,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        return json.load(urllib.request.urlopen(req, timeout=timeout))
+
+    return api, loader, post
+
+
+def test_restful_api_generate_endpoint():
+    """POST /generate on an LM chain decodes autoregressively (greedy
+    deterministic; single-prompt squeeze; no graph loop required —
+    the decode is its own jitted program)."""
+    api, loader, post = _lm_api("lmserve")
     try:
-        def post(payload):
-            req = urllib.request.Request(
-                "http://127.0.0.1:%d/generate" % api.port,
-                data=json.dumps(payload).encode(),
-                headers={"Content-Type": "application/json"})
-            return json.load(urllib.request.urlopen(req, timeout=30))
 
         a = post({"prompt": [3, 1, 4], "steps": 5})
         b = post({"prompt": [3, 1, 4], "steps": 5})
@@ -443,6 +454,57 @@ def test_mnist_forward_example(tmp_path, capsys):
     assert fwd_main([path, "4"]) == 0
     out = capsys.readouterr().out
     assert out.count("sample ") == 4 and "digit" in out
+
+
+def test_generate_endpoint_concurrent_soak():
+    """Concurrency soak on the decode endpoint: many threads mixing
+    greedy/sampled/ragged/beam/stop requests against ONE RESTfulAPI —
+    every request must answer correctly (greedy requests keep exact
+    determinism while sampled/beam traffic interleaves; the decode
+    lock serializes Array.devmem and the compile caches)."""
+    api, loader, post = _lm_api("soak", timeout=120)
+    try:
+        baseline = post({"prompt": [3, 1, 4], "steps": 5})["tokens"]
+        requests = [
+            {"prompt": [3, 1, 4], "steps": 5},                 # greedy
+            {"prompt": [[2, 5], [7, 7, 1]], "steps": 4},       # ragged
+            {"prompt": [1, 2], "steps": 4, "temperature": 0.9,
+             "top_k": 5, "seed": 7},                           # sampled
+            {"prompt": [3, 1, 4], "steps": 4, "beam": 3},      # beam
+            {"prompt": [3, 1, 4], "steps": 5,
+             "stop": int(baseline[4])},                        # stop
+        ]
+        errors = []
+
+        def worker(i):
+            try:
+                for r in range(6):
+                    payload = requests[(i + r) % len(requests)]
+                    reply = post(payload)
+                    if payload.get("beam"):
+                        assert len(reply["beams"]) == 3
+                    elif "stop" in payload:
+                        first = baseline.index(payload["stop"], 3)
+                        assert reply["tokens"] == \
+                            baseline[:first + 1], reply
+                    elif payload == requests[0]:
+                        assert reply["tokens"] == baseline, reply
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append((i, repr(e)))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            # past the worst case (6 requests × the urlopen timeout) —
+            # and a still-alive worker IS the deadlock this test hunts
+            t.join(6 * 120 + 30)
+            assert not t.is_alive(), "worker blocked: server deadlock"
+        assert not errors, errors[:3]
+    finally:
+        api.stop()
+        loader.close()
 
 
 def test_serve_workflow_end_to_end(tmp_path):
