@@ -1,0 +1,117 @@
+"""Text LM pipeline: byte-level BPE vocab + window loader + the
+samples/lm.py text_path route (loader/text.py — no reference
+analogue, SURVEY.md §5)."""
+
+import numpy
+import pytest
+
+from veles_tpu.config import root
+from veles_tpu.loader.text import BytePairVocab, FullBatchTextLM
+
+CORPUS = ("the cat sat on the mat. the cat ate the rat. "
+          "a cat and a rat sat. the mat sat flat. ") * 20
+
+
+def test_bpe_roundtrip_exact():
+    v = BytePairVocab.train(CORPUS, vocab_size=300)
+    ids = v.encode(CORPUS)
+    assert v.decode(ids) == CORPUS
+    # merges compress: fewer tokens than raw bytes
+    assert len(ids) < len(CORPUS.encode("utf-8"))
+    # byte-level: ARBITRARY unseen text still encodes losslessly
+    weird = "zebra-Ω∑ unseen\ttabs\nnewlines 12345"
+    assert v.decode(v.encode(weird)) == weird
+
+
+def test_bpe_specials_and_io(tmp_path):
+    v = BytePairVocab.train(CORPUS, vocab_size=280,
+                            specials=("<eos>", "<pad>"))
+    eos = v.special("<eos>")
+    assert eos == 256 and v.special("<pad>") == 257
+    assert eos not in v.encode(CORPUS)     # never emitted
+    assert v.decode([eos]) == ""           # decodes to nothing
+    p = str(tmp_path / "v.json")
+    v.save(p)
+    w = BytePairVocab.load(p)
+    assert w.size == v.size
+    assert w.encode(CORPUS) == v.encode(CORPUS)
+    assert w.special("<eos>") == eos
+
+
+def test_bpe_train_bounds():
+    with pytest.raises(ValueError, match="vocab_size"):
+        BytePairVocab.train(CORPUS, vocab_size=100)
+    # a tiny budget stops at the budget, an ample one at min_freq
+    small = BytePairVocab.train(CORPUS, vocab_size=260)
+    assert small.size == 260
+    big = BytePairVocab.train("ab " * 4, vocab_size=10_000)
+    assert big.size < 10_000
+
+
+def test_text_loader_windows_and_split():
+    from veles_tpu.backends import Device
+    # NON-repeating corpus: every word is unique, so train/valid
+    # window content can only coincide through actual leakage
+    corpus = " ".join("w%03d" % i for i in range(400)) + " "
+    loader = FullBatchTextLM(None, text=corpus, vocab_size=300,
+                             seq_len=16, stride=8, minibatch_size=8,
+                             normalization_type="none")
+    loader.initialize(device=Device(backend="numpy"))
+    data = numpy.asarray(loader.original_data)
+    assert data.dtype == numpy.int32 and data.shape[1] == 16
+    n_valid, n_train = loader.class_lengths[1], loader.class_lengths[2]
+    assert n_valid >= 1 and n_train > n_valid
+    assert n_valid + n_train == data.shape[0]
+    # every window decodes back into the corpus (stride windows are
+    # substrings of the token stream)
+    for row in data[:2].tolist() + data[-2:].tolist():
+        assert loader.vocab.decode(row) in corpus
+    # NO LEAKAGE even at stride < seq_len: the token STREAM was split
+    # before windowing, so the words of every validation window are
+    # disjoint from the words of every training window
+    valid_words = set()
+    for row in data[:n_valid]:
+        valid_words.update(loader.vocab.decode(row).split())
+    train_words = set()
+    for row in data[n_valid:]:
+        train_words.update(loader.vocab.decode(row).split())
+    # boundary tokens may split a word across the cut — drop partials
+    whole = {w for w in valid_words | train_words
+             if len(w) == 4 and w.startswith("w")}
+    assert not (valid_words & train_words & whole), \
+        sorted(valid_words & train_words & whole)[:5]
+
+
+def test_lm_sample_trains_on_text(tmp_path):
+    """The CLI route: root.lm_tpu.text_path trains the LM on a real
+    file end-to-end, and the trained chain decodes back to text."""
+    from veles_tpu.backends import Device
+    from veles_tpu.models.generate import generate
+    from veles_tpu.samples.lm import LMWorkflow
+
+    corpus_file = tmp_path / "corpus.txt"
+    corpus_file.write_text(CORPUS)
+    root.lm_tpu.update({
+        "text_path": str(corpus_file), "vocab_size": 280,
+        "seq": 16, "stride": 8, "dim": 32, "blocks": 1, "heads": 2,
+        "minibatch_size": 16, "max_epochs": 3,
+        "snapshot_time_interval": 1e9, "fail_iterations": 50,
+    })
+    try:
+        wf = LMWorkflow(None, plotters=False)
+        wf.snapshotter.interval = 10**9
+        wf.snapshotter.time_interval = 10**9
+        wf.initialize(device=Device(backend="numpy"))
+        wf.run()
+        wf.gd.loss.map_read()
+        assert numpy.isfinite(wf.gd.loss.mem)
+        vocab = wf.loader.vocab
+        prompt = numpy.asarray([vocab.encode("the cat ")],
+                               numpy.int32)[:, :8]
+        out = numpy.asarray(generate(wf.forwards, prompt, 8))
+        text = vocab.decode(out[0])
+        assert isinstance(text, str) and len(text) > 0
+    finally:
+        # the global config must not leak the text route into the
+        # Markov-corpus LM tests that share root.lm_tpu
+        root.lm_tpu.text_path = None

@@ -103,26 +103,62 @@ class MarkovLoader(FullBatchLoader, IResultProvider):
 
 
 class LMWorkflow(StandardWorkflow):
-    """Next-token LM on the planted-Markov corpus."""
+    """Next-token LM on the planted-Markov corpus — or on a REAL text
+    file via ``root.lm_tpu.text_path`` (byte-level BPE trained on the
+    corpus itself; ``vocab_size``/``seq``/``stride`` configure the
+    window loader — loader/text.py)."""
 
     def __init__(self, workflow, **kwargs):
         cfg = root.lm_tpu
         dim = int(cfg.get("dim", 128))
         blocks = int(cfg.get("blocks", 2))
-        spec = [{"type": "embedding", "vocab": int(cfg.get("vocab", 64)),
-                 "dim": dim}]
+        text_path = cfg.get("text_path")
+        if text_path:
+            import os
+
+            from veles_tpu.loader.text import (BytePairVocab,
+                                               FullBatchTextLM)
+            # resolve the vocabulary HERE so the embedding/logits
+            # width is the vocab's TRUE size — a stale vocab_path file
+            # or an early min_freq stop must never leave the model a
+            # different width than the ids the loader emits
+            vp = cfg.get("vocab_path")
+            if vp and os.path.exists(vp):
+                bpe = BytePairVocab.load(vp)
+            else:
+                with open(text_path, encoding="utf-8") as f:
+                    corpus = f.read()
+                bpe = BytePairVocab.train(
+                    corpus, int(cfg.get("vocab_size", 512)),
+                    specials=("<eos>",))
+                if vp:
+                    bpe.save(vp)
+            vocab = bpe.size
+            loader_factory = FullBatchTextLM
+            loader_config = {
+                "path": text_path,
+                "vocab": bpe,
+                "seq_len": int(cfg.get("seq", 128)),
+                "stride": cfg.get("stride"),
+                "valid_fraction": float(cfg.get("valid_fraction", 0.1)),
+            }
+        else:
+            vocab = int(cfg.get("vocab", 64))
+            loader_factory = MarkovLoader
+            loader_config = {}
+        spec = [{"type": "embedding", "vocab": vocab, "dim": dim}]
         spec += [{"type": "transformer_block",
                   "heads": int(cfg.get("heads", 4)), "causal": True}
                  for _ in range(blocks)]
-        spec += [{"type": "token_logits",
-                  "vocab": int(cfg.get("vocab", 64))}]
+        spec += [{"type": "token_logits", "vocab": vocab}]
+        loader_config.update({
+            "minibatch_size": int(cfg.get("minibatch_size", 128)),
+            "normalization_type": "none",
+        })
         super(LMWorkflow, self).__init__(
             workflow, name="LM",
-            loader_factory=MarkovLoader,
-            loader_config={
-                "minibatch_size": int(cfg.get("minibatch_size", 128)),
-                "normalization_type": "none",
-            },
+            loader_factory=loader_factory,
+            loader_config=loader_config,
             layers=spec,
             loss="next_token",
             solver=cfg.get("solver", "adam"),
