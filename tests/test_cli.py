@@ -62,6 +62,39 @@ class TestCLI:
         data = json.loads(results.read_text())
         assert data["Total epochs"] >= 1
 
+    def test_resume_extends_with_decision_override(self, tmp_path,
+                                                   small_cfg):
+        """A resumed run stops immediately at the PICKLED max_epochs;
+        --decision max_epochs=N is the documented way to extend it."""
+        m = Main([MNIST, MNIST_CFG] + small_cfg)
+        assert m.run() == 0
+        snap = os.path.join(root.common.dirs.get("snapshots"),
+                            "mnist_current.pickle.gz")
+        results = tmp_path / "extended.json"
+        m2 = Main([MNIST, MNIST_CFG, "-s", snap,
+                   "--decision", "max_epochs=4",
+                   "--result-file", str(results)] + small_cfg)
+        assert m2.run() == 0
+        assert m2.workflow.decision.max_epochs == 4
+        data = json.loads(results.read_text())
+        assert data["Total epochs"] == 4      # trained PAST the
+        # pickled budget of 2
+        with pytest.raises(ValueError, match="no attribute"):
+            Main([MNIST, MNIST_CFG, "-s", snap,
+                  "--decision", "nonsense=1"] + small_cfg).run()
+        # a typo'd value fails at the CLI, not an epoch into training
+        with pytest.raises(ValueError, match="could not parse"):
+            Main([MNIST, MNIST_CFG, "-s", snap,
+                  "--decision", "max_epochs=4O"] + small_cfg).run()
+        # gate Bools are .set(), never replaced (the graph's gate
+        # expressions reference the shared object)
+        m3 = Main([MNIST, MNIST_CFG, "-s", snap,
+                   "--decision", "max_epochs=5",
+                   "--decision", "complete=False"] + small_cfg)
+        assert m3.run() == 0
+        from veles_tpu.mutable import Bool
+        assert isinstance(m3.workflow.decision.complete, Bool)
+
     def test_visualize(self, capsys, small_cfg):
         m = Main([MNIST, MNIST_CFG, "--visualize"] + small_cfg)
         assert m.run() == 0

@@ -79,7 +79,53 @@ class Main:
             self.workflow = workflow_class(self.launcher, **kwargs)
         return self.workflow, self.restored
 
+    def _apply_decision_overrides(self):
+        """--decision KEY=VALUE: poke the decision unit directly —
+        the ONLY way to extend a resumed run, whose decision carries
+        its pickled max_epochs/patience state, not the config's."""
+        if not self.args.decision:
+            return
+        dec = getattr(self.workflow, "decision", None)
+        if dec is None:
+            raise ValueError(
+                "--decision: workflow %s has no decision unit"
+                % type(self.workflow).__name__)
+        import ast
+
+        from veles_tpu.mutable import Bool
+        for kv in self.args.decision:
+            key, sep, val = kv.partition("=")
+            if not sep or not hasattr(dec, key):
+                raise ValueError(
+                    "--decision %r: %s has no attribute %r"
+                    % (kv, type(dec).__name__, key))
+            try:
+                parsed = ast.literal_eval(val)
+            except (ValueError, SyntaxError):
+                parsed = val
+            current = getattr(dec, key)
+            if isinstance(parsed, str) and not isinstance(current, str):
+                # a typo like max_epochs=4O must fail HERE, not as a
+                # TypeError an epoch into the resumed training
+                raise ValueError(
+                    "--decision %r: could not parse %r (current "
+                    "value is %r)" % (kv, val, current))
+            if isinstance(current, Bool):
+                # shared gate Bools are referenced by the graph's
+                # gate expressions — REPLACING one would detach them
+                current.set(bool(parsed))
+            else:
+                try:
+                    setattr(dec, key, parsed)
+                except AttributeError:
+                    raise ValueError(
+                        "--decision %r: %s.%s is read-only"
+                        % (kv, type(dec).__name__, key))
+            logging.getLogger("Main").info(
+                "decision.%s = %r", key, parsed)
+
     def _main(self, **kwargs):
+        self._apply_decision_overrides()
         self.launcher.initialize(**kwargs)
         if self.args.debug_pickle:
             from veles_tpu.pickle_debug import (
@@ -108,6 +154,8 @@ class Main:
             argv += ["-a", self.args.backend]
         if self.args.device:
             argv += ["-d", str(self.args.device)]
+        for kv in self.args.decision:
+            argv += ["--decision", kv]
         for _ in range(self.args.verbose):
             argv += ["-v"]
         return argv

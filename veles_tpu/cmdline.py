@@ -34,6 +34,13 @@ def build_parser():
                    help="device index within the backend")
     p.add_argument("-s", "--snapshot", default=None,
                    help="resume from snapshot file")
+    p.add_argument("--decision", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="override a decision-unit attribute after "
+                        "(re)construction — e.g. max_epochs=30 or "
+                        "fail_iterations=100 to extend a RESUMED "
+                        "run, whose pickled stopping state would "
+                        "otherwise end it immediately (repeatable)")
     p.add_argument("-c", "--config-override", action="append", default=[],
                    metavar="SNIPPET",
                    help='python snippet, e.g. "root.x.y = 1" '
