@@ -137,41 +137,72 @@ int main(int argc, char** argv) {
         return best;  // numeric tail: fall back to the mode
       };
       std::vector<char> done(batch, 0);
-      size_t decoded = 0;  // forward passes actually run (the --stop
+      size_t decoded = 0;  // sampling steps actually run (the --stop
                            // early-exit fill is not decode work)
-      auto t0 = std::chrono::steady_clock::now();
-      for (size_t t = prompt; t < total; ++t) {
-        ++decoded;
-        veles_rt::Tensor logits = wf.Run(buf, &pool);
-        if (logits.shape.size() != 3 || logits.dim(1) != window)
-          throw std::runtime_error(
-              "--generate needs a per-token-logits package "
-              "(embedding + causal blocks + TokenProjection)");
-        size_t vocab = logits.dim(2);
-        for (size_t n = 0; n < batch; ++n) {
-          const float* row = logits.ptr() + (n * window + t - 1) * vocab;
-          // always draw, then override frozen rows — the sampler's
-          // stream stays identical to an unstopped run, so other
-          // rows' tokens are unaffected by one row finishing
-          size_t tok = next_token(row, vocab);
-          if (done[n]) tok = static_cast<size_t>(stop_id);
-          else if (stop_id >= 0 && tok == static_cast<size_t>(stop_id))
-            done[n] = 1;  // a GENERATED stop freezes the row
-          buf.ptr()[n * window + t] = static_cast<float>(tok);
-        }
-        if (stop_id >= 0) {
-          // every row frozen: the remaining tokens are all determined
-          // — fill and skip the dead forward passes
-          bool all_done = true;
+      // a row's sampled token, with the --stop freeze applied: always
+      // draw, then override frozen rows — the sampler's stream stays
+      // identical to an unstopped run, so other rows' tokens are
+      // unaffected by one row finishing
+      auto place_token = [&](const float* row, size_t vocab, size_t n,
+                             size_t t) {
+        size_t tok = next_token(row, vocab);
+        if (done[n]) tok = static_cast<size_t>(stop_id);
+        else if (stop_id >= 0 && tok == static_cast<size_t>(stop_id))
+          done[n] = 1;  // a GENERATED stop freezes the row
+        buf.ptr()[n * window + t] = static_cast<float>(tok);
+      };
+      // every row frozen: the remaining tokens are all determined —
+      // fill and skip the dead forward passes
+      auto all_frozen_fill = [&](size_t from) {
+        if (stop_id < 0) return false;
+        bool all_done = true;
+        for (size_t n = 0; n < batch; ++n)
+          all_done = all_done && done[n];
+        if (!all_done) return false;
+        for (size_t tt = from; tt < total; ++tt)
           for (size_t n = 0; n < batch; ++n)
-            all_done = all_done && done[n];
-          if (all_done) {
-            for (size_t tt = t + 1; tt < total; ++tt)
-              for (size_t n = 0; n < batch; ++n)
-                buf.ptr()[n * window + tt] =
-                    static_cast<float>(stop_id);
-            break;
-          }
+            buf.ptr()[n * window + tt] = static_cast<float>(stop_id);
+        return true;
+      };
+      bool kv_cache = wf.CanDecodeStep();
+      auto t0 = std::chrono::steady_clock::now();
+      if (kv_cache) {
+        // KV-cached decode: one position per step — TransformerBlock
+        // keeps per-layer K/V across steps, so each token costs
+        // O(pos·d + d²) instead of the O(seq²·d) full-buffer rescan.
+        // Token placement, sampler stream and --stop semantics are
+        // identical to the rescan path below (bit-exact logits: the
+        // same per-row accumulation order).
+        wf.BeginDecode(batch, total);
+        veles_rt::Tensor step({batch, 1});
+        for (size_t t = 0; t + 1 < total; ++t) {
+          for (size_t n = 0; n < batch; ++n)
+            step.ptr()[n] = buf.ptr()[n * window + t];
+          veles_rt::Tensor logits = wf.RunStep(step, t, &pool);
+          if (logits.shape.size() != 3 || logits.dim(1) != 1)
+            throw std::runtime_error(
+                "--generate needs a per-token-logits package "
+                "(embedding + causal blocks + TokenProjection)");
+          if (t + 1 < prompt) continue;  // prompt prefill steps
+          ++decoded;
+          size_t vocab = logits.dim(2);
+          for (size_t n = 0; n < batch; ++n)
+            place_token(logits.ptr() + n * vocab, vocab, n, t + 1);
+          if (all_frozen_fill(t + 2)) break;
+        }
+      } else {
+        for (size_t t = prompt; t < total; ++t) {
+          ++decoded;
+          veles_rt::Tensor logits = wf.Run(buf, &pool);
+          if (logits.shape.size() != 3 || logits.dim(1) != window)
+            throw std::runtime_error(
+                "--generate needs a per-token-logits package "
+                "(embedding + causal blocks + TokenProjection)");
+          size_t vocab = logits.dim(2);
+          for (size_t n = 0; n < batch; ++n)
+            place_token(logits.ptr() + (n * window + t - 1) * vocab,
+                        vocab, n, t);
+          if (all_frozen_fill(t + 1)) break;
         }
       }
       double dt = std::chrono::duration<double>(
@@ -185,11 +216,11 @@ int main(int argc, char** argv) {
       std::printf(
           "{\"workflow\": \"%s\", \"units\": %zu, \"batch\": %zu, "
           "\"generated\": %d, \"decoded_steps\": %zu, "
-          "\"temperature\": %.3f, \"top_k\": %d, "
+          "\"kv_cache\": %s, \"temperature\": %.3f, \"top_k\": %d, "
           "\"sec_total\": %.6f, \"tokens_per_sec\": %.1f}\n",
           wf.name().c_str(), wf.unit_count(), batch, generate,
-          decoded, temperature, top_k, dt,
-          batch * decoded / (dt > 0 ? dt : 1e-9));
+          decoded, kv_cache ? "true" : "false", temperature, top_k,
+          dt, batch * decoded / (dt > 0 ? dt : 1e-9));
       return 0;
     }
     veles_rt::Tensor out = wf.Run(input, &pool);  // warm (touch pages)

@@ -579,6 +579,32 @@ void EmbeddingU::Execute(const Tensor& in, Tensor* out,
   });
 }
 
+void EmbeddingU::ExecuteStep(const Tensor& in, Tensor* out, size_t pos,
+                             ThreadPool* pool) const {
+  (void)pool;
+  size_t batch = in.dim(0);
+  size_t d = static_cast<size_t>(dim_);
+  if (weights_.dim(0) != static_cast<size_t>(vocab_) ||
+      weights_.dim(1) != d)
+    throw std::runtime_error("Embedding parameter shape mismatch");
+  if (learned_positions_ &&
+      (positions_.dim(0) <= pos || positions_.dim(1) != d))
+    throw std::runtime_error(
+        "Embedding decode position exceeds the positional table");
+  out->reshape({batch, 1, d});
+  for (size_t n = 0; n < batch; ++n) {
+    long tok = static_cast<long>(in.ptr()[n]);
+    if (tok < 0 || tok >= vocab_)
+      throw std::runtime_error("Embedding token id out of range");
+    float* y = out->ptr() + n * d;
+    std::memcpy(y, weights_.ptr() + tok * d, d * sizeof(float));
+    if (learned_positions_) {
+      const float* p = positions_.ptr() + pos * d;
+      for (size_t j = 0; j < d; ++j) y[j] += p[j];
+    }
+  }
+}
+
 // -- TransformerBlock ---------------------------------------------------------
 
 namespace {
@@ -669,17 +695,10 @@ void TransformerBlockU::BuildMoE() const {
   }
 }
 
-void TransformerBlockU::Execute(const Tensor& in, Tensor* out,
-                                ThreadPool* pool) const {
-  size_t batch = in.dim(0), seq = in.dim(1), d = in.dim(2);
-  size_t h = static_cast<size_t>(heads_);
-  if (d % h)
-    throw std::runtime_error("TransformerBlock dim/heads mismatch");
-  size_t hd = d / h;
-  // build the MoE sub-unit FIRST: it mutates p_ (moves the expert
-  // tensors out), so every Execute thread must pass this barrier
-  // before any p_ access below
-  if (n_experts_) std::call_once(moe_once_, [this] { BuildMoE(); });
+void TransformerBlockU::ValidateParams(size_t d) const {
+  // full presence + shape validation before any pointer arithmetic
+  // (same invariant as MoE/Embedding/Dense/Conv): a truncated package
+  // must throw, not read out of bounds
   for (const char* name : {"ln1_scale", "ln1_bias", "wq", "wk", "wv",
                            "wo", "ln2_scale", "ln2_bias"})
     if (!p_.count(name))
@@ -690,9 +709,6 @@ void TransformerBlockU::Execute(const Tensor& in, Tensor* out,
       if (!p_.count(name))
         throw std::runtime_error(
             std::string("TransformerBlock missing param ") + name);
-  // full shape validation before any pointer arithmetic (same
-  // invariant as MoE/Embedding/Dense/Conv): a truncated package must
-  // throw, not read out of bounds
   for (const char* name : {"ln1_scale", "ln1_bias", "ln2_scale",
                            "ln2_bias"})
     if (p_.at(name).count() != d)
@@ -710,6 +726,20 @@ void TransformerBlockU::Execute(const Tensor& in, Tensor* out,
         p_.at("ffn_b2").count() != d)
       throw std::runtime_error("TransformerBlock bad FFN shapes");
   }
+}
+
+void TransformerBlockU::Execute(const Tensor& in, Tensor* out,
+                                ThreadPool* pool) const {
+  size_t batch = in.dim(0), seq = in.dim(1), d = in.dim(2);
+  size_t h = static_cast<size_t>(heads_);
+  if (d % h)
+    throw std::runtime_error("TransformerBlock dim/heads mismatch");
+  size_t hd = d / h;
+  // build the MoE sub-unit FIRST: it mutates p_ (moves the expert
+  // tensors out), so every Execute thread must pass this barrier
+  // before any p_ access below
+  if (n_experts_) std::call_once(moe_once_, [this] { BuildMoE(); });
+  ValidateParams(d);
   out->reshape(in.shape);
   float scale = 1.0f / std::sqrt(static_cast<float>(hd));
 
@@ -787,6 +817,117 @@ void TransformerBlockU::Execute(const Tensor& in, Tensor* out,
         MatVecRows(hid.data(), p_.at("ffn_w2").ptr(), f2.data(), seq,
                    hdim, d);
         for (size_t j = 0; j < seq * d; ++j) y[j] += f2[j];
+      }
+    }
+  });
+}
+
+void TransformerBlockU::BeginDecode(size_t batch, size_t window) {
+  if (!causal_)  // a non-causal block's past outputs change when
+    // future tokens arrive — single-position steps cannot reproduce
+    // them (same contract as models/generate.py's kv path)
+    throw std::runtime_error(
+        "TransformerBlock: KV-cached decode needs causal blocks");
+  if (!p_.count("wq"))
+    throw std::runtime_error("TransformerBlock missing param wq");
+  size_t d = p_.at("wq").dim(0);
+  decode_batch_ = batch;
+  decode_window_ = window;
+  k_cache_.assign(batch * window * d, 0.0f);
+  v_cache_.assign(batch * window * d, 0.0f);
+}
+
+void TransformerBlockU::ExecuteStep(const Tensor& in, Tensor* out,
+                                    size_t pos,
+                                    ThreadPool* pool) const {
+  size_t batch = in.dim(0), d = in.dim(2);
+  size_t h = static_cast<size_t>(heads_);
+  if (d % h)
+    throw std::runtime_error("TransformerBlock dim/heads mismatch");
+  size_t hd = d / h;
+  if (n_experts_) std::call_once(moe_once_, [this] { BuildMoE(); });
+  ValidateParams(d);
+  if (batch != decode_batch_ || pos >= decode_window_ ||
+      k_cache_.size() != decode_batch_ * decode_window_ * d)
+    throw std::runtime_error(
+        "TransformerBlock decode step outside BeginDecode bounds");
+  out->reshape(in.shape);
+  float scale = 1.0f / std::sqrt(static_cast<float>(hd));
+  const MoE* moe = moe_.get();
+  size_t W = decode_window_;
+
+  pool->ParallelFor(batch, [&](size_t n0, size_t n1) {
+    // per-row single-position step: same math/accumulation order as
+    // Execute's query row at ``pos`` (bit-exact greedy parity), but
+    // K/V of earlier positions come from the cache instead of being
+    // recomputed — O(pos·d + d²) per token instead of O(seq²·d)
+    std::vector<float> ln(d), q(d), attn(d), logits(pos + 1), hid;
+    for (size_t n = n0; n < n1; ++n) {
+      const float* x = in.ptr() + n * d;
+      float* y = out->ptr() + n * d;
+      float* kc = k_cache_.data() + n * W * d;
+      float* vc = v_cache_.data() + n * W * d;
+      // ---- attention half: y = x + Wo·attn(LN1(x))
+      LayerNormRow(x, p_.at("ln1_scale").ptr(),
+                   p_.at("ln1_bias").ptr(), ln.data(), d);
+      std::fill(q.begin(), q.end(), 0.0f);
+      MatVecRows(ln.data(), p_.at("wq").ptr(), q.data(), 1, d, d);
+      // this position's K/V project straight into the cache rows
+      float* krow = kc + pos * d;
+      float* vrow = vc + pos * d;
+      std::fill(krow, krow + d, 0.0f);
+      std::fill(vrow, vrow + d, 0.0f);
+      MatVecRows(ln.data(), p_.at("wk").ptr(), krow, 1, d, d);
+      MatVecRows(ln.data(), p_.at("wv").ptr(), vrow, 1, d, d);
+      std::fill(attn.begin(), attn.end(), 0.0f);
+      for (size_t hh = 0; hh < h; ++hh) {
+        size_t off = hh * hd;
+        float mx = -std::numeric_limits<float>::infinity();
+        for (size_t sk = 0; sk <= pos; ++sk) {
+          float dot = 0;
+          const float* kr = kc + sk * d + off;
+          for (size_t j = 0; j < hd; ++j) dot += q[off + j] * kr[j];
+          logits[sk] = dot * scale;
+          mx = std::fmax(mx, logits[sk]);
+        }
+        float denom = 0;
+        for (size_t sk = 0; sk <= pos; ++sk) {
+          logits[sk] = std::exp(logits[sk] - mx);
+          denom += logits[sk];
+        }
+        float* arow = attn.data() + off;
+        for (size_t sk = 0; sk <= pos; ++sk) {
+          float wgt = logits[sk] / denom;
+          const float* vr = vc + sk * d + off;
+          for (size_t j = 0; j < hd; ++j) arow[j] += wgt * vr[j];
+        }
+      }
+      std::memcpy(y, x, d * sizeof(float));
+      MatVecRows(attn.data(), p_.at("wo").ptr(), y, 1, d, d);
+      // ---- FFN half: y += FFN(LN2(y))
+      LayerNormRow(y, p_.at("ln2_scale").ptr(),
+                   p_.at("ln2_bias").ptr(), ln.data(), d);
+      if (n_experts_) {
+        Tensor lnt({1, d});
+        std::memcpy(lnt.ptr(), ln.data(), d * sizeof(float));
+        Tensor ffn_out;
+        ThreadPool serial(1);  // already inside the batch ParallelFor
+        moe->Execute(lnt, &ffn_out, &serial);
+        for (size_t j = 0; j < d; ++j) y[j] += ffn_out.ptr()[j];
+      } else {
+        size_t hdim = static_cast<size_t>(hidden_);
+        hid.assign(hdim, 0.0f);
+        std::memcpy(hid.data(), p_.at("ffn_b1").ptr(),
+                    hdim * sizeof(float));
+        MatVecRows(ln.data(), p_.at("ffn_w1").ptr(), hid.data(), 1,
+                   d, hdim);
+        for (auto& t : hid) t = std::fmax(t, 0.0f);
+        std::vector<float> f2(d);
+        std::memcpy(f2.data(), p_.at("ffn_b2").ptr(),
+                    d * sizeof(float));
+        MatVecRows(hid.data(), p_.at("ffn_w2").ptr(), f2.data(), 1,
+                   hdim, d);
+        for (size_t j = 0; j < d; ++j) y[j] += f2[j];
       }
     }
   });

@@ -31,6 +31,21 @@ class Unit {
                        ThreadPool* pool) const = 0;
   // adopt parameters loaded from the archive's npy files
   virtual void SetParam(const std::string& /*name*/, Tensor /*t*/) {}
+
+  // -- KV-cached decode (mirrors models/generate.py's apply_step):
+  // CanStep units accept ONE sequence position per ExecuteStep call;
+  // stateful units (TransformerBlock) keep per-layer K/V buffers
+  // across steps, turning the O(L²)-per-token full-buffer decode into
+  // O(L).  BeginDecode (re)sizes + resets that state; the default
+  // ExecuteStep suits position-independent units, which just run
+  // their normal forward on the [batch, 1, ...] slice.
+  virtual bool CanStep() const { return false; }
+  virtual void BeginDecode(size_t /*batch*/, size_t /*window*/) {}
+  virtual void ExecuteStep(const Tensor& in, Tensor* out, size_t pos,
+                           ThreadPool* pool) const {
+    (void)pos;
+    Execute(in, out, pool);
+  }
   std::string name;
 };
 
@@ -147,6 +162,10 @@ class EmbeddingU : public Unit {
                ThreadPool* pool) const override;
   void SetParam(const std::string& name, Tensor t) override;
 
+  bool CanStep() const override { return true; }
+  void ExecuteStep(const Tensor& in, Tensor* out, size_t pos,
+                   ThreadPool* pool) const override;
+
  private:
   int vocab_, dim_;
   bool learned_positions_;
@@ -162,9 +181,18 @@ class TransformerBlockU : public Unit {
   void Execute(const Tensor& in, Tensor* out,
                ThreadPool* pool) const override;
   void SetParam(const std::string& name, Tensor t) override;
+  // KV-cached single-position decode: writes this step's K/V into
+  // the per-block cache and attends over positions [0, pos] only —
+  // O(pos·d) attention per token instead of re-running the whole
+  // O(seq²) buffer.  Causal blocks only (BeginDecode enforces).
+  bool CanStep() const override { return causal_; }
+  void BeginDecode(size_t batch, size_t window) override;
+  void ExecuteStep(const Tensor& in, Tensor* out, size_t pos,
+                   ThreadPool* pool) const override;
 
  private:
   void BuildMoE() const;
+  void ValidateParams(size_t d) const;
 
   int heads_, hidden_, n_experts_, top_k_;
   bool causal_;
@@ -175,6 +203,10 @@ class TransformerBlockU : public Unit {
   //: (a served model handles parallel requests on one unit)
   mutable std::unique_ptr<MoE> moe_;
   mutable std::once_flag moe_once_;
+  //: decode K/V caches, [batch, window, d] each (BeginDecode sizes;
+  //: ExecuteStep writes row ``pos`` — single decode driver thread)
+  mutable std::vector<float> k_cache_, v_cache_;
+  size_t decode_batch_ = 0, decode_window_ = 0;
 };
 
 class MeanPoolSeqU : public Unit {  // [b, s, d] -> [b, d]
@@ -196,6 +228,10 @@ class TokenProjectionU : public Unit {
                ThreadPool* pool) const override;
   void SetParam(const std::string& name, Tensor t) override;
 
+  // position-wise (the DECODE_POINTWISE convention): the default
+  // ExecuteStep — plain Execute on the [batch, 1, d] slice — is exact
+  bool CanStep() const override { return true; }
+
  private:
   int vocab_;
   Tensor weights_, bias_;
@@ -211,6 +247,7 @@ class Identity : public Unit {  // dropout at inference
     out->shape = in.shape;
     out->data = in.data;
   }
+  bool CanStep() const override { return true; }
 };
 
 // factory keyed by exporter class name (unit_factory.cc role)

@@ -59,4 +59,29 @@ Tensor PackagedWorkflow::Run(const Tensor& input, ThreadPool* pool) {
   return *src;
 }
 
+bool PackagedWorkflow::CanDecodeStep() const {
+  if (units_.empty()) return false;
+  for (const auto& u : units_)
+    if (!u->CanStep()) return false;
+  return true;
+}
+
+void PackagedWorkflow::BeginDecode(size_t batch, size_t window) {
+  for (auto& u : units_) u->BeginDecode(batch, window);
+}
+
+Tensor PackagedWorkflow::RunStep(const Tensor& input, size_t pos,
+                                 ThreadPool* pool) {
+  if (input.shape.size() != 2 || input.dim(1) != 1)
+    throw std::runtime_error("RunStep expects a [batch, 1] input");
+  const Tensor* src = &input;
+  Tensor* dst = &step_a_;
+  for (const auto& u : units_) {
+    u->ExecuteStep(*src, dst, pos, pool);
+    src = dst;
+    dst = (dst == &step_a_) ? &step_b_ : &step_a_;
+  }
+  return *src;
+}
+
 }  // namespace veles_rt

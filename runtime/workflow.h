@@ -25,6 +25,16 @@ class PackagedWorkflow {
   // forward pass; input batch must not exceed the packaged batch
   Tensor Run(const Tensor& input, ThreadPool* pool);
 
+  // -- KV-cached decode (counterpart of models/generate.py's kv
+  // path): when every unit CanStep, RunStep feeds ONE sequence
+  // position [batch, 1] through the chain per call — stateful units
+  // keep K/V across steps, so a decode costs O(L·d) per token
+  // instead of the O(L²·d) full-buffer rescan.  BeginDecode sizes
+  // and resets that per-unit state.
+  bool CanDecodeStep() const;
+  void BeginDecode(size_t batch, size_t window);
+  Tensor RunStep(const Tensor& input, size_t pos, ThreadPool* pool);
+
   const std::vector<size_t>& input_shape() const { return input_shape_; }
   const std::string& name() const { return name_; }
   size_t unit_count() const { return units_.size(); }
@@ -34,8 +44,10 @@ class PackagedWorkflow {
   std::vector<size_t> input_shape_;
   std::vector<std::unique_ptr<Unit>> units_;
   // the two ping-pong arenas, reused across Run calls (reshape keeps
-  // storage, so --repeat loops do no per-layer allocation)
-  Tensor buf_a_, buf_b_;
+  // storage, so --repeat loops do no per-layer allocation); decode
+  // steps get their own pair so an interleaved full Run cannot
+  // clobber an in-flight step
+  Tensor buf_a_, buf_b_, step_a_, step_b_;
 };
 
 }  // namespace veles_rt

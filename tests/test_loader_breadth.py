@@ -460,8 +460,10 @@ def test_generate_endpoint_concurrent_soak():
     """Concurrency soak on the decode endpoint: many threads mixing
     greedy/sampled/ragged/beam/stop requests against ONE RESTfulAPI —
     every request must answer correctly (greedy requests keep exact
-    determinism while sampled/beam traffic interleaves; the decode
-    lock serializes Array.devmem and the compile caches)."""
+    determinism while sampled/beam traffic interleaves; non-beam
+    requests ride the continuous-batching scheduler's slots, beam
+    stays on the serialized legacy path — the two run concurrently).
+    The overlap/latency assertions live in tests/test_serving.py."""
     api, loader, post = _lm_api("soak", timeout=120)
     try:
         baseline = post({"prompt": [3, 1, 4], "steps": 5})["tokens"]

@@ -451,6 +451,10 @@ def test_cpp_runner_generate_greedy_parity(runner_binary, tmp_path):
         assert r.returncode == 0, r.stderr
         status = json.loads(r.stdout)
         assert status["generated"] == steps
+        # the runner must decode through its per-layer K/V caches
+        # (O(L) per token), not the full-buffer rescan — and still be
+        # token-for-token with the Python decode
+        assert status["kv_cache"] is True
         y = numpy.load(tmp_path / "out.npy").astype(numpy.int32)
         assert y.shape == (2, prompt_len + steps)
         numpy.testing.assert_array_equal(y, y_ref)

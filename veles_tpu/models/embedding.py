@@ -86,6 +86,21 @@ class Embedding(ForwardBase):
             y = y + row[None]
         return y
 
+    def apply_step_slots(self, params, x, pos):
+        """Per-slot decode step (serving path): x [batch, 1] token
+        ids where row n sits at ITS OWN sequence index ``pos[n]``
+        ([batch] ints, traced) — each slot's positional row is
+        gathered independently."""
+        from veles_tpu import dtypes
+        cd = dtypes.compute_dtype()
+        y = jnp.take(params["weights"].astype(cd),
+                     x.astype(jnp.int32), axis=0)
+        if self.learned_positions:
+            rows = jnp.take(params["positions"].astype(cd),
+                            pos, axis=0)
+            y = y + rows[:, None, :]
+        return y
+
     def export_config(self):
         return {"vocab": self.vocab, "dim": self.dim,
                 "learned_positions": self.learned_positions}

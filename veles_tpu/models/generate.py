@@ -90,6 +90,34 @@ def _make_pre_step(forwards, b):
     return pre_step
 
 
+def _make_prefill(forwards):
+    """BATCHED prompt-prefill builder (serving PR): ONE forward pass
+    over the whole prompt fills every cacheable block's K/V rows —
+    TTFT drops from O(prompt_len) compiled scan steps to O(1).  The
+    chain runs only up to the LAST cacheable block (later units fill
+    no caches and their prompt outputs are discarded).  Returns None
+    when any cacheable unit predates ``apply_prefill`` — the caller
+    falls back to the per-token scan."""
+    cacheable = [i for i, u in enumerate(forwards)
+                 if hasattr(u, "init_cache")]
+    if not cacheable or any(
+            not hasattr(forwards[i], "apply_prefill")
+            for i in cacheable):
+        return None
+    last = cacheable[-1]
+
+    def prefill(params, toks, caches):
+        h = toks
+        out = dict(caches)
+        for i, u in enumerate(forwards[:last + 1]):
+            if hasattr(u, "init_cache"):
+                h, out[i] = u.apply_prefill(params[i], h, caches[i])
+            else:
+                h = u.apply(params[i], h)
+        return out
+    return prefill
+
+
 def kv_cache_eligible(forwards):
     """True when :func:`generate` can decode this chain with
     ``kv_cache=True``: every cacheable block is causal and every other
@@ -300,7 +328,8 @@ def generate(forwards, prompt, steps, temperature=0.0, top_k=0,
             return decode(params, buf0, key, caches0, lens,
                           stop0)
         decode = _decode_cached_kv(
-            cache_key, _StepClosure((pre_step, dec_step)))
+            cache_key, _StepClosure((_make_prefill(forwards),
+                                     pre_step, dec_step)))
         return decode(params, buf0, key, caches0, stop0)
     if lens is not None:
         # positions before every row's prompt end need no forward at
@@ -387,7 +416,8 @@ def generate_beam(forwards, prompt, steps, beam):
                  "beam", str(dtypes.compute_dtype()),
                  str(dtypes.matmul_precision()))
     decode = _decode_cached_beam(
-        cache_key, _StepClosure((pre_step, beam_step, beam)))
+        cache_key, _StepClosure((_make_prefill(forwards), pre_step,
+                                 beam_step, beam)))
     return decode(params, buf0, caches0)
 
 
@@ -439,14 +469,19 @@ def _decode_cached(cache_key, step_closure):
 @functools.lru_cache(maxsize=16)
 def _decode_cached_kv(cache_key, step_closure):
     steps, p_len = cache_key[2], cache_key[3]
-    pre_step, dec_step = step_closure.fn
+    prefill, pre_step, dec_step = step_closure.fn
 
     @jax.jit
     def decode(params, buf, key, caches, stop):
         if p_len > 1:  # prefill caches over the prompt's predecessors
-            (buf, _, caches), _ = jax.lax.scan(
-                functools.partial(pre_step, params),
-                (buf, jnp.int32(0), caches), None, length=p_len - 1)
+            if prefill is not None:
+                # ONE batched pass over the prompt (TTFT O(1) steps)
+                caches = prefill(params, buf[:, :p_len - 1], caches)
+            else:
+                (buf, _, caches), _ = jax.lax.scan(
+                    functools.partial(pre_step, params),
+                    (buf, jnp.int32(0), caches), None,
+                    length=p_len - 1)
         (buf, _, _, caches, _), _ = jax.lax.scan(
             functools.partial(dec_step, params),
             (buf, jnp.int32(p_len - 1), key, caches, stop), None,
@@ -475,14 +510,18 @@ def _decode_cached_varlen(cache_key, step_closure):
 @functools.lru_cache(maxsize=16)
 def _decode_cached_beam(cache_key, step_closure):
     steps, p_len = cache_key[2], cache_key[3]
-    pre_step, beam_step, beam = step_closure.fn
+    prefill, pre_step, beam_step, beam = step_closure.fn
 
     @jax.jit
     def decode(params, buf, caches):
         if p_len > 1:  # prefill at batch b, then tile beam-ways
-            (buf, _, caches), _ = jax.lax.scan(
-                functools.partial(pre_step, params),
-                (buf, jnp.int32(0), caches), None, length=p_len - 1)
+            if prefill is not None:
+                caches = prefill(params, buf[:, :p_len - 1], caches)
+            else:
+                (buf, _, caches), _ = jax.lax.scan(
+                    functools.partial(pre_step, params),
+                    (buf, jnp.int32(0), caches), None,
+                    length=p_len - 1)
         b, total = buf.shape
         bufs = jnp.repeat(buf[:, None, :], beam, axis=1)
         caches = jax.tree_util.tree_map(

@@ -155,6 +155,113 @@ class TransformerBlock(ForwardBase):
         return {"k": jnp.zeros((batch, max_len, d), dtype),
                 "v": jnp.zeros((batch, max_len, d), dtype)}
 
+    def _qkv(self, params, x):
+        """LN1 + q/k/v projections in the decode conventions (the
+        projection dtypes apply_step documents — shared by the
+        single-token, per-slot and batched-prefill steps so all three
+        produce identical K/V rows)."""
+        from veles_tpu import dtypes
+        cd = dtypes.compute_dtype()
+        ad = dtypes.accum_dtype()
+        prec = dtypes.matmul_precision()
+        ln = _layer_norm(x, params["ln1_scale"], params["ln1_bias"])
+
+        def proj(name):
+            y = jnp.einsum("bsd,de->bse", ln.astype(cd),
+                           params[name].astype(cd), precision=prec,
+                           preferred_element_type=ad)
+            return y.astype(cd)
+
+        return proj("wq"), proj("wk"), proj("wv")
+
+    def _attn_out(self, params, x, probs, vh):
+        """probs·V + output projection + residual + FFN half (the
+        shared tail of every decode-step variant)."""
+        from veles_tpu import dtypes
+        cd = dtypes.compute_dtype()
+        ad = dtypes.accum_dtype()
+        prec = dtypes.matmul_precision()
+        b, s, d = x.shape
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs, vh).reshape(b, s, d)
+        attn = jnp.einsum("bsd,de->bse", o.astype(cd),
+                          params["wo"].astype(cd), precision=prec,
+                          preferred_element_type=ad).astype(x.dtype)
+        y = x + attn
+        return y + self._ffn(params, _layer_norm(
+            y, params["ln2_scale"], params["ln2_bias"]))
+
+    def apply_prefill(self, params, x, cache, lens=None):
+        """Batched prompt prefill: consume ALL of x [batch, P, d] in
+        ONE pass, writing every position's K/V into cache rows
+        [0, P) — the O(1)-compiled-steps replacement for scanning
+        :meth:`apply_step` over the prompt.  Same projection/attention
+        conventions as apply_step, so the cache rows and outputs match
+        the per-token scan (f32).
+
+        ``lens`` (optional [batch] ints, traced): ragged prompts —
+        K/V rows at or past each row's length are ZEROED (exactly the
+        rows a per-row sequential prefill would have left at the
+        init_cache zeros), and output rows past the length are
+        garbage the caller must not read.  Valid rows are unaffected:
+        the causal mask keeps queries q < lens[n] away from the
+        zeroed keys."""
+        from veles_tpu import dtypes
+        cd = dtypes.compute_dtype()
+        b, p, d = x.shape
+        h = self.heads
+        hd = d // h
+        q, k_new, v_new = self._qkv(params, x)
+        if lens is not None:
+            keep = (jnp.arange(p)[None, :] < lens[:, None])[..., None]
+            k_new = jnp.where(keep, k_new, 0).astype(k_new.dtype)
+            v_new = jnp.where(keep, v_new, 0).astype(v_new.dtype)
+        ck = jax.lax.dynamic_update_slice(
+            cache["k"], k_new.astype(cache["k"].dtype), (0, 0, 0))
+        cv = jax.lax.dynamic_update_slice(
+            cache["v"], v_new.astype(cache["v"].dtype), (0, 0, 0))
+        qh = q.reshape(b, p, h, hd)
+        kh = k_new.astype(cd).reshape(b, p, h, hd)
+        vh = v_new.astype(cd).reshape(b, p, h, hd)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) \
+            * (1.0 / jnp.sqrt(hd))
+        mask = (jnp.arange(p)[None, :]
+                <= jnp.arange(p)[:, None])[None, None]
+        logits = jnp.where(mask, logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return self._attn_out(params, x, probs, vh), \
+            {"k": ck, "v": cv}
+
+    def apply_step_slots(self, params, x, pos, cache):
+        """Decode ONE position PER ROW: x [batch, 1, d] where row n
+        sits at ITS OWN sequence index ``pos[n]`` ([batch] ints,
+        traced) — the serving-slot shape: requests at different decode
+        depths share one compiled step.  Row-for-row the same math as
+        :meth:`apply_step` (which is the all-pos-equal special case):
+        K/V written at ``pos[n]``, attention over keys ≤ ``pos[n]``."""
+        from veles_tpu import dtypes
+        cd = dtypes.compute_dtype()
+        b, _, d = x.shape
+        h = self.heads
+        hd = d // h
+        q, k_new, v_new = self._qkv(params, x)
+        rows = jnp.arange(b)
+        ck = cache["k"].at[rows, pos].set(
+            k_new[:, 0].astype(cache["k"].dtype))
+        cv = cache["v"].at[rows, pos].set(
+            v_new[:, 0].astype(cache["v"].dtype))
+        length = ck.shape[1]
+        qh = q.reshape(b, 1, h, hd)
+        kh = ck.astype(cd).reshape(b, length, h, hd)
+        vh = cv.astype(cd).reshape(b, length, h, hd)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) \
+            * (1.0 / jnp.sqrt(hd))
+        mask = (jnp.arange(length)[None, :]
+                <= pos[:, None])[:, None, None, :]
+        logits = jnp.where(mask, logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return self._attn_out(params, x, probs, vh), \
+            {"k": ck, "v": cv}
+
     def apply_step(self, params, x, pos, cache):
         """Decode ONE position: x [batch, 1, d] at sequence index
         ``pos`` (traced scalar); returns (y, cache') with this step's
@@ -167,20 +274,10 @@ class TransformerBlock(ForwardBase):
         identical in f32."""
         from veles_tpu import dtypes
         cd = dtypes.compute_dtype()
-        ad = dtypes.accum_dtype()
-        prec = dtypes.matmul_precision()
         b, _, d = x.shape
         h = self.heads
         hd = d // h
-        ln = _layer_norm(x, params["ln1_scale"], params["ln1_bias"])
-
-        def proj(name):
-            y = jnp.einsum("bsd,de->bse", ln.astype(cd),
-                           params[name].astype(cd), precision=prec,
-                           preferred_element_type=ad)
-            return y.astype(cd)
-
-        q, k_new, v_new = proj("wq"), proj("wk"), proj("wv")
+        q, k_new, v_new = self._qkv(params, x)
         ck = jax.lax.dynamic_update_slice(
             cache["k"], k_new.astype(cache["k"].dtype), (0, pos, 0))
         cv = jax.lax.dynamic_update_slice(
@@ -194,14 +291,8 @@ class TransformerBlock(ForwardBase):
         mask = (jnp.arange(length) <= pos)[None, None, None, :]
         logits = jnp.where(mask, logits, -jnp.inf)
         probs = jax.nn.softmax(logits, axis=-1)
-        o = jnp.einsum("bhqk,bkhd->bqhd", probs, vh).reshape(b, 1, d)
-        attn = jnp.einsum("bsd,de->bse", o.astype(cd),
-                          params["wo"].astype(cd), precision=prec,
-                          preferred_element_type=ad).astype(x.dtype)
-        y = x + attn
-        out = y + self._ffn(params, _layer_norm(
-            y, params["ln2_scale"], params["ln2_bias"]))
-        return out, {"k": ck, "v": cv}
+        return self._attn_out(params, x, probs, vh), \
+            {"k": ck, "v": cv}
 
     def export_config(self):
         cfg = {"heads": self.heads, "hidden": int(self.hidden),

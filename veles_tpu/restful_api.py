@@ -67,12 +67,24 @@ class RESTfulAPI(Unit):
     """HTTP endpoint unit (ref: veles/restful_api.py:78): POST /api
     ``{"input": [...]}`` → ``{"result": [...]}``.  Runs after the
     forward chain; resolves each request's future with its output row.
+
+    With an LM ``forwards`` chain, POST /generate serves through the
+    continuous-batching scheduler (``veles_tpu/serving/``): each
+    prompt row is an independent request that joins a decode slot at a
+    token boundary, so concurrent clients genuinely interleave — there
+    is no decode lock on this path.  Admission control surfaces as
+    HTTP 503 (queue full) / 408 (queue deadline), and GET
+    /serving/metrics reports TTFT, throughput, queue depth and slot
+    occupancy.  Beam requests (and chains the scheduler cannot serve)
+    fall back to the serialized legacy decode.
     """
 
     VIEW_GROUP = "SERVICE"
 
     def __init__(self, workflow, loader=None, port=0, host="127.0.0.1",
-                 request_timeout=30.0, forwards=None, **kwargs):
+                 request_timeout=30.0, forwards=None, serving=True,
+                 max_slots=4, serving_window=None, max_queue=32,
+                 **kwargs):
         super(RESTfulAPI, self).__init__(workflow, **kwargs)
         self.loader = loader
         self.output = None  # linked from the head forward unit
@@ -83,8 +95,15 @@ class RESTfulAPI(Unit):
         #: wire their stop request here)
         self.shutdown_callback = None
         #: optional LM forward chain (… → TokenProjection); when set,
-        #: POST /generate decodes autoregressively via models/generate
+        #: POST /generate decodes autoregressively via the serving
+        #: scheduler (or models/generate when serving is off)
         self.forwards = forwards
+        #: continuous-batching knobs (serving=False pins the legacy
+        #: serialized decode path)
+        self.serving = bool(serving)
+        self.max_slots = int(max_slots)
+        self.serving_window = serving_window
+        self.max_queue = int(max_queue)
         self.demand("loader", "output")
 
     def _validate_prompt(self, prompt):
@@ -102,21 +121,21 @@ class RESTfulAPI(Unit):
 
     def _decode_beam(self, prompt, steps, beam):
         """Beam-search decode for /generate (serialized like
-        :meth:`_decode`)."""
+        :meth:`_decode` — beam search stays off the slot scheduler)."""
         from veles_tpu.models.generate import generate_beam
-        with self._decode_lock_:
+        with self._legacy_lock_:
             return generate_beam(self.forwards, prompt, steps, beam)
 
     def _decode(self, prompt, steps, temperature, top_k, seed,
                 prompt_lens=None, stop_token=None):
-        """Run the decode for /generate — kv-cached when the chain is
-        eligible, full-buffer rescan otherwise.  Serialized: decode
-        requests share the chain's param Arrays and the compile
-        caches; a novel (batch, prompt_len, steps, sampler) shape
-        compiles a fresh executable on first use (seconds), so
-        variable-shape clients pay per shape, cached thereafter
-        (ragged lengths within one shape reuse the same executable —
-        the lens are a traced argument)."""
+        """Legacy lockstep decode for /generate — the fallback when
+        the serving scheduler is off or cannot serve the chain.
+        Serialized: decode requests share the chain's param Arrays and
+        the compile caches; a novel (batch, prompt_len, steps,
+        sampler) shape compiles a fresh executable on first use
+        (seconds), so variable-shape clients pay per shape, cached
+        thereafter (ragged lengths within one shape reuse the same
+        executable — the lens are a traced argument)."""
         import jax
 
         from veles_tpu.models.generate import generate, \
@@ -127,7 +146,7 @@ class RESTfulAPI(Unit):
             import os
             seed = int.from_bytes(os.urandom(4), "little")
         key = jax.random.key(int(seed)) if temperature else None
-        with self._decode_lock_:
+        with self._legacy_lock_:
             return generate(self.forwards, prompt, steps,
                             temperature=temperature, top_k=top_k,
                             key=key,
@@ -135,11 +154,27 @@ class RESTfulAPI(Unit):
                             prompt_lens=prompt_lens,
                             stop_token=stop_token)
 
+    def _generate_scheduled(self, rows, steps, temperature, top_k,
+                            seed, stop):
+        """Decode a /generate body through the continuous-batching
+        scheduler: every prompt row is its own request (ragged batches
+        interleave in the slots like independent clients).  Returns
+        per-row token lists, each ending at its first generated stop
+        token.  A pinned seed stays reproducible per row (row i draws
+        from seed + i)."""
+        futures = [self.scheduler_.submit(
+            row, steps, temperature=temperature, top_k=top_k,
+            seed=None if seed is None else int(seed) + i,
+            stop_token=stop, timeout=self.request_timeout)
+            for i, row in enumerate(rows)]
+        return [f.result(self.request_timeout) for f in futures]
+
     def init_unpickled(self):
         super(RESTfulAPI, self).init_unpickled()
         self._server_ = None
         self._thread_ = None
-        self._decode_lock_ = threading.Lock()
+        self._legacy_lock_ = threading.Lock()
+        self.scheduler_ = None
 
     def initialize(self, **kwargs):
         super(RESTfulAPI, self).initialize(**kwargs)
@@ -151,6 +186,23 @@ class RESTfulAPI(Unit):
             for u in self.forwards:
                 for arr in u.param_arrays().values():
                     arr.devmem
+        if self.forwards is not None and self.serving \
+                and self.scheduler_ is None:
+            from veles_tpu.serving import (
+                InferenceScheduler, serving_supported)
+            if serving_supported(self.forwards):
+                self.scheduler_ = InferenceScheduler(
+                    self.forwards, max_slots=self.max_slots,
+                    window=self.serving_window,
+                    max_queue=self.max_queue,
+                    queue_timeout=self.request_timeout).start()
+                self.info(
+                    "serving scheduler: %d slots, window %d, "
+                    "queue cap %d", self.scheduler_.max_slots,
+                    self.scheduler_.window, self.max_queue)
+            else:
+                self.info("chain not slot-servable; /generate stays "
+                          "on the serialized decode path")
         if self._server_ is not None:
             return
         api = self
@@ -158,6 +210,15 @@ class RESTfulAPI(Unit):
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
                 pass
+
+            def do_GET(self):
+                if self.path.rstrip("/") == "/serving/metrics":
+                    if api.scheduler_ is None:
+                        self.send_error(404, "no serving scheduler")
+                        return
+                    self._reply_json(api.scheduler_.metrics())
+                    return
+                self.send_error(404)
 
             def _reply_json(self, obj):
                 blob = json.dumps(obj).encode()
@@ -221,7 +282,17 @@ class RESTfulAPI(Unit):
                         if err:
                             self.send_error(400, err)
                             return
-                        steps = int(body["steps"])
+                        try:
+                            steps = int(body["steps"])
+                            if steps < 0:
+                                raise ValueError(steps)
+                        except (KeyError, TypeError, ValueError):
+                            # client error, not a server fault
+                            # (ADVICE r5 #1)
+                            self.send_error(
+                                400, "steps must be a non-negative "
+                                "int")
+                            return
                         ragged = min(lens) != width
                         try:
                             beam = int(body.get("beam", 0))
@@ -267,6 +338,34 @@ class RESTfulAPI(Unit):
                             self._reply_json(reply)
                             return
                         stop = body.get("stop")
+                        if api.scheduler_ is not None and steps >= 1:
+                            # continuous batching: rows join decode
+                            # slots independently — NO lock, so
+                            # concurrent clients interleave
+                            from veles_tpu.serving.scheduler import \
+                                SchedulerError
+                            try:
+                                outs = api._generate_scheduled(
+                                    rows, steps,
+                                    float(body.get("temperature",
+                                                   0.0)),
+                                    int(body.get("top_k", 0)),
+                                    body.get("seed"), stop)
+                            except ValueError as e:
+                                self.send_error(400, _status_text(e))
+                                return
+                            except SchedulerError as e:
+                                self.send_error(e.http_status,
+                                                _status_text(e))
+                                return
+                            except concurrent.futures.TimeoutError:
+                                self.send_error(
+                                    408, "decode timed out")
+                                return
+                            self._reply_json(
+                                {"tokens": outs[0] if squeeze
+                                 else outs})
+                            return
                         tokens = api._decode(
                             prompt, steps,
                             float(body.get("temperature", 0.0)),
@@ -330,6 +429,9 @@ class RESTfulAPI(Unit):
         self.loader.pending_futures_ = []
 
     def stop(self):
+        if self.scheduler_ is not None:
+            self.scheduler_.close()
+            self.scheduler_ = None
         if self._server_ is not None:
             self._server_.shutdown()
             self._server_ = None

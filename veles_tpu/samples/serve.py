@@ -87,6 +87,11 @@ class ServeWorkflow(AcceleratedWorkflow):
             self, loader=self.loader,
             port=int(cfg.get("port", 0)),
             host=cfg.get("host", "127.0.0.1"),
+            # continuous-batching knobs (docs/serving.md): slots,
+            # queue cap and the off switch ride root.serve
+            serving=bool(cfg.get("serving", True)),
+            max_slots=int(cfg.get("max_slots", 4)),
+            max_queue=int(cfg.get("max_queue", 32)),
             # an LM snapshot (per-token logits head) also serves
             # POST /generate — autoregressive decode off the same chain
             forwards=self.forwards

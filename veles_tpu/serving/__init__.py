@@ -1,0 +1,33 @@
+"""Continuous-batching inference serving (Orca/vLLM lineage), built
+natively on the jitted decode machinery in ``models/generate``.
+
+The decode path this package replaces served one client at a time:
+REST ``/generate`` held a single decode lock and prompt prefill was a
+per-token scan.  Here:
+
+- :mod:`veles_tpu.serving.prefill` — batched prefill: ONE jitted
+  forward over the whole prompt fills the KV cache (TTFT O(1)
+  compiled steps instead of O(prompt_len));
+- :mod:`veles_tpu.serving.kv_slots` — a slot-based batched KV cache
+  (fixed ``max_slots × window`` buffers, per-slot lengths) so requests
+  at different decode positions share one compiled step;
+- :mod:`veles_tpu.serving.engine` — that shared compiled step:
+  per-slot positions, per-slot sampler settings, per-request PRNG
+  streams;
+- :mod:`veles_tpu.serving.scheduler` — the continuous-batching
+  scheduler: requests join free slots at token boundaries and leave
+  on stop-token/step-limit, with admission control (queue-depth cap →
+  503, queue deadline → 408) and a background decode loop;
+- :mod:`veles_tpu.serving.metrics` — per-request TTFT, tokens/sec,
+  queue depth and slot occupancy, exposed through the JSONL event
+  sink (:mod:`veles_tpu.logger`) and a ``snapshot()`` dict.
+"""
+
+from veles_tpu.serving.engine import slot_decode_step  # noqa: F401
+from veles_tpu.serving.kv_slots import SlotKVCache  # noqa: F401
+from veles_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from veles_tpu.serving.prefill import (  # noqa: F401
+    prefill, serving_supported)
+from veles_tpu.serving.scheduler import (  # noqa: F401
+    DeadlineExceededError, InferenceScheduler, QueueFullError,
+    SchedulerError)

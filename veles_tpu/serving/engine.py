@@ -1,0 +1,124 @@
+"""The shared compiled decode step over serving slots.
+
+ONE executable serves every mix of in-flight requests: per-slot
+positions (``apply_step_slots`` — slots at different decode depths),
+per-slot sampler settings (temperature / top-k ride as traced
+vectors), and per-REQUEST PRNG streams (token ``t`` of a request with
+seed ``s`` is drawn with ``fold_in(key(s), t)`` — reproducible per
+seed no matter which slot the request landed in or what traffic it
+shared the batch with).
+
+Free slots decode garbage rows (position 0, token 0) rather than
+splitting the executable on an activity mask — their cache rows are
+wholesale-replaced at the next insert, so the garbage never escapes.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.models.generate import (
+    _StepClosure, _arch_sig, _device_params)
+
+
+def sample_slots(logits, temps, topks, keys):
+    """Per-slot next-token sampler: rows with ``temps[n] == 0`` take
+    the greedy argmax; sampling rows draw categorical(logits / temp)
+    restricted to each row's top-k (0 = full vocab; ties with the
+    k-th value stay in, matching ``generate``'s masking)."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    v = logits.shape[-1]
+    z = logits / jnp.maximum(temps, 1e-6)[:, None]
+    zs = jnp.sort(z, axis=-1)
+    kth = jnp.take_along_axis(
+        zs, jnp.clip(v - topks, 0, v - 1)[:, None], axis=-1)
+    z = jnp.where((topks[:, None] > 0) & (z < kth), -jnp.inf, z)
+    drawn = jax.vmap(jax.random.categorical)(keys, z)
+    return jnp.where(temps > 0, drawn.astype(jnp.int32), greedy)
+
+
+def _fold_keys(seeds, counts):
+    """Per-request stream keys: fold each request's draw counter into
+    its seed-derived base key."""
+    return jax.vmap(
+        lambda s, c: jax.random.fold_in(jax.random.key(s), c))(
+            seeds, counts)
+
+
+def sample_first(logits, temps, topks, seeds):
+    """First-token sampler over prefill's last-position logits
+    (draw counter 0 of each request's stream)."""
+    keys = _fold_keys(seeds, jnp.zeros(seeds.shape, jnp.int32))
+    return sample_slots(logits, temps, topks, keys)
+
+
+_sample_first_jit = jax.jit(sample_first)
+
+
+def _make_step(forwards):
+    cacheable = frozenset(i for i, u in enumerate(forwards)
+                          if hasattr(u, "init_cache"))
+
+    def step(params, toks, pos, temps, topks, seeds, counts, caches):
+        h = toks
+        out = dict(caches)
+        for i, u in enumerate(forwards):
+            if i in cacheable:
+                h, out[i] = u.apply_step_slots(params[i], h, pos,
+                                               caches[i])
+            elif hasattr(u, "apply_step_slots"):
+                h = u.apply_step_slots(params[i], h, pos)
+            else:
+                h = u.apply(params[i], h)
+        logits = h[:, 0].astype(jnp.float32)
+        keys = _fold_keys(seeds, counts)
+        return sample_slots(logits, temps, topks, keys), out
+    return step
+
+
+@functools.lru_cache(maxsize=16)
+def _step_cached(cache_key, closure):
+    return jax.jit(closure.fn)
+
+
+def clear_step_cache():
+    """Drop the compiled slot-step cache (entries pin the chain's
+    units — same lifetime note as ``generate.clear_decode_caches``)."""
+    _step_cached.cache_clear()
+
+
+def slot_decode_step(forwards, cache, toks, pos, temps, topks, seeds,
+                     counts):
+    """Run ONE decode step over every slot of ``cache``
+    (:class:`serving.kv_slots.SlotKVCache`, updated in place).
+
+    ``toks`` [S, 1] — each slot's last token; ``pos`` [S] — its
+    sequence index (length - 1); ``temps``/``topks`` [S] — per-slot
+    sampler settings; ``seeds``/``counts`` [S] — per-request PRNG
+    stream (seed and draw counter for THIS step's token).  Returns the
+    [S] next tokens (device array — callers ``numpy.asarray`` it)."""
+    from veles_tpu import dtypes
+    params = _device_params(forwards)
+    cache_key = (_arch_sig(forwards), cache.max_slots, cache.window,
+                 str(dtypes.compute_dtype()),
+                 str(dtypes.matmul_precision()))
+    fn = _step_cached(cache_key, _StepClosure(_make_step(forwards)))
+    nxt, cache.caches = fn(
+        params, jnp.asarray(toks, jnp.int32),
+        jnp.asarray(pos, jnp.int32),
+        jnp.asarray(temps, jnp.float32),
+        jnp.asarray(topks, jnp.int32),
+        jnp.asarray(seeds, jnp.uint32),
+        jnp.asarray(counts, jnp.int32), cache.caches)
+    return nxt
+
+
+def first_tokens(last_logits, temps, topks, seeds):
+    """Sample each admitted request's FIRST token from its prefill
+    logits ([k, vocab] f32) — draw 0 of its stream."""
+    return _sample_first_jit(
+        jnp.asarray(last_logits, jnp.float32),
+        jnp.asarray(temps, jnp.float32),
+        jnp.asarray(topks, jnp.int32),
+        jnp.asarray(seeds, jnp.uint32))

@@ -1,0 +1,73 @@
+"""Slot-based batched KV cache.
+
+One fixed ``[max_slots, window, d]`` K/V buffer pair per cacheable
+block, shared by every in-flight request: request ↔ slot row.  A slot
+row's lifecycle:
+
+- **alloc** — a request leaves the queue and claims a free slot;
+- **insert** — its batched prefill row (window-width, rows past the
+  prompt zeroed) REPLACES the slot row wholesale, so stale K/V from
+  the previous occupant can never leak into the newcomer's attention;
+- **decode** — the shared compiled step (:mod:`serving.engine`)
+  writes position ``len-1`` and attends over ``[0, len)`` per slot;
+- **release** — stop-token / step-limit frees the row for the next
+  request (no zeroing needed: insert overwrites).
+
+All methods must be called from ONE thread (the scheduler's decode
+loop) — the arrays are plain jax values, swapped functionally.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _insert_row(dst, src, slot):
+    # slot rides traced so every insert shares one executable
+    return jax.lax.dynamic_update_slice(
+        dst, src.astype(dst.dtype), (slot, jnp.int32(0), jnp.int32(0)))
+
+
+class SlotKVCache:
+    """Per-layer slot-major K/V buffers + free-slot bookkeeping."""
+
+    def __init__(self, forwards, max_slots, window):
+        from veles_tpu import dtypes
+        self.max_slots = int(max_slots)
+        self.window = int(window)
+        if self.max_slots < 1 or self.window < 2:
+            raise ValueError("need max_slots >= 1 and window >= 2")
+        self.caches = {
+            i: u.init_cache(self.max_slots, self.window,
+                            dtypes.compute_dtype())
+            for i, u in enumerate(forwards)
+            if hasattr(u, "init_cache")}
+        if not self.caches:
+            raise ValueError("chain has no cacheable blocks")
+        # lowest slot first — keeps occupancy dense and debuggable
+        self._free = list(range(self.max_slots - 1, -1, -1))
+
+    @property
+    def free_slots(self):
+        return len(self._free)
+
+    @property
+    def active_slots(self):
+        return self.max_slots - len(self._free)
+
+    def alloc(self):
+        """Claim a free slot index, or None when all are busy."""
+        return self._free.pop() if self._free else None
+
+    def release(self, slot):
+        self._free.append(int(slot))
+
+    def insert(self, slot, row_caches):
+        """Adopt a prefilled batch-1, window-width cache row
+        (:func:`serving.prefill.prefill` output) into ``slot`` —
+        replaces the whole row, clearing any previous occupant."""
+        s = jnp.int32(slot)
+        for i, layer in self.caches.items():
+            self.caches[i] = {
+                name: _insert_row(layer[name], row_caches[i][name], s)
+                for name in layer}

@@ -1,0 +1,124 @@
+"""Serving metrics — per-request TTFT / tokens-per-sec, queue and
+slot gauges, wired into the JSONL event sink (:mod:`veles_tpu.logger`)
+the L8 status plumbing already ships to the web dashboard.
+
+The scheduler calls the ``record_*`` hooks; :meth:`snapshot` returns
+the aggregate dict REST exposes at ``GET /serving/metrics`` (and
+``bench.py`` reads for the serving entries).
+"""
+
+import threading
+import time
+from collections import deque
+
+from veles_tpu.logger import events
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(len(sorted_vals) * q))
+    return sorted_vals[i]
+
+
+class ServingMetrics:
+    """Thread-safe serving counters + recent-window latency stats."""
+
+    def __init__(self, recent=256):
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0       # queue-depth cap (503)
+        self.expired = 0        # queue deadline (408)
+        self.tokens_generated = 0
+        self.slot_busy_steps = 0
+        self.slot_total_steps = 0
+        # recent windows for percentile / throughput reads
+        self._ttft_ms = deque(maxlen=recent)
+        self._queued_ms = deque(maxlen=recent)
+        self._completions = deque(maxlen=recent)  # (t, tokens)
+        self._t0 = time.monotonic()
+
+    # -- scheduler hooks ------------------------------------------------
+
+    def record_submit(self):
+        with self._lock:
+            self.submitted += 1
+
+    def record_reject(self, depth):
+        with self._lock:
+            self.rejected += 1
+        events.record("serving.reject", "single",
+                      cls="InferenceScheduler", queue_depth=depth)
+
+    def record_expire(self, queued_ms):
+        with self._lock:
+            self.expired += 1
+        events.record("serving.expire", "single",
+                      cls="InferenceScheduler",
+                      queued_ms=round(queued_ms, 3))
+
+    def record_first_token(self, ttft_ms, queued_ms):
+        with self._lock:
+            self._ttft_ms.append(float(ttft_ms))
+            self._queued_ms.append(float(queued_ms))
+
+    def record_step(self, active, slots):
+        with self._lock:
+            self.slot_busy_steps += int(active)
+            self.slot_total_steps += int(slots)
+
+    def record_complete(self, req_tokens, duration_s, ttft_ms,
+                        queued_ms):
+        now = time.monotonic()
+        with self._lock:
+            self.completed += 1
+            self.tokens_generated += int(req_tokens)
+            self._completions.append((now, int(req_tokens)))
+        events.record(
+            "serving.request", "single", cls="InferenceScheduler",
+            tokens=int(req_tokens), ttft_ms=round(ttft_ms, 3),
+            queued_ms=round(queued_ms, 3),
+            duration_ms=round(duration_s * 1e3, 3),
+            tokens_per_sec=round(req_tokens / duration_s, 1)
+            if duration_s > 0 else None)
+
+    # -- reads ----------------------------------------------------------
+
+    def recent_tokens_per_sec(self):
+        """Aggregate decode throughput over the recent completion
+        window (None before two completions)."""
+        with self._lock:
+            if len(self._completions) < 2:
+                return None
+            t_first = self._completions[0][0]
+            t_last = self._completions[-1][0]
+            toks = sum(n for _, n in self._completions)
+            if t_last <= t_first:
+                return None
+            return toks / (t_last - t_first)
+
+    def snapshot(self, queue_depth=0, active_slots=0, max_slots=0):
+        with self._lock:
+            ttft = sorted(self._ttft_ms)
+            queued = sorted(self._queued_ms)
+            occ = (self.slot_busy_steps / self.slot_total_steps
+                   if self.slot_total_steps else 0.0)
+            out = {
+                "requests_submitted": self.submitted,
+                "requests_completed": self.completed,
+                "requests_rejected": self.rejected,
+                "requests_expired": self.expired,
+                "tokens_generated": self.tokens_generated,
+                "queue_depth": int(queue_depth),
+                "active_slots": int(active_slots),
+                "max_slots": int(max_slots),
+                "slot_occupancy": round(occ, 4),
+                "ttft_ms_p50": _pct(ttft, 0.50),
+                "ttft_ms_p95": _pct(ttft, 0.95),
+                "queued_ms_p50": _pct(queued, 0.50),
+                "uptime_s": round(time.monotonic() - self._t0, 3),
+            }
+        tps = self.recent_tokens_per_sec()
+        out["tokens_per_sec_recent"] = round(tps, 1) if tps else None
+        return out

@@ -1,0 +1,149 @@
+"""Batched prompt prefill — one jitted pass over the whole prompt.
+
+The pre-serving decode stack consumed prompts one token at a time
+(``_make_pre_step`` scanning ``apply_step`` — O(prompt_len) compiled
+steps before the first generated token).  :func:`prefill` runs the
+chain ONCE over all prompt positions, writes every cacheable block's
+K/V rows in that single pass, and returns the logits at each row's
+last prompt position — everything a request needs to emit its first
+token and start single-token decoding.
+
+Ragged batches prefill together: ``prompt_lens`` rides the compiled
+pass as a traced argument (one executable serves any length mix at the
+same shapes), rows at or past a row's length are zeroed in the cache
+(exactly the rows a per-row sequential prefill would have left at the
+``init_cache`` zeros), and the last-position logits gather follows the
+per-row lengths.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.models.generate import (
+    _StepClosure, _arch_sig, _check_positions, _device_params,
+    kv_cache_eligible)
+
+
+def serving_supported(forwards):
+    """True when the chain can serve through the slot scheduler:
+    kv-cache eligible AND every cacheable block speaks the serving
+    step shapes (``apply_prefill`` + ``apply_step_slots``) AND every
+    other sequence-dependent unit has a per-slot step or is
+    position-wise."""
+    if not kv_cache_eligible(forwards):
+        return False
+    has_cache = False
+    for u in forwards:
+        if hasattr(u, "init_cache"):
+            has_cache = True
+            if not hasattr(u, "apply_prefill") \
+                    or not hasattr(u, "apply_step_slots"):
+                return False
+        elif hasattr(u, "apply_step") \
+                and not getattr(u, "DECODE_POINTWISE", False) \
+                and not hasattr(u, "apply_step_slots"):
+            return False
+    return has_cache
+
+
+def serving_window(forwards):
+    """The widest decode window the chain supports, from the smallest
+    learned positional table in the chain — None when no unit bounds
+    the sequence length (the scheduler then requires an explicit
+    window)."""
+    best = None
+    for u in forwards:
+        pos_table = getattr(u, "positions", None)
+        if pos_table is not None and hasattr(pos_table, "shape") \
+                and len(pos_table.shape) == 2:
+            n = int(pos_table.shape[0])
+            best = n if best is None else min(best, n)
+    return best
+
+
+def _make_prefill_fn(forwards, window):
+    cacheable = frozenset(i for i, u in enumerate(forwards)
+                          if hasattr(u, "init_cache"))
+
+    def run(params, prompt, lens):
+        from veles_tpu import dtypes
+        b = prompt.shape[0]
+        caches = {i: forwards[i].init_cache(b, window,
+                                            dtypes.compute_dtype())
+                  for i in cacheable}
+        h = prompt
+        for i, u in enumerate(forwards):
+            if i in cacheable:
+                h, caches[i] = u.apply_prefill(params[i], h,
+                                               caches[i], lens=lens)
+            else:
+                h = u.apply(params[i], h)
+        # h: [b, P, vocab]; each row's next token is predicted by the
+        # logits at ITS last prompt position
+        last = jnp.take_along_axis(
+            h, (lens - 1)[:, None, None], axis=1)[:, 0]
+        return caches, last.astype(jnp.float32)
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _prefill_cached(cache_key, closure):
+    return jax.jit(closure.fn)
+
+
+def clear_prefill_cache():
+    """Drop the compiled-prefill cache (same lifetime note as
+    ``generate.clear_decode_caches``: entries pin the chain's units)."""
+    _prefill_cached.cache_clear()
+
+
+def prefill(forwards, prompt, prompt_lens=None, window=None):
+    """Prefill ``prompt`` [batch, P] (int32, front-aligned rows) in
+    ONE compiled pass.
+
+    Returns ``(caches, last_logits)``: ``caches`` maps the chain index
+    of every cacheable block to its ``{"k", "v"}`` buffers —
+    [batch, window, d] with rows [0, lens[n]) holding the prompt's K/V
+    and every later row zero; ``last_logits`` [batch, vocab] (f32) are
+    the logits at each row's position ``lens[n] - 1``.
+
+    ``prompt_lens`` (optional [batch] ints) marks ragged rows (pad the
+    array arbitrarily past each length); it rides the executable as a
+    traced argument.  ``window`` (default P) sizes the returned cache
+    buffers — a request decoding into a slot cache prefills straight
+    at the slot width."""
+    from veles_tpu import dtypes
+    for u in forwards:
+        if hasattr(u, "init_cache") \
+                and not hasattr(u, "apply_prefill"):
+            raise ValueError(
+                "batched prefill: %s has no apply_prefill"
+                % type(u).__name__)
+    params = _device_params(forwards)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    b, p = prompt.shape
+    window = int(window or p)
+    if window < p:
+        raise ValueError("window %d < prompt width %d" % (window, p))
+    _check_positions(forwards, p)
+    if prompt_lens is None:
+        lens = jnp.full((b,), p, jnp.int32)
+    else:
+        lens_np = numpy.asarray(prompt_lens, numpy.int32)
+        if lens_np.shape != (b,):
+            raise ValueError("prompt_lens must be [batch] ints")
+        if lens_np.min() < 1 or lens_np.max() > p:
+            raise ValueError(
+                "prompt_lens must be in [1, %d] (the prompt width)"
+                % p)
+        lens = jnp.asarray(lens_np)
+    cache_key = (_arch_sig(forwards), b, p, window,
+                 str(dtypes.compute_dtype()),
+                 str(dtypes.matmul_precision()))
+    fn = _prefill_cached(cache_key,
+                         _StepClosure(_make_prefill_fn(forwards,
+                                                       window)))
+    return fn(params, prompt, lens)

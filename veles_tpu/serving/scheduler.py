@@ -1,0 +1,343 @@
+"""Continuous-batching inference scheduler.
+
+Requests queue on :meth:`InferenceScheduler.submit` (any thread) and
+are decoded by ONE background loop (all jax work — ``Array.devmem``
+uploads and the compile caches are not thread-safe against concurrent
+mutation, and a single loop is what lets every in-flight request share
+one compiled step):
+
+1. **admit** — while free slots exist, the oldest queued request
+   claims one: its prompt prefills in ONE compiled pass (bucketed
+   widths bound the executable count), the K/V row is inserted into
+   the slot cache, and its first token samples from the prefill
+   logits (that's the TTFT edge);
+2. **step** — all active slots advance one token through the shared
+   compiled step (:func:`serving.engine.slot_decode_step`) — requests
+   at different depths, temperatures and seeds genuinely interleave;
+3. **retire** — a slot that generated its stop token or hit its step
+   limit completes its future and frees at the token boundary, where
+   the next queued request joins.
+
+Admission control: a full queue raises :class:`QueueFullError` (HTTP
+503) at submit; a request still queued past its deadline fails with
+:class:`DeadlineExceededError` (HTTP 408).  Greedy requests keep
+exact determinism (each slot's attention sees only its own cache
+row); sampled requests are reproducible per seed — though the stream
+differs from the single-user ``generate()`` path's (one fold per
+generated token here vs one split per lockstep buffer position
+there).
+"""
+
+import collections
+import concurrent.futures
+import os
+import threading
+import time
+
+import numpy
+
+from veles_tpu.logger import Logger
+from veles_tpu.serving.engine import first_tokens, slot_decode_step
+from veles_tpu.serving.kv_slots import SlotKVCache
+from veles_tpu.serving.metrics import ServingMetrics
+from veles_tpu.serving.prefill import (
+    prefill, serving_supported, serving_window)
+
+
+class SchedulerError(Exception):
+    """Base serving failure (maps to HTTP 500)."""
+    http_status = 500
+
+
+class QueueFullError(SchedulerError):
+    """Admission control: queue-depth cap hit (HTTP 503)."""
+    http_status = 503
+
+
+class DeadlineExceededError(SchedulerError):
+    """Admission control: queued past the deadline (HTTP 408)."""
+    http_status = 408
+
+
+def _bucket(n, floor, cap):
+    """Pad prompt widths to power-of-two buckets so the compiled
+    prefill count stays O(log window) across arbitrary clients."""
+    b = max(int(floor), 1)
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class _Request(object):
+    __slots__ = ("prompt", "steps", "temperature", "top_k",
+                 "stop_token", "seed", "deadline", "future", "slot",
+                 "generated", "t_submit", "t_admit", "t_first")
+
+    def __init__(self, prompt, steps, temperature, top_k, stop_token,
+                 seed, deadline):
+        self.prompt = prompt
+        self.steps = steps
+        self.temperature = temperature
+        self.top_k = top_k
+        self.stop_token = stop_token
+        self.seed = seed
+        self.deadline = deadline
+        self.future = concurrent.futures.Future()
+        self.slot = None
+        self.generated = []
+        self.t_submit = time.monotonic()
+        self.t_admit = None
+        self.t_first = None
+
+
+class InferenceScheduler(Logger):
+    """Continuous-batching decode service over a forward chain.
+
+    ``max_slots`` — concurrent requests decoding per step;
+    ``window`` — slot cache width (default: the chain's positional
+    table; a request needs ``prompt_len + steps <= window``);
+    ``max_queue`` — waiting-request cap beyond the slots (503 above);
+    ``queue_timeout`` — default admission deadline in seconds (408
+    for requests still queued past it);
+    ``prefill_bucket`` — smallest compiled prefill width.
+    """
+
+    def __init__(self, forwards, max_slots=4, window=None,
+                 max_queue=32, queue_timeout=30.0, prefill_bucket=8):
+        super(InferenceScheduler, self).__init__()
+        if not serving_supported(forwards):
+            raise ValueError(
+                "chain cannot serve through the slot scheduler (needs "
+                "causal cacheable blocks with apply_prefill/"
+                "apply_step_slots; see serving_supported)")
+        window = window or serving_window(forwards)
+        if not window or int(window) < 2:
+            raise ValueError(
+                "no usable decode window: pass window= (the chain has "
+                "no learned positional table to derive it from)")
+        self.forwards = forwards
+        self.max_slots = int(max_slots)
+        self.window = int(window)
+        self.max_queue = int(max_queue)
+        self.queue_timeout = float(queue_timeout)
+        self.prefill_bucket = int(prefill_bucket)
+        self.stats = ServingMetrics()
+        self._queue = collections.deque()
+        self._active = {}            # slot -> _Request
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._closed = False
+        self._thread = None
+
+    # -- client side ----------------------------------------------------
+
+    def start(self):
+        """Warm the device params (single-threaded — Array.devmem's
+        lazy upload is not re-entrant) and start the decode loop."""
+        if self._thread is not None:
+            return self
+        for u in self.forwards:
+            for arr in u.param_arrays().values():
+                arr.devmem
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="serving-scheduler")
+        self._thread.start()
+        return self
+
+    def submit(self, prompt, steps, temperature=0.0, top_k=0,
+               seed=None, stop_token=None, timeout=None):
+        """Queue one sequence for decoding; returns a Future whose
+        result is the full token list (prompt + generated, ending at
+        the first generated stop token if one fired).
+
+        Raises ``ValueError`` on malformed requests (client errors),
+        :class:`QueueFullError` when admission control rejects."""
+        prompt = [int(t) for t in prompt]
+        steps = int(steps)
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        if steps < 1:
+            raise ValueError("steps must be >= 1")
+        if len(prompt) + steps > self.window:
+            raise ValueError(
+                "prompt_len + steps = %d exceeds the serving window "
+                "(%d)" % (len(prompt) + steps, self.window))
+        temperature = float(temperature or 0.0)
+        top_k = int(top_k or 0)
+        if top_k and not temperature:
+            raise ValueError(
+                "top_k only applies to sampling — set temperature > 0")
+        if seed is None:
+            # unpinned sampling must draw fresh tokens per request
+            seed = int.from_bytes(os.urandom(4), "little")
+        req = _Request(
+            prompt, steps, temperature, top_k,
+            int(stop_token) if stop_token is not None else None,
+            int(seed) & 0xFFFFFFFF,
+            time.monotonic() + float(timeout or self.queue_timeout))
+        with self._wake:
+            if self._closed:
+                raise SchedulerError("scheduler is closed")
+            if len(self._queue) >= self.max_queue:
+                self.stats.record_reject(len(self._queue))
+                raise QueueFullError(
+                    "serving queue full (%d waiting)"
+                    % len(self._queue))
+            self.stats.record_submit()
+            self._queue.append(req)
+            self._wake.notify()
+        return req.future
+
+    def metrics(self):
+        with self._lock:
+            depth, active = len(self._queue), len(self._active)
+        snap = self.stats.snapshot(queue_depth=depth,
+                                   active_slots=active,
+                                   max_slots=self.max_slots)
+        snap["window"] = self.window
+        return snap
+
+    def close(self):
+        """Stop the loop and fail every unfinished request."""
+        with self._wake:
+            if self._closed:
+                return
+            self._closed = True
+            self._wake.notify()
+        if self._thread is not None:
+            self._thread.join(30)
+        err = SchedulerError("scheduler closed")
+        with self._lock:
+            pending = list(self._queue) + list(self._active.values())
+            self._queue.clear()
+            self._active.clear()
+        for req in pending:
+            if not req.future.done():
+                req.future.set_exception(err)
+
+    # -- decode loop ----------------------------------------------------
+
+    def _loop(self):
+        try:
+            cache = SlotKVCache(self.forwards, self.max_slots,
+                                self.window)
+        except Exception as e:  # surface init failures to clients
+            with self._wake:
+                self._closed = True
+                pending = list(self._queue)
+                self._queue.clear()
+            for req in pending:
+                req.future.set_exception(SchedulerError(repr(e)))
+            raise
+        while True:
+            with self._wake:
+                while not self._closed and not self._queue \
+                        and not self._active:
+                    self._wake.wait()
+                if self._closed:
+                    return
+                self._expire_locked()
+                admits = []
+                while self._queue and cache.free_slots:
+                    req = self._queue.popleft()
+                    req.slot = cache.alloc()
+                    self._active[req.slot] = req
+                    admits.append(req)
+            # jax work OUTSIDE the lock: submit() must never block on
+            # a device step
+            for req in admits:
+                self._admit(req, cache)
+            if self._active:
+                self._step(cache)
+
+    def _expire_locked(self):
+        now = time.monotonic()
+        kept = collections.deque()
+        while self._queue:
+            req = self._queue.popleft()
+            if req.deadline is not None and now > req.deadline:
+                queued_ms = (now - req.t_submit) * 1e3
+                self.stats.record_expire(queued_ms)
+                req.future.set_exception(DeadlineExceededError(
+                    "queued %.0f ms without a free slot" % queued_ms))
+            else:
+                kept.append(req)
+        self._queue = kept
+
+    def _admit(self, req, cache):
+        """Prefill one joining request into its slot and emit its
+        first token (the TTFT edge)."""
+        req.t_admit = time.monotonic()
+        p_len = len(req.prompt)
+        width = _bucket(p_len, self.prefill_bucket, self.window)
+        padded = numpy.zeros((1, width), numpy.int32)
+        padded[0, :p_len] = req.prompt
+        try:
+            row_caches, last = prefill(
+                self.forwards, padded, prompt_lens=[p_len],
+                window=self.window)
+        except Exception as e:
+            self._retire(req, cache, error=e)
+            return
+        cache.insert(req.slot, row_caches)
+        tok = int(numpy.asarray(first_tokens(
+            last, [req.temperature], [req.top_k], [req.seed]))[0])
+        req.generated.append(tok)
+        req.t_first = time.monotonic()
+        self.stats.record_first_token(
+            (req.t_first - req.t_submit) * 1e3,
+            (req.t_admit - req.t_submit) * 1e3)
+        self._maybe_finish(req, cache)
+
+    def _step(self, cache):
+        """Advance every active slot one token through the shared
+        compiled step, then retire finished slots at the boundary."""
+        s = self.max_slots
+        toks = numpy.zeros((s, 1), numpy.int32)
+        pos = numpy.zeros((s,), numpy.int32)
+        temps = numpy.zeros((s,), numpy.float32)
+        topks = numpy.zeros((s,), numpy.int32)
+        seeds = numpy.zeros((s,), numpy.uint32)
+        counts = numpy.zeros((s,), numpy.int32)
+        with self._lock:
+            active = dict(self._active)
+        if not active:
+            return
+        for slot, req in active.items():
+            toks[slot, 0] = req.generated[-1]
+            pos[slot] = len(req.prompt) + len(req.generated) - 1
+            temps[slot] = req.temperature
+            topks[slot] = req.top_k
+            seeds[slot] = req.seed
+            counts[slot] = len(req.generated)
+        nxt = numpy.asarray(slot_decode_step(
+            self.forwards, cache, toks, pos, temps, topks, seeds,
+            counts))
+        self.stats.record_step(len(active), s)
+        for slot, req in active.items():
+            req.generated.append(int(nxt[slot]))
+            self._maybe_finish(req, cache)
+
+    def _maybe_finish(self, req, cache, error=None):
+        done = error is not None \
+            or len(req.generated) >= req.steps \
+            or (req.stop_token is not None
+                and req.generated[-1] == req.stop_token)
+        if done:
+            self._retire(req, cache, error=error)
+
+    def _retire(self, req, cache, error=None):
+        with self._lock:
+            self._active.pop(req.slot, None)
+        cache.release(req.slot)
+        if error is not None:
+            req.future.set_exception(
+                error if isinstance(error, SchedulerError)
+                else SchedulerError(repr(error)))
+            return
+        now = time.monotonic()
+        self.stats.record_complete(
+            len(req.generated), now - req.t_submit,
+            (req.t_first - req.t_submit) * 1e3,
+            (req.t_admit - req.t_submit) * 1e3)
+        req.future.set_result(list(req.prompt) + req.generated)
