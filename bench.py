@@ -567,7 +567,10 @@ def bench_allreduce(short=10, long=510, dispatches=32):
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5 keeps it in experimental
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     devices = jax.devices()
@@ -889,6 +892,19 @@ def main():
     record.update(allreduce)
     if dp:
         record.update(dp)
+    # observability riders (veles_tpu/telemetry/): where the XLA
+    # compile time went (per jitted entry point) and the heaviest
+    # units' run-time digests — the audit trail for "was this run
+    # compile-bound or stall-bound", free since the registry was
+    # populated by the benches above anyway
+    from veles_tpu.telemetry import compile_summary, \
+        unit_timing_summary
+    compile_rec = compile_summary()
+    record["compile"] = compile_rec
+    record["compile_seconds_total"] = \
+        compile_rec["total"]["compile_seconds"]
+    record["compiles_total"] = compile_rec["total"]["compiles"]
+    record["unit_seconds_top"] = unit_timing_summary(top=10)
     # full record to disk (auditable windows/configs/methodology);
     # compact primary-metric summary as the LAST stdout line — the
     # driver's 2 kB tail window must never again truncate entries
@@ -905,7 +921,8 @@ def main():
         "serving_ttft_ms", "serving_concurrent_tokens_per_sec",
         "serving_slot_occupancy", "allreduce_p50_us",
         "allreduce_substrate", "allreduce_quality",
-        "dp_samples_per_sec",
+        "dp_samples_per_sec", "compile_seconds_total",
+        "compiles_total",
         "lm_error", "decode_error", "serving_error")
     compact = {k: record[k] for k in compact_keys if k in record}
     compact["full_record"] = "BENCH.json"

@@ -226,6 +226,9 @@ class Main:
         load_site_configs()
         if self.args.timings:
             root.common.timings = True
+        if self.args.events_log:
+            from veles_tpu.logger import events
+            events.open(self.args.events_log)
         if self.args.config:
             apply_config_file(self.args.config)
         for snippet in self.args.config_override:

@@ -141,7 +141,10 @@ class AcceleratedUnit(Unit):
             def stepper(donated, held):
                 return self.step(**donated, **held)
 
-            self._jit_step_ = jax.jit(stepper, donate_argnums=(0,))
+            from veles_tpu.telemetry import track_jit
+            self._jit_step_ = track_jit(
+                "accel.%s" % type(self).__name__,
+                jax.jit(stepper, donate_argnums=(0,)))
         tensors = self._gather()
         wset = set(self.writes)
         donated = {a: t for a, t in tensors.items() if a in wset}
@@ -233,7 +236,10 @@ class FusedSegment:
             results = self._fused(donated_vals, held_vals)
         else:
             if self._jit is None:
-                self._jit = jax.jit(self._fused, donate_argnums=(0,))
+                from veles_tpu.telemetry import track_jit
+                self._jit = track_jit(
+                    "fused:%s" % self.units[0].name,
+                    jax.jit(self._fused, donate_argnums=(0,)))
             results = self._jit(donated_vals, held_vals)
         for k, v in zip(outputs, results):
             self._arrays[k].devmem = v

@@ -103,6 +103,10 @@ def build_parser():
                    help="capture a jax.profiler trace of the run into "
                         "DIR (view with tensorboard / xprof); also "
                         "annotates each unit run")
+    p.add_argument("--events-log", default=None, metavar="FILE",
+                   help="record the span/event stream to a JSONL FILE "
+                        "(convert for Perfetto with python -m "
+                        "veles_tpu.telemetry.trace_export)")
     for fn in EXTRA_PARSERS:
         fn(p)
     return p

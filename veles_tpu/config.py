@@ -149,7 +149,10 @@ root.common.update({
         "backend": os.environ.get("VELES_TPU_BACKEND", "auto"),
     },
     "timings": False,
-    "trace": {"run": False},
+    "trace": {"run": False, "profiler_dir": None},
+    # host-side instrumentation (per-unit spans + metric histograms,
+    # veles_tpu/telemetry/) — on by default, overhead-gated in CI
+    "telemetry": {"enabled": True},
     "web": {"host": "localhost", "port": 8090},
 })
 root.common.protect("dirs")

@@ -76,22 +76,56 @@ class EventSink:
         self.path = None
         self._lock = threading.Lock()
         self._file = None
+        self._warned = False
 
     def open(self, path):
+        # open the NEW file first: if it raises, the previous sink
+        # stays intact (and its handle doesn't leak unclosed)
+        f = open(path, "a")
         with self._lock:
-            self.path = path
             if self._file:
-                self._file.close()
-            self._file = open(path, "a")
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+            self._file = f
+            self.path = path
+            self._warned = False
+
+    def close(self):
+        with self._lock:
+            if self._file:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
 
     def record(self, name, kind, **attrs):
         ev = {"name": name, "kind": kind, "time": time.time(),
-              "pid": os.getpid(), **attrs}
+              "pid": os.getpid(), "tid": threading.get_ident() & 0xFFFF,
+              **attrs}
         with self._lock:
             self.ring.append(ev)
             if self._file:
-                self._file.write(json.dumps(ev, default=str) + "\n")
-                self._file.flush()
+                try:
+                    self._file.write(json.dumps(ev, default=str) + "\n")
+                    self._file.flush()
+                except (OSError, ValueError):
+                    # a failed/closed file must not throw from hot
+                    # paths: drop the file sink (ring keeps recording)
+                    # with a one-time warning
+                    try:
+                        self._file.close()
+                    except Exception:
+                        pass
+                    self._file = None
+                    if not self._warned:
+                        self._warned = True
+                        logging.getLogger("EventSink").warning(
+                            "span file sink %s failed — file recording "
+                            "disabled (in-memory ring still active)",
+                            self.path)
         return ev
 
 
@@ -152,12 +186,14 @@ class _TimedEvent:
 
 
 def timed(fn):
-    """Decorator recording a single span with duration for each call."""
+    """Decorator recording a single span with duration for each call.
+    Works on free functions and bound methods alike (the span name is
+    the qualified name either way)."""
     @functools.wraps(fn)
-    def wrapper(self, *args, **kwargs):
+    def wrapper(*args, **kwargs):
         t0 = time.time()
         try:
-            return fn(self, *args, **kwargs)
+            return fn(*args, **kwargs)
         finally:
             events.record(fn.__qualname__, "single",
                           duration=time.time() - t0)

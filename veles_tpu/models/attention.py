@@ -24,7 +24,10 @@ def _ring_mha(mesh, q, k, v, causal):
     with seq over ``sp`` (and batch over dp/fsdp when present); K/V
     rotate around the ring so each chip only ever holds seq/sp of
     them."""
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5 keeps it in experimental
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from veles_tpu.ops.attention import ring_attention

@@ -457,16 +457,19 @@ class GradientDescent(AcceleratedUnit):
         return train_step
 
     def _build_train_step(self):
+        from veles_tpu.telemetry import track_jit
         train_step = self._make_minibatch_step()
         if self.mesh is None:
-            return jax.jit(train_step, donate_argnums=(0, 1, 2))
+            return track_jit(
+                "trainer.minibatch_step",
+                jax.jit(train_step, donate_argnums=(0, 1, 2)))
         params_sh, opt_sh, x_sh, tgt_sh, rep = self._ensure_shardings()
-        return jax.jit(
+        return track_jit("trainer.minibatch_step", jax.jit(
             train_step,
             in_shardings=(params_sh, opt_sh, rep, x_sh, tgt_sh,
                           rep, rep, rep, rep, rep),
             out_shardings=(params_sh, opt_sh, rep, rep, rep),
-            donate_argnums=(0, 1, 2))
+            donate_argnums=(0, 1, 2)))
 
     def _build_span_step(self):
         """One jitted dispatch per class span: ``lax.scan`` over the
@@ -492,20 +495,23 @@ class GradientDescent(AcceleratedUnit):
                 body, (params, opt_state, acc, jnp.int32(0)), (idx, sizes))
             return params, opt_state, acc, losses[-1], n_errs[-1]
 
+        from veles_tpu.telemetry import track_jit
         if self.mesh is None:
-            return jax.jit(span_step, donate_argnums=(0, 1, 2))
+            return track_jit(
+                "trainer.span_step",
+                jax.jit(span_step, donate_argnums=(0, 1, 2)))
         from jax.sharding import NamedSharding, PartitionSpec as P
         params_sh, opt_sh, x_sh, tgt_sh, rep = self._ensure_shardings()
         batch_axes = x_sh.spec[0] if len(x_sh.spec) else None
         idx_sh = NamedSharding(self.mesh, P(None, batch_axes))
         self._idx_sharding_ = idx_sh  # _run_span pre-places host indices
         sizes_sh = rep
-        return jax.jit(
+        return track_jit("trainer.span_step", jax.jit(
             span_step,
             in_shardings=(params_sh, opt_sh, rep, rep, rep, idx_sh,
                           sizes_sh, rep, rep, rep, rep),
             out_shardings=(params_sh, opt_sh, rep, rep, rep),
-            donate_argnums=(0, 1, 2))
+            donate_argnums=(0, 1, 2)))
 
     def _ensure_shardings(self):
         """NamedShardings over self.mesh — XLA then inserts the gradient

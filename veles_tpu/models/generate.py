@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy
 
+from veles_tpu.telemetry import track_jit
+
 
 def _chain_logits(forwards, params, tokens):
     h = tokens
@@ -463,7 +465,7 @@ def _decode_cached(cache_key, step_closure):
             (buf, jnp.int32(p_len), key, stop), None, length=steps)
         return buf
 
-    return decode
+    return track_jit("generate.decode", decode)
 
 
 @functools.lru_cache(maxsize=16)
@@ -488,7 +490,7 @@ def _decode_cached_kv(cache_key, step_closure):
             length=steps)
         return buf
 
-    return decode
+    return track_jit("generate.decode_kv", decode)
 
 
 @functools.lru_cache(maxsize=16)
@@ -504,7 +506,7 @@ def _decode_cached_varlen(cache_key, step_closure):
             length=total - vmin)
         return buf
 
-    return decode
+    return track_jit("generate.decode_varlen", decode)
 
 
 @functools.lru_cache(maxsize=16)
@@ -533,7 +535,7 @@ def _decode_cached_beam(cache_key, step_closure):
             length=steps)
         return bufs, scores
 
-    return decode
+    return track_jit("generate.decode_beam", decode)
 
 
 @functools.lru_cache(maxsize=16)
@@ -548,4 +550,4 @@ def _decode_cached_kv_varlen(cache_key, step_closure):
             length=total - 1)
         return buf
 
-    return decode
+    return track_jit("generate.decode_kv_varlen", decode)

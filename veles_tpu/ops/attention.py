@@ -193,7 +193,10 @@ def ring_attention_sharded(mesh, q, k, v, axis="sp", causal=False):
     run :func:`ring_attention` under shard_map.  q/k/v: [seq, heads,
     dim] global arrays."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5 keeps it in experimental
+        from jax.experimental.shard_map import shard_map
 
     spec = P(axis, None, None)
     fn = shard_map(

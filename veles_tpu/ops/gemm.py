@@ -111,7 +111,15 @@ def pallas_matmul(a, b, block_m=256, block_n=256, block_k=512,
         out_specs=pl.BlockSpec((block_m, block_n), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        # jax >= 0.5 renamed TPUCompilerParams -> CompilerParams
+        compiler_params=getattr(
+            pltpu, "CompilerParams",
+            getattr(pltpu, "TPUCompilerParams", None))(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(a, b)
+
+
+from veles_tpu.telemetry import track_jit  # noqa: E402 (cycle-free: telemetry only needs logger)
+
+pallas_matmul = track_jit("ops.pallas_matmul", pallas_matmul)

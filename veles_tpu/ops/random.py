@@ -22,6 +22,7 @@ import numpy
 from veles_tpu import prng
 from veles_tpu.accelerated_units import AcceleratedUnit
 from veles_tpu.memory import Array
+from veles_tpu.telemetry import track_jit as _track_jit
 
 
 def _pallas_uniform_kernel(seed_ref, out_ref):
@@ -47,6 +48,9 @@ def pallas_uniform(seed, shape):
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(shape, jnp.float32),
     )(jnp.asarray([seed], jnp.int32))
+
+
+pallas_uniform = _track_jit("ops.pallas_uniform", pallas_uniform)
 
 
 def uniform(key_or_seed, shape, use_pallas=None):

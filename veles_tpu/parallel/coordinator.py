@@ -41,6 +41,29 @@ _HDR = struct.Struct("!IB")  # length, flags
 _FLAG_GZIP = 1
 
 
+def _coord_metrics():
+    """Fleet-level series in the shared registry (created lazily —
+    importing the coordinator must not populate /metrics)."""
+    from veles_tpu.telemetry import metrics
+    return {
+        "workers": metrics.gauge(
+            "veles_coordinator_workers",
+            "workers currently registered with the coordinator"),
+        "dispatched": metrics.counter(
+            "veles_coordinator_jobs_dispatched_total",
+            "jobs handed to workers"),
+        "completed": metrics.counter(
+            "veles_coordinator_jobs_completed_total",
+            "job updates applied"),
+        "dropped": metrics.counter(
+            "veles_coordinator_workers_dropped_total",
+            "worker sessions dropped (timeouts, disconnects, evictions)"),
+        "job_seconds": metrics.histogram(
+            "veles_coordinator_job_seconds",
+            "job round-trip time (dispatch to update)"),
+    }
+
+
 async def send_frame(writer, obj, compress=True):
     blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     flags = 0
@@ -106,6 +129,7 @@ class Coordinator(Logger):
         self._server = None
         self._done = asyncio.Event()
         self._stopping = False
+        self._metrics = _coord_metrics()
 
     @property
     def strikes(self):
@@ -214,6 +238,7 @@ class Coordinator(Logger):
             except Exception:
                 pass
         self.workers[wid] = worker
+        self._metrics["workers"].set(len(self.workers))
         self.info("worker %s joined from %s (power %.1f)", wid, peer,
                   worker.power)
         await send_frame(writer, {"id": wid})
@@ -272,6 +297,7 @@ class Coordinator(Logger):
                     continue
                 worker.state = "WORK"
                 worker.job_started = time.time()
+                self._metrics["dispatched"].inc()
                 await send_frame(worker.writer, {"cmd": "job",
                                                  "data": job})
             elif cmd == "update":
@@ -291,6 +317,8 @@ class Coordinator(Logger):
                     return
                 dt = time.time() - (worker.job_started or time.time())
                 self.job_durations.append(dt)
+                self._metrics["completed"].inc()
+                self._metrics["job_seconds"].observe(dt)
                 worker.state = "WAIT"
                 worker.jobs_done += 1
                 # a completed job proves the worker is healthy — clear
@@ -347,6 +375,8 @@ class Coordinator(Logger):
             # never unregister a registration we don't own
             return
         del self.workers[worker.id]
+        self._metrics["dropped"].inc()
+        self._metrics["workers"].set(len(self.workers))
         if requeue and not self._done.is_set():
             # the workflow refiles the worker's in-flight minibatches
             # (ref: loader/base.py:679-687 failed_minibatches); the

@@ -218,6 +218,19 @@ class RESTfulAPI(Unit):
                         return
                     self._reply_json(api.scheduler_.metrics())
                     return
+                if self.path.rstrip("/").split("?")[0] == "/metrics":
+                    # Prometheus text exposition of the process-wide
+                    # registry (serving, per-unit, compile series)
+                    from veles_tpu.telemetry import metrics as registry
+                    blob = registry.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
+                    return
                 self.send_error(404)
 
             def _reply_json(self, obj):

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from veles_tpu.models.generate import (
     _StepClosure, _arch_sig, _device_params)
+from veles_tpu.telemetry import track_jit
 
 
 def sample_slots(logits, temps, topks, keys):
@@ -53,7 +54,8 @@ def sample_first(logits, temps, topks, seeds):
     return sample_slots(logits, temps, topks, keys)
 
 
-_sample_first_jit = jax.jit(sample_first)
+_sample_first_jit = track_jit("serving.sample_first",
+                              jax.jit(sample_first))
 
 
 def _make_step(forwards):
@@ -79,7 +81,7 @@ def _make_step(forwards):
 
 @functools.lru_cache(maxsize=16)
 def _step_cached(cache_key, closure):
-    return jax.jit(closure.fn)
+    return track_jit("serving.slot_step", jax.jit(closure.fn))
 
 
 def clear_step_cache():

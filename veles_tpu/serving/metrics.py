@@ -1,10 +1,13 @@
 """Serving metrics — per-request TTFT / tokens-per-sec, queue and
-slot gauges, wired into the JSONL event sink (:mod:`veles_tpu.logger`)
-the L8 status plumbing already ships to the web dashboard.
+slot gauges, built on the shared :mod:`veles_tpu.telemetry` types and
+wired into the JSONL event sink (:mod:`veles_tpu.logger`).
 
-The scheduler calls the ``record_*`` hooks; :meth:`snapshot` returns
-the aggregate dict REST exposes at ``GET /serving/metrics`` (and
-``bench.py`` reads for the serving entries).
+Each :class:`ServingMetrics` instance keeps its OWN counters and
+latency histograms (so :meth:`snapshot` — the ``GET /serving/metrics``
+JSON and the bench reader — reports this scheduler's lifetime), and
+every observation is mirrored into the process-wide registry
+(:data:`veles_tpu.telemetry.metrics`), where Prometheus scrapes it at
+``GET /metrics`` as the cumulative ``veles_serving_*`` series.
 """
 
 import threading
@@ -12,13 +15,48 @@ import time
 from collections import deque
 
 from veles_tpu.logger import events
+from veles_tpu.telemetry import MS_BUCKETS, Histogram, metrics, \
+    nearest_rank
 
 
 def _pct(sorted_vals, q):
-    if not sorted_vals:
-        return None
-    i = min(len(sorted_vals) - 1, int(len(sorted_vals) * q))
-    return sorted_vals[i]
+    """Nearest-rank percentile on a sorted window (kept as the module
+    helper the snapshot math uses; ``q=0.5`` over 2 elements is the
+    LOWER value, ``q=0.99`` never IndexErrors on tiny windows)."""
+    return nearest_rank(sorted_vals, q)
+
+
+def _registry_series():
+    return {
+        "submitted": metrics.counter(
+            "veles_serving_requests_submitted_total",
+            "requests accepted into the serving queue"),
+        "completed": metrics.counter(
+            "veles_serving_requests_completed_total",
+            "requests that finished decoding"),
+        "rejected": metrics.counter(
+            "veles_serving_requests_rejected_total",
+            "requests refused at admission (queue-depth cap, HTTP 503)"),
+        "expired": metrics.counter(
+            "veles_serving_requests_expired_total",
+            "requests that aged out while queued (HTTP 408)"),
+        "tokens": metrics.counter(
+            "veles_serving_tokens_generated_total",
+            "tokens generated across all requests"),
+        "busy_steps": metrics.counter(
+            "veles_serving_slot_busy_steps_total",
+            "slot-steps spent decoding an active request"),
+        "total_steps": metrics.counter(
+            "veles_serving_slot_steps_total",
+            "slot-steps elapsed (busy + idle slots)"),
+        "ttft_ms": metrics.histogram(
+            "veles_serving_ttft_ms",
+            "submit-to-first-token latency (ms)", buckets=MS_BUCKETS),
+        "queued_ms": metrics.histogram(
+            "veles_serving_queued_ms",
+            "submit-to-slot-admission latency (ms)",
+            buckets=MS_BUCKETS),
+    }
 
 
 class ServingMetrics:
@@ -33,40 +71,50 @@ class ServingMetrics:
         self.tokens_generated = 0
         self.slot_busy_steps = 0
         self.slot_total_steps = 0
-        # recent windows for percentile / throughput reads
-        self._ttft_ms = deque(maxlen=recent)
-        self._queued_ms = deque(maxlen=recent)
+        # instance-lifetime latency histograms (the shared telemetry
+        # type: bounded reservoir + bucket counts), window = `recent`
+        self._ttft = Histogram("ttft_ms", buckets=MS_BUCKETS,
+                               reservoir=recent)
+        self._queued = Histogram("queued_ms", buckets=MS_BUCKETS,
+                                 reservoir=recent)
         self._completions = deque(maxlen=recent)  # (t, tokens)
         self._t0 = time.monotonic()
+        self._global = _registry_series()
 
     # -- scheduler hooks ------------------------------------------------
 
     def record_submit(self):
         with self._lock:
             self.submitted += 1
+        self._global["submitted"].inc()
 
     def record_reject(self, depth):
         with self._lock:
             self.rejected += 1
+        self._global["rejected"].inc()
         events.record("serving.reject", "single",
                       cls="InferenceScheduler", queue_depth=depth)
 
     def record_expire(self, queued_ms):
         with self._lock:
             self.expired += 1
+        self._global["expired"].inc()
         events.record("serving.expire", "single",
                       cls="InferenceScheduler",
                       queued_ms=round(queued_ms, 3))
 
     def record_first_token(self, ttft_ms, queued_ms):
-        with self._lock:
-            self._ttft_ms.append(float(ttft_ms))
-            self._queued_ms.append(float(queued_ms))
+        self._ttft.observe(ttft_ms)
+        self._queued.observe(queued_ms)
+        self._global["ttft_ms"].observe(ttft_ms)
+        self._global["queued_ms"].observe(queued_ms)
 
     def record_step(self, active, slots):
         with self._lock:
             self.slot_busy_steps += int(active)
             self.slot_total_steps += int(slots)
+        self._global["busy_steps"].inc(int(active))
+        self._global["total_steps"].inc(int(slots))
 
     def record_complete(self, req_tokens, duration_s, ttft_ms,
                         queued_ms):
@@ -75,6 +123,8 @@ class ServingMetrics:
             self.completed += 1
             self.tokens_generated += int(req_tokens)
             self._completions.append((now, int(req_tokens)))
+        self._global["completed"].inc()
+        self._global["tokens"].inc(int(req_tokens))
         events.record(
             "serving.request", "single", cls="InferenceScheduler",
             tokens=int(req_tokens), ttft_ms=round(ttft_ms, 3),
@@ -100,8 +150,6 @@ class ServingMetrics:
 
     def snapshot(self, queue_depth=0, active_slots=0, max_slots=0):
         with self._lock:
-            ttft = sorted(self._ttft_ms)
-            queued = sorted(self._queued_ms)
             occ = (self.slot_busy_steps / self.slot_total_steps
                    if self.slot_total_steps else 0.0)
             out = {
@@ -114,11 +162,12 @@ class ServingMetrics:
                 "active_slots": int(active_slots),
                 "max_slots": int(max_slots),
                 "slot_occupancy": round(occ, 4),
-                "ttft_ms_p50": _pct(ttft, 0.50),
-                "ttft_ms_p95": _pct(ttft, 0.95),
-                "queued_ms_p50": _pct(queued, 0.50),
                 "uptime_s": round(time.monotonic() - self._t0, 3),
             }
+        out["ttft_ms_p50"] = self._ttft.percentile(0.50)
+        out["ttft_ms_p95"] = self._ttft.percentile(0.95)
+        out["ttft_ms_p99"] = self._ttft.percentile(0.99)
+        out["queued_ms_p50"] = self._queued.percentile(0.50)
         tps = self.recent_tokens_per_sec()
         out["tokens_per_sec_recent"] = round(tps, 1) if tps else None
         return out

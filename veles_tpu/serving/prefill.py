@@ -25,6 +25,7 @@ import numpy
 from veles_tpu.models.generate import (
     _StepClosure, _arch_sig, _check_positions, _device_params,
     kv_cache_eligible)
+from veles_tpu.telemetry import track_jit
 
 
 def serving_supported(forwards):
@@ -91,7 +92,7 @@ def _make_prefill_fn(forwards, window):
 
 @functools.lru_cache(maxsize=32)
 def _prefill_cached(cache_key, closure):
-    return jax.jit(closure.fn)
+    return track_jit("serving.prefill", jax.jit(closure.fn))
 
 
 def clear_prefill_cache():

@@ -1,0 +1,46 @@
+"""veles_tpu.telemetry — unified observability layer.
+
+One process-wide :data:`metrics` registry (counters / gauges /
+histograms with bounded reservoirs, labeled series), a span pipeline
+over the JSONL :data:`veles_tpu.logger.events` sink, JIT compile
+tracking, and two export surfaces:
+
+- Prometheus text exposition at ``GET /metrics`` (served by both
+  :mod:`veles_tpu.web_status` and :mod:`veles_tpu.restful_api`);
+- Chrome ``trace_event`` JSON from a recorded span log
+  (``python -m veles_tpu.telemetry.trace_export run.jsonl trace.json``).
+
+See ``docs/observability.md`` for the metric names and span schema.
+"""
+
+from veles_tpu.telemetry.compile_tracker import (  # noqa: F401
+    compile_summary, maybe_profiler_trace, track_jit)
+from veles_tpu.telemetry.registry import (  # noqa: F401
+    Counter, DEFAULT_BUCKETS, Gauge, Histogram, MS_BUCKETS,
+    MetricsRegistry, metrics, nearest_rank)
+from veles_tpu.telemetry.spans import (  # noqa: F401
+    iter_spans, next_span_id, span)
+
+
+def enabled():
+    """Whether host-side instrumentation (per-unit spans + histograms)
+    is on — ``root.common.telemetry.enabled``, default True.  The
+    metrics registry itself is always live; this gates only the
+    per-run hot-path hooks."""
+    from veles_tpu.config import root
+    return bool(root.common.telemetry.get("enabled", True))
+
+
+def unit_timing_summary(top=None):
+    """Per-unit run-time digest from the shared histograms —
+    ``{unit: {count, sum, mean, p50, p95, ...}}`` sorted by total
+    time, optionally truncated to the ``top`` heaviest units."""
+    fam = metrics.get("veles_unit_run_seconds")
+    if fam is None:
+        return {}
+    rows = [(child.sum, name, child.summary())
+            for (name,), child in fam.children().items()]
+    rows.sort(reverse=True)
+    if top is not None:
+        rows = rows[:top]
+    return {name: digest for _, name, digest in rows}

@@ -1,0 +1,151 @@
+"""JIT compile tracking — make XLA (re)compilation a first-class
+metric.
+
+Every jitted entry point in the framework (fused workflow segments,
+trainer steps, serving prefill / slot decode, the ``generate()``
+decode family) is wrapped with :func:`track_jit`; the wrapper detects
+compilations by watching the jitted callable's executable-cache size
+grow across a call (``jax.jit`` exposes ``_cache_size()``), so
+
+- first-call compile time per entry point becomes a gauge,
+- recompile counts (new shapes / dtypes hitting the same entry point)
+  become a counter — the "why is the server stalling" answer that raw
+  wall timers can't give,
+- each detected compile also lands in the span log as a
+  ``jit.compile`` event, so Chrome traces show compile gaps inline.
+
+:func:`maybe_profiler_trace` is the opt-in ``jax.profiler`` toggle:
+set ``root.common.trace.profiler_dir`` and every ``Workflow.run()``
+writes a TensorBoard-loadable device trace alongside the host spans.
+"""
+
+import contextlib
+import functools
+import time
+
+from veles_tpu.logger import events
+from veles_tpu.telemetry.registry import metrics
+
+
+def _compile_metrics():
+    return (
+        metrics.counter(
+            "veles_jit_compiles_total",
+            "XLA compilations per jitted entry point (first call + "
+            "every recompile on a new shape/dtype)", ("fn",)),
+        metrics.counter(
+            "veles_jit_calls_total",
+            "calls into tracked jitted entry points", ("fn",)),
+        metrics.histogram(
+            "veles_jit_compile_seconds",
+            "wall time of calls that triggered an XLA compilation "
+            "(trace + compile + first dispatch)", ("fn",)),
+        metrics.gauge(
+            "veles_jit_first_compile_seconds",
+            "wall time of the FIRST compiling call per entry point",
+            ("fn",)),
+    )
+
+
+class _TrackedJit:
+    """Callable proxy over a jitted function counting compiles.
+
+    Transparent: attribute access (``_cache_size``, ``lower``,
+    ``clear_cache``...) delegates to the wrapped callable."""
+
+    def __init__(self, name, fn):
+        self.name = name
+        self.fn = fn
+        functools.update_wrapper(self, fn, updated=())
+        compiles, calls, hist, first = _compile_metrics()
+        self._compiles = compiles.labels(name)
+        self._calls = calls.labels(name)
+        self._hist = hist.labels(name)
+        self._first = first.labels(name)
+        self._seen_compile = False
+
+    def _cache_len(self):
+        probe = getattr(self.fn, "_cache_size", None)
+        if probe is None:
+            return None
+        try:
+            return int(probe())
+        except Exception:
+            return None
+
+    def __call__(self, *args, **kwargs):
+        before = self._cache_len()
+        t0 = time.perf_counter()
+        out = self.fn(*args, **kwargs)
+        self._calls.inc()
+        if before is not None:
+            after = self._cache_len()
+            if after is not None and after > before:
+                dt = time.perf_counter() - t0
+                self._compiles.inc(after - before)
+                self._hist.observe(dt)
+                if not self._seen_compile:
+                    self._seen_compile = True
+                    self._first.set(dt)
+                events.record("jit.compile", "single", fn=self.name,
+                              duration=dt)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self.fn, name)
+
+
+def track_jit(name, fn):
+    """Wrap a jitted callable so its compiles are counted under
+    ``name``.  Same-name wrappers share the metric series (an LRU
+    cache re-jitting a cleared entry keeps accumulating into one
+    series).  The wrapper holds no global reference: its lifetime is
+    the wrapped callable's, so dropping the jit handle still frees
+    the compiled executables and everything their closures pin."""
+    return _TrackedJit(name, fn)
+
+
+def compile_summary():
+    """Per-entry-point compile digest — ``{name: {compiles, calls,
+    first_compile_s, compile_seconds_total}}`` plus a ``total`` rollup;
+    what ``bench.py`` records next to throughput."""
+    out = {}
+    total_compiles = 0
+    total_seconds = 0.0
+    fam_compiles = metrics.get("veles_jit_compiles_total")
+    fam_calls = metrics.get("veles_jit_calls_total")
+    fam_hist = metrics.get("veles_jit_compile_seconds")
+    fam_first = metrics.get("veles_jit_first_compile_seconds")
+    if fam_compiles is None:
+        return {"total": {"compiles": 0, "compile_seconds": 0.0}}
+    for (name,), child in sorted(fam_compiles.children().items()):
+        compiles = int(child.value)
+        hist = fam_hist.labels(name)
+        calls = fam_calls.labels(name)
+        first = fam_first.labels(name)
+        total_compiles += compiles
+        total_seconds += hist.sum
+        out[name] = {
+            "compiles": compiles,
+            "calls": int(calls.value),
+            "first_compile_s": round(first.value, 4),
+            "compile_seconds_total": round(hist.sum, 4),
+        }
+    out["total"] = {"compiles": total_compiles,
+                    "compile_seconds": round(total_seconds, 4)}
+    return out
+
+
+@contextlib.contextmanager
+def maybe_profiler_trace():
+    """When ``root.common.trace.profiler_dir`` names a directory, run
+    the block under ``jax.profiler.trace`` (device-side timeline for
+    TensorBoard/Perfetto); otherwise a no-op."""
+    from veles_tpu.config import root
+    trace_dir = root.common.trace.get("profiler_dir")
+    if not trace_dir:
+        yield
+        return
+    import jax.profiler
+    with jax.profiler.trace(str(trace_dir)):
+        yield

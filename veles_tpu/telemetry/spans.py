@@ -1,0 +1,79 @@
+"""Span pipeline — structured begin/end tracing over the EventSink.
+
+The :class:`veles_tpu.logger.EventSink` records raw ``begin``/``end``/
+``single`` events; this module adds the *workflow tracing* contract on
+top:
+
+- :func:`span` — a context manager emitting a ``begin``/``end`` pair
+  that shares a unique ``span`` id, with the measured ``duration``
+  (seconds) attached to the ``end`` event, so every begin can be paired
+  with its end even across interleaved threads;
+- :func:`iter_spans` — stream a recorded JSONL span log back as dicts
+  (the reader side used by :mod:`veles_tpu.telemetry.trace_export`).
+
+The per-unit spans the scheduler emits (``unit:<name>`` in
+:meth:`veles_tpu.units.Unit._run_wrapped`) follow the same schema.
+"""
+
+import itertools
+import json
+import os
+import time
+
+from veles_tpu.logger import events as default_sink
+
+_span_ids = itertools.count(1)
+
+
+def next_span_id():
+    """Process-unique span id (pid-qualified so merged logs from a
+    coordinator fleet keep their pairs distinct)."""
+    return "%d-%d" % (os.getpid(), next(_span_ids))
+
+
+class span:
+    """Context manager emitting a paired begin/end span::
+
+        with span("load checkpoint", path=p):
+            ...
+
+    The end event carries ``duration`` (seconds) and ``error`` (the
+    exception type name) when the block raised."""
+
+    def __init__(self, name, sink=None, **attrs):
+        self.name = name
+        self.sink = sink or default_sink
+        self.attrs = attrs
+        self.span_id = None
+        self._t0 = None
+
+    def __enter__(self):
+        self.span_id = next_span_id()
+        self._t0 = time.time()
+        self.sink.record(self.name, "begin", span=self.span_id,
+                         **self.attrs)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        attrs = dict(self.attrs)
+        attrs["duration"] = time.time() - self._t0
+        if exc_type is not None:
+            attrs["error"] = exc_type.__name__
+        self.sink.record(self.name, "end", span=self.span_id, **attrs)
+        return False
+
+
+def iter_spans(path):
+    """Yield the events of a JSONL span log as dicts; malformed lines
+    (a crashed writer's torn tail) are skipped, not fatal."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(ev, dict):
+                yield ev

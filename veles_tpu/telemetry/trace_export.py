@@ -1,0 +1,103 @@
+"""JSONL span log → Chrome ``trace_event`` JSON.
+
+The EventSink's JSONL file (``EventSink.open``) is greppable but not
+visual; this converter turns a recorded run into the Chrome trace
+format that https://ui.perfetto.dev (and ``chrome://tracing``) load
+directly::
+
+    python -m veles_tpu.telemetry.trace_export run.jsonl trace.json
+
+Mapping:
+
+- ``begin``/``end`` events → ``B``/``E`` phase pairs (Perfetto nests
+  them per pid/tid track, so per-unit spans stack under the workflow
+  run span);
+- ``single`` events with a ``duration`` → ``X`` complete events
+  (``ts`` backdated by the duration so the bar ends at record time);
+- other ``single`` events → ``i`` instants;
+- remaining attributes ride along as ``args`` (visible on click).
+
+Timestamps are microseconds relative to the first event, keeping the
+numbers readable in the UI.
+"""
+
+import json
+import sys
+
+from veles_tpu.telemetry.spans import iter_spans
+
+_META = ("name", "kind", "time", "pid", "tid")
+
+
+def _args(ev):
+    return {k: v for k, v in ev.items() if k not in _META}
+
+
+def spans_to_chrome(events, t0=None):
+    """Convert an iterable of span-event dicts to a list of Chrome
+    trace events.  ``t0`` pins the timeline origin (defaults to the
+    first event's timestamp)."""
+    out = []
+    for ev in events:
+        try:
+            t = float(ev["time"])
+            kind = ev["kind"]
+            name = str(ev["name"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        if t0 is None:
+            t0 = t
+        pid = int(ev.get("pid", 0))
+        tid = int(ev.get("tid", 0))
+        ts = (t - t0) * 1e6
+        cat = str(ev.get("cls", ev.get("unit", "span")))
+        if kind == "begin":
+            out.append({"name": name, "ph": "B", "ts": ts, "pid": pid,
+                        "tid": tid, "cat": cat, "args": _args(ev)})
+        elif kind == "end":
+            out.append({"name": name, "ph": "E", "ts": ts, "pid": pid,
+                        "tid": tid, "cat": cat, "args": _args(ev)})
+        elif kind == "single" and ev.get("duration") is not None:
+            try:
+                dur = float(ev["duration"]) * 1e6
+            except (TypeError, ValueError):
+                continue
+            out.append({"name": name, "ph": "X", "ts": ts - dur,
+                        "dur": dur, "pid": pid, "tid": tid,
+                        "cat": cat, "args": _args(ev)})
+        else:
+            out.append({"name": name, "ph": "i", "ts": ts, "pid": pid,
+                        "tid": tid, "cat": cat, "s": "t",
+                        "args": _args(ev)})
+    return out
+
+
+def export(in_path, out_path):
+    """Convert the JSONL span log at ``in_path`` into a Chrome trace
+    JSON at ``out_path``; returns the number of trace events."""
+    trace = {
+        "traceEvents": spans_to_chrome(iter_spans(in_path)),
+        "displayTimeUnit": "ms",
+        "otherData": {"source": "veles_tpu.telemetry.trace_export",
+                      "input": str(in_path)},
+    }
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+        f.write("\n")
+    return len(trace["traceEvents"])
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) != 2:
+        print("usage: python -m veles_tpu.telemetry.trace_export "
+              "<run.jsonl> <trace.json>", file=sys.stderr)
+        return 2
+    n = export(argv[0], argv[1])
+    print("wrote %d trace events to %s (open in "
+          "https://ui.perfetto.dev)" % (n, argv[1]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,24 @@ from veles_tpu.mutable import Bool, LinkableAttribute
 from veles_tpu.unit_registry import RegisteredDistributable
 
 
+def _unit_metrics():
+    """The shared per-unit telemetry series (created on first use so
+    importing units never forces the registry into being)."""
+    from veles_tpu.telemetry import metrics
+    return (
+        metrics.histogram(
+            "veles_unit_run_seconds",
+            "wall time of one unit run() firing", ("unit",)),
+        metrics.histogram(
+            "veles_unit_gate_wait_seconds",
+            "time between a unit's first incoming link firing and its "
+            "gate opening (scheduling slack on multi-input units)",
+            ("unit",)),
+        metrics.counter(
+            "veles_unit_runs_total", "unit run() firings", ("unit",)),
+    )
+
+
 class MissingDemand(AttributeError):
     """A demanded attribute is absent at initialize() time — the workflow
     re-queues the unit and tries again after its suppliers initialize
@@ -65,6 +83,9 @@ class Unit(RegisteredDistributable):
 
     def init_unpickled(self):
         super(Unit, self).init_unpickled()
+        self._gate_wait_t0_ = None
+        self._gate_wait_ = 0.0
+        self._telemetry_ = None
 
     # -- identity ----------------------------------------------------------
 
@@ -180,12 +201,22 @@ class Unit(RegisteredDistributable):
 
     def open_gate(self, src):
         """Mark the ``src → self`` edge fired; True when all inputs fired
-        (flags then reset for the next wave)."""
+        (flags then reset for the next wave).  On multi-input units the
+        span between the FIRST edge firing and the gate opening is the
+        unit's gate-wait (scheduling slack), surfaced through telemetry."""
         if src is not None and src in self.links_from:
+            if len(self.links_from) > 1 and self._gate_wait_t0_ is None \
+                    and not any(self.links_from.values()):
+                # fallback stamp for signals that bypassed
+                # run_dependent (direct open_gate callers)
+                self._gate_wait_t0_ = time.time()
             self.links_from[src] = True
         if all(self.links_from.values()) or not self.links_from:
             for k in self.links_from:
                 self.links_from[k] = False
+            t0 = self._gate_wait_t0_
+            self._gate_wait_ = time.time() - t0 if t0 else 0.0
+            self._gate_wait_t0_ = None
             return True
         return False
 
@@ -210,8 +241,19 @@ class Unit(RegisteredDistributable):
         (SURVEY.md §5 jax.profiler requirement)."""
         if not self._is_initialized:
             raise RuntimeError("%s.run() before initialize()" % self)
+        import veles_tpu.telemetry as telemetry
         from veles_tpu.config import root
+        from veles_tpu.logger import events
         tracing = root.common.trace.get("run")
+        observing = telemetry.enabled()
+        gate_wait = self._gate_wait_
+        self._gate_wait_ = 0.0
+        span_id = None
+        if observing:
+            span_id = telemetry.next_span_id()
+            events.record("unit:%s" % self.name, "begin",
+                          unit=self.name, cls=type(self).__name__,
+                          span=span_id)
         t0 = time.time()
         try:
             if tracing:
@@ -225,13 +267,35 @@ class Unit(RegisteredDistributable):
             dt = time.time() - t0
             self.timers["run"] += dt
             self.timers["runs"] += 1
+            if observing:
+                events.record("unit:%s" % self.name, "end",
+                              unit=self.name, cls=type(self).__name__,
+                              span=span_id, duration=dt,
+                              gate_wait=round(gate_wait, 6))
+                if self._telemetry_ is None:
+                    run_h, wait_h, runs_c = _unit_metrics()
+                    self._telemetry_ = (run_h.labels(self.name),
+                                        wait_h.labels(self.name),
+                                        runs_c.labels(self.name))
+                run_h, wait_h, runs_c = self._telemetry_
+                run_h.observe(dt)
+                runs_c.inc()
+                if gate_wait:
+                    wait_h.observe(gate_wait)
             if root.common.get("timings"):
                 self.debug("%s ran in %.4fs", self.name, dt)
 
     def run_dependent(self):
         """Propagate the control signal to successors
-        (ref: units.py:485-505) — enqueues on the workflow scheduler."""
+        (ref: units.py:485-505) — enqueues on the workflow scheduler.
+        A multi-input successor's gate-wait clock starts when its FIRST
+        producer finishes (here, at schedule time — not at queue
+        delivery, which the serial worklist makes back-to-back)."""
+        now = time.time()
         for dst in self.links_to:
+            if len(dst.links_from) > 1 and dst._gate_wait_t0_ is None \
+                    and not any(dst.links_from.values()):
+                dst._gate_wait_t0_ = now
             self._workflow.schedule(dst, self)
 
     # -- export metadata ----------------------------------------------------

@@ -8,7 +8,9 @@ with per-worker state), ``/graph/<run>`` (the workflow graph rendered
 as layered SVG — the viz.js graph view of the reference's ``web/``,
 server-side and dependency-free) and ``/events/<run>`` (a browsable
 view of the JSONL event stream, filterable by unit/name/kind — the
-reference's Mongo-backed event viewer).  Machines read ``/api/runs``.
+reference's Mongo-backed event viewer).  Machines read ``/api/runs``
+and scrape ``/metrics`` (the process-wide telemetry registry as
+Prometheus text exposition).
 
 Run standalone:  ``python -m veles_tpu.web_status --port 8090``
 """
@@ -256,6 +258,14 @@ class WebStatusServer(Logger):
                                                        rid))),
                               svg))
 
+        class Metrics(tornado.web.RequestHandler):
+            def get(self):
+                from veles_tpu.telemetry import metrics as registry
+                self.set_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.write(registry.render_prometheus())
+
         class Events(tornado.web.RequestHandler):
             def get(self, rid):
                 run = server.runs.get(rid)
@@ -277,6 +287,7 @@ class WebStatusServer(Logger):
 
         self.app = tornado.web.Application([
             (r"/update", Update), (r"/", Page), (r"/api/runs", Api),
+            (r"/metrics", Metrics),
             (r"/graph/(.+)", Graph), (r"/events/(.+)", Events)])
         self._loop = None
         self._thread = None

@@ -126,19 +126,32 @@ class Workflow(Unit):
     def run(self):
         """Run the graph to completion (one full wave from start_point
         until end_point fires or the queue drains)
-        (ref: workflow.py:351-377)."""
+        (ref: workflow.py:351-377).  The wave is one paired span in
+        the event log; ``root.common.trace.profiler_dir`` additionally
+        wraps it in a ``jax.profiler`` device trace."""
+        from veles_tpu.telemetry import (
+            maybe_profiler_trace, metrics, next_span_id)
         self.stopped.set(False)
         self._sched_queue_.clear()
         t0 = time.time()
-        self.event("workflow run", "begin")
+        span_id = next_span_id()
+        self.event("workflow run", "begin", workflow=self.name,
+                   span=span_id)
         try:
-            self.schedule(self.start_point, None)
-            while self._sched_queue_ and not self.stopped:
-                unit, src = self._sched_queue_.popleft()
-                unit._check_gate_and_run(src)
+            with maybe_profiler_trace():
+                self.schedule(self.start_point, None)
+                while self._sched_queue_ and not self.stopped:
+                    unit, src = self._sched_queue_.popleft()
+                    unit._check_gate_and_run(src)
         finally:
-            self._run_time += time.time() - t0
-            self.event("workflow run", "end")
+            dt = time.time() - t0
+            self._run_time += dt
+            self.event("workflow run", "end", workflow=self.name,
+                       span=span_id, duration=dt)
+            metrics.histogram(
+                "veles_workflow_run_seconds",
+                "wall time of one full workflow wave",
+                ("workflow",)).labels(self.name).observe(dt)
         if self.run_is_finished_callback_ is not None:
             self.run_is_finished_callback_()
 
@@ -285,12 +298,27 @@ class Workflow(Unit):
         return dot
 
     def print_stats(self, top=5):
-        """Top-N per-unit run-time table (ref: workflow.py:788-825)."""
+        """Top-N per-unit run-time table (ref: workflow.py:788-825),
+        with per-run p50/p95 and cumulative gate-wait from the shared
+        telemetry histograms when instrumentation is on."""
+        from veles_tpu.telemetry import metrics
         stats = sorted(((u.timers["run"], u.timers["runs"], u.name)
                         for u in self.units), reverse=True)[:top]
         total = self._run_time or sum(s[0] for s in stats) or 1e-9
+        run_fam = metrics.get("veles_unit_run_seconds")
+        wait_fam = metrics.get("veles_unit_gate_wait_seconds")
         self.info("---- unit run-time stats (total %.2fs) ----", total)
         for t, n, name in stats:
-            self.info("  %-30s %8.3fs  %6d runs  %5.1f%%",
-                      name, t, n, 100.0 * t / total)
+            extra = ""
+            hist = run_fam.children().get((name,)) if run_fam else None
+            if hist is not None and hist.count:
+                p50 = hist.percentile(0.50)
+                p95 = hist.percentile(0.95)
+                extra = "  p50 %.4fs  p95 %.4fs" % (p50, p95)
+            wait = wait_fam.children().get((name,)) if wait_fam \
+                else None
+            if wait is not None and wait.count:
+                extra += "  gate-wait %.3fs" % wait.sum
+            self.info("  %-30s %8.3fs  %6d runs  %5.1f%%%s",
+                      name, t, n, 100.0 * t / total, extra)
         return stats
