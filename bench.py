@@ -897,14 +897,40 @@ def main():
     # units' run-time digests — the audit trail for "was this run
     # compile-bound or stall-bound", free since the registry was
     # populated by the benches above anyway
-    from veles_tpu.telemetry import compile_summary, \
+    from veles_tpu.telemetry import compile_summary, cost_summary, \
         unit_timing_summary
+    from veles_tpu.telemetry.health import monitor
     compile_rec = compile_summary()
     record["compile"] = compile_rec
     record["compile_seconds_total"] = \
         compile_rec["total"]["compile_seconds"]
     record["compiles_total"] = compile_rec["total"]["compiles"]
     record["unit_seconds_top"] = unit_timing_summary(top=10)
+    # cost accounting (XLA cost/memory analysis per tracked entry
+    # point): flops/bytes per TRAINER dispatch are the roofline
+    # denominators future perf PRs divide measured time by.  Explicit
+    # nulls when this backend can't report — absence must be visible,
+    # not silently zero.  NOTE: the span entry is per span DISPATCH
+    # (a lax.scan over many minibatches), the minibatch entry per
+    # single step.
+    costs = cost_summary()
+    record["cost_analysis"] = costs
+
+    def _cost(key):
+        for name in ("trainer.span_step", "trainer.minibatch_step"):
+            rec = costs.get(name)
+            if rec is not None and rec.get(key) is not None:
+                return rec[key]
+        return None
+
+    record["flops_per_step"] = _cost("flops")
+    record["hbm_bytes_per_step"] = _cost("bytes_accessed")
+    # training-health digest: did any bench step go non-finite, and
+    # what the final norms looked like (telemetry/health.py)
+    health = monitor.state()
+    record["health"] = health
+    record["health_status"] = health["status"]
+    record["health_nonfinite_total"] = health["nonfinite_total"]
     # full record to disk (auditable windows/configs/methodology);
     # compact primary-metric summary as the LAST stdout line — the
     # driver's 2 kB tail window must never again truncate entries
@@ -922,7 +948,8 @@ def main():
         "serving_slot_occupancy", "allreduce_p50_us",
         "allreduce_substrate", "allreduce_quality",
         "dp_samples_per_sec", "compile_seconds_total",
-        "compiles_total",
+        "compiles_total", "flops_per_step", "hbm_bytes_per_step",
+        "health_status", "health_nonfinite_total",
         "lm_error", "decode_error", "serving_error")
     compact = {k: record[k] for k in compact_keys if k in record}
     compact["full_record"] = "BENCH.json"

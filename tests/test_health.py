@@ -1,0 +1,494 @@
+"""Training-health monitor + flight recorder (``telemetry/health.py``,
+``telemetry/flight_recorder.py``): NaN detection within one step,
+policy enforcement (warn/skip_step/halt), loss-divergence EMA+patience,
+cost accounting degrade, crash-bundle dumps, the ``/healthz`` +
+``/debug/state`` surfaces and coordinator job trace ids."""
+
+import asyncio
+import json
+import math
+import os
+import signal
+import time
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.config import root
+from veles_tpu.logger import events
+from veles_tpu.loader.base import TRAIN
+from veles_tpu.telemetry import metrics
+from veles_tpu.telemetry.flight_recorder import FlightRecorder
+from veles_tpu.telemetry.health import monitor
+
+pytestmark = pytest.mark.health
+
+
+@pytest.fixture(scope="module")
+def device():
+    return Device(backend="numpy")
+
+
+@pytest.fixture
+def health_policy():
+    """Set-and-restore root.common.health.* around a test; resets the
+    process-wide monitor state both ways."""
+    saved = {k: root.common.health.get(k) for k in
+             ("policy", "divergence_patience", "divergence_tolerance",
+              "ema_beta", "grad_norm_max")}
+
+    def set_policy(policy, **kwargs):
+        root.common.health.policy = policy
+        for k, v in kwargs.items():
+            setattr(root.common.health, k, v)
+
+    monitor.reset()
+    yield set_policy
+    for k, v in saved.items():
+        if v is not None:
+            setattr(root.common.health, k, v)
+    root.common.health.policy = saved["policy"] or "warn"
+    monitor.reset()
+
+
+def _counter_value(name):
+    m = metrics.get(name)
+    return m.value if m is not None else 0.0
+
+
+# -- monitor unit behaviour ---------------------------------------------------
+
+def test_monitor_nonfinite_policies(health_policy):
+    base = _counter_value("veles_health_nonfinite_total")
+    health_policy("warn")
+    assert monitor.on_train_step(1.0, 2.0, 0.01, nonfinite=1.0,
+                                 loss=0.5, unit="t") == "warn"
+    health_policy("skip_step")
+    assert monitor.on_train_step(1.0, 2.0, 0.01, nonfinite=2.0,
+                                 loss=0.5, unit="t") == "skip_step"
+    health_policy("halt")
+    assert monitor.on_train_step(1.0, 2.0, 0.01, nonfinite=1.0,
+                                 loss=0.5, unit="t") == "halt"
+    assert monitor.halted
+    assert monitor.status_name == "halted"
+    assert _counter_value("veles_health_nonfinite_total") - base == 4
+    state = monitor.state()
+    assert state["skipped_total"] == 2
+    assert state["halts_total"] == 1
+    # a clean step does not un-latch halt
+    monitor.on_train_step(1.0, 2.0, 0.01, nonfinite=0.0, unit="t")
+    assert monitor.halted
+
+
+def test_monitor_divergence_ema_patience(health_policy):
+    health_policy("halt", divergence_patience=3,
+                  divergence_tolerance=1.5, ema_beta=0.9)
+    base = _counter_value("veles_health_divergence_events_total")
+    assert monitor.observe_loss(1.0) == "ok"      # seeds the EMA
+    assert monitor.observe_loss(1.01) == "ok"     # within tolerance
+    assert monitor.observe_loss(5.0) == "ok"      # streak 1
+    assert monitor.observe_loss(50.0) == "ok"     # streak 2
+    assert monitor.observe_loss(500.0) == "halt"  # streak 3 = patience
+    assert monitor.halted
+    assert _counter_value(
+        "veles_health_divergence_events_total") - base == 1
+    # NaN losses count toward the streak but never poison the EMA
+    monitor.reset()
+    health_policy("warn", divergence_patience=2)
+    monitor.observe_loss(1.0)
+    assert monitor.observe_loss(float("nan")) == "ok"
+    assert monitor.observe_loss(float("nan")) == "diverging"
+    assert math.isfinite(monitor.state()["loss_ema"])
+
+
+def test_decision_divergence_halts_run(health_policy):
+    """The decision unit feeds epoch losses to the monitor; a halt
+    verdict flips its complete gate."""
+    from veles_tpu.models.decision import DecisionGD
+
+    class _Loader:
+        epoch_number = 0
+        epoch_ended = True
+        train_ended = False
+
+    class _Trainer:
+        evaluator = None
+
+    health_policy("halt", divergence_patience=1,
+                  divergence_tolerance=1.5)
+    dec = DecisionGD(None, fail_iterations=100)
+    dec.loader = _Loader()
+    dec.trainer = _Trainer()
+    from veles_tpu.loader.base import VALID
+    for epoch, loss in enumerate((1.0, 1.0, 100.0)):
+        dec.loader.epoch_number = epoch
+        dec.epoch_samples[VALID] = 10
+        dec.epoch_n_err[VALID] = 1
+        dec.epoch_loss_sum[VALID] = loss * 10
+        dec._on_epoch_ended()
+        if bool(dec.complete):
+            break
+    assert bool(dec.complete)
+    assert monitor.halted
+
+
+# -- NaN injection through the real trainer -----------------------------------
+
+def _build_mlp(device, name):
+    """Tiny 3-class MLP on the minibatch (non-span) trainer path."""
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models import (
+        All2AllSoftmax, All2AllTanh, EvaluatorSoftmax, GradientDescent)
+
+    class _Blobs(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(7)
+            data = rng.normal(size=(120, 6)).astype(numpy.float32)
+            labels = (rng.integers(0, 3, 120)).tolist()
+            self.class_lengths[:] = [0, 40, 80]
+            self.original_data = data
+            self.original_labels = labels
+
+    wf = AcceleratedWorkflow(None, name=name)
+    loader = _Blobs(wf, minibatch_size=20, prng_key=name)
+    loader.initialize(device=device)
+    loader.span_serving = False   # exercise the per-minibatch path
+    l1 = All2AllTanh(wf, output_sample_shape=(8,), name=name + "-fc")
+    l1.input = loader.minibatch_data
+    l1.initialize(device=device)
+    head = All2AllSoftmax(wf, output_sample_shape=(3,),
+                          name=name + "-head")
+    head.input = l1.output
+    head.initialize(device=device)
+    ev = EvaluatorSoftmax(wf, name=name + "-ev")
+    ev.output = head.output
+    ev.labels = loader.minibatch_labels
+    ev.loader = loader
+    ev.initialize(device=device)
+    gd = GradientDescent(wf, forwards=[l1, head], evaluator=ev,
+                         loader=loader, learning_rate=0.05,
+                         name=name + "-gd")
+    gd.initialize(device=device)
+    return wf, loader, [l1, head], gd
+
+
+def _step_to_train(loader):
+    """Advance the loader to the next TRAIN minibatch."""
+    for _ in range(32):
+        loader.run()
+        if loader.minibatch_class == TRAIN:
+            return
+    raise AssertionError("no TRAIN minibatch served")
+
+
+def _poison_minibatch(loader):
+    arr = loader.minibatch_data
+    arr.map_write()
+    arr.mem[0, 0] = numpy.nan
+    arr.unmap()
+
+
+def _params_finite(layers):
+    for u in layers:
+        for arr in u.param_arrays().values():
+            arr.map_read()
+            if not numpy.isfinite(arr.mem).all():
+                return False
+    return True
+
+
+def test_nan_step_detected_and_skipped(device, health_policy):
+    """A NaN injected into a minibatch mid-training is detected within
+    ONE step, the skip_step policy drops the update in-graph (params
+    stay finite, training continues) and
+    veles_health_nonfinite_total increments."""
+    health_policy("skip_step")
+    wf, loader, layers, gd = _build_mlp(device, "health-skip")
+    # a few clean steps first (mid-training, not step 0)
+    for _ in range(3):
+        _step_to_train(loader)
+        gd.run()
+    base = _counter_value("veles_health_nonfinite_total")
+    base_skip = _counter_value("veles_health_steps_skipped_total")
+    _step_to_train(loader)
+    _poison_minibatch(loader)
+    gd.run()   # must not raise
+    assert _counter_value("veles_health_nonfinite_total") - base >= 1, \
+        "NaN step not detected within one step"
+    assert _counter_value(
+        "veles_health_steps_skipped_total") - base_skip >= 1
+    assert _params_finite(layers), \
+        "skip_step let a non-finite update reach the parameters"
+    assert monitor.state()["status"] == "degraded"
+    assert not monitor.halted
+    # training continues: the next clean step produces a finite loss
+    _step_to_train(loader)
+    gd.run()
+    gd.loss.map_read()
+    assert numpy.isfinite(gd.loss.mem)
+    # the skipped step's NaN never reached the epoch accumulator
+    gd.epoch_acc.map_read()
+    assert numpy.isfinite(gd.epoch_acc.mem).all()
+
+
+def test_nan_step_halt_policy_stops_workflow(device, health_policy):
+    """Under policy=halt the workflow stops gracefully (stopped gate
+    set, process alive) and /healthz turns 503-worthy."""
+    health_policy("halt")
+    wf, loader, layers, gd = _build_mlp(device, "health-halt")
+    _step_to_train(loader)
+    gd.run()
+    _step_to_train(loader)
+    _poison_minibatch(loader)
+    gd.run()   # must not raise
+    assert monitor.halted
+    assert bool(wf.stopped), "halt policy did not stop the workflow"
+
+
+# -- cost accounting ----------------------------------------------------------
+
+def test_cost_summary_fields_or_nulls():
+    """Every tracked entry point gets a cost record whose fields are
+    numbers or explicit Nones — never an error, whatever this jax /
+    backend supports."""
+    import jax
+    from veles_tpu.telemetry import cost_summary, track_jit
+    from veles_tpu.telemetry.compile_tracker import COST_KEYS
+    f = track_jit("test.cost_probe",
+                  jax.jit(lambda x: (x * 2.0).sum()))
+    f(numpy.ones((8, 8), numpy.float32))
+    rec = cost_summary().get("test.cost_probe")
+    assert rec is not None
+    assert set(rec) == set(COST_KEYS)
+    for v in rec.values():
+        assert v is None or isinstance(v, (int, float))
+
+
+def test_cost_analysis_toggle_off():
+    import jax
+    from veles_tpu.telemetry import cost_summary, track_jit
+    saved = root.common.telemetry.get("cost_analysis", True)
+    root.common.telemetry.cost_analysis = False
+    try:
+        f = track_jit("test.cost_disabled",
+                      jax.jit(lambda x: x + 1))
+        f(numpy.float32(1))
+        assert "test.cost_disabled" not in cost_summary()
+    finally:
+        root.common.telemetry.cost_analysis = saved
+
+
+# -- flight recorder ----------------------------------------------------------
+
+def _check_bundle(path, reason_prefix):
+    with open(path) as f:
+        bundle = json.load(f)
+    assert bundle["reason"].startswith(reason_prefix)
+    assert bundle["pid"] == os.getpid()
+    for key in ("events", "metrics", "config", "threads", "logs"):
+        assert key in bundle, "bundle missing %r" % key
+    assert "health" in bundle and "status" in bundle["health"]
+    return bundle
+
+
+def test_flight_recorder_sigusr1_dump(tmp_path):
+    rec = FlightRecorder(max_events=64)
+    rec.install(directory=str(tmp_path))
+    try:
+        events.record("pre-crash-breadcrumb", "single", detail=42)
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.time() + 10
+        while not rec.dumps and time.time() < deadline:
+            time.sleep(0.02)
+        assert rec.dumps, "SIGUSR1 produced no flight-recorder bundle"
+        bundle = _check_bundle(rec.dumps[-1], "signal:SIGUSR1")
+        assert any(ev.get("name") == "pre-crash-breadcrumb"
+                   for ev in bundle["events"])
+    finally:
+        rec.uninstall()
+
+
+def test_flight_recorder_excepthook_and_manual_dump(tmp_path):
+    rec = FlightRecorder()
+    rec.install(directory=str(tmp_path), signals=())
+    try:
+        try:
+            raise RuntimeError("boom for the recorder")
+        except RuntimeError:
+            import sys
+            rec._excepthook(*sys.exc_info())
+        bundle = _check_bundle(rec.dumps[-1],
+                               "exception:RuntimeError")
+        assert "boom for the recorder" in bundle["exception"]
+        path = rec.dump("manual")
+        assert path and os.path.exists(path)
+        state = rec.state()
+        assert state["installed"] and len(state["dumps"]) == 2
+    finally:
+        rec.uninstall()
+    assert not rec.state()["installed"]
+
+
+# -- HTTP surfaces ------------------------------------------------------------
+
+def _get_json(url, timeout=10):
+    try:
+        body = urllib.request.urlopen(url, timeout=timeout)
+        return body.status, json.load(body)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def test_rest_healthz_and_debug_state(device, health_policy):
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.memory import Array
+    from veles_tpu.restful_api import RESTfulAPI, RestfulLoader
+    health_policy("warn")
+    wf = AcceleratedWorkflow(None, name="healthz-rest")
+    loader = RestfulLoader(wf, sample_shape=(4,), minibatch_size=1,
+                           max_wait=1.0)
+    loader.initialize(device=device)
+    api = RESTfulAPI(wf, loader=loader, name="healthz-rest-api")
+    api.output = Array(numpy.zeros((1, 2), numpy.float32))
+    api.initialize()
+    try:
+        code, payload = _get_json(
+            "http://127.0.0.1:%d/healthz" % api.port)
+        assert code == 200
+        assert payload["status"] in ("ok", "degraded")
+        assert payload["health"]["policy"] == "warn"
+        events.record("debug-state-breadcrumb", "single")
+        code, payload = _get_json(
+            "http://127.0.0.1:%d/debug/state" % api.port)
+        assert code == 200
+        assert "flightrec" in payload and "health" in payload
+        assert any(ev.get("name") == "debug-state-breadcrumb"
+                   for ev in payload["events"])
+        # a halted monitor turns the liveness probe 503
+        root.common.health.policy = "halt"
+        monitor.on_train_step(1.0, 1.0, 0.0, nonfinite=1.0, unit="t")
+        code, payload = _get_json(
+            "http://127.0.0.1:%d/healthz" % api.port)
+        assert code == 503
+        assert payload["status"] == "halted"
+    finally:
+        api.stop()
+        loader.close()
+
+
+def test_web_status_healthz_and_debug_state(health_policy):
+    pytest.importorskip("tornado")
+    import socket
+    from veles_tpu.web_status import WebStatusServer
+    health_policy("warn")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    server = WebStatusServer(port=port)
+    server.start(background=True)
+    try:
+        code, payload = _get_json(
+            "http://127.0.0.1:%d/healthz" % port)
+        assert code == 200
+        assert payload["status"] in ("ok", "degraded")
+        code, payload = _get_json(
+            "http://127.0.0.1:%d/debug/state" % port)
+        assert code == 200
+        assert "events" in payload and "flightrec" in payload
+    finally:
+        server.stop()
+
+
+# -- coordinator job trace ids ------------------------------------------------
+
+class _FakeMaster:
+    def __init__(self, n_jobs=3):
+        self.n_jobs = n_jobs
+        self.served = 0
+        self.applied = []
+
+    def checksum(self):
+        return "trace-test"
+
+    def generate_data_for_slave(self, slave_id):
+        self.served += 1
+        return {"job_no": self.served}
+
+    def apply_data_from_slave(self, data, slave_id):
+        self.applied.append(data)
+
+    def drop_slave(self, slave_id):
+        pass
+
+    def has_more_jobs(self):
+        return self.served < self.n_jobs
+
+    def all_jobs_done(self):
+        return len(self.applied) >= self.n_jobs
+
+
+class _FakeWorker:
+    def checksum(self):
+        return "trace-test"
+
+    def do_job(self, data, update, callback):
+        callback({"result": data["job_no"]})
+
+
+def test_coordinator_job_trace_ids():
+    """Every dispatched job carries a trace id recorded as paired
+    master-side 'job' spans and worker-side 'job.work' spans sharing
+    the id — the stitch key for merged Chrome-trace exports."""
+    from veles_tpu.parallel.coordinator import Coordinator, WorkerClient
+    before = len(events.ring)
+
+    async def main():
+        coord = Coordinator(_FakeMaster(), port=0)
+        await coord.start()
+        await WorkerClient(_FakeWorker(),
+                           "127.0.0.1:%d" % coord.port).run()
+        await coord.stop()
+
+    asyncio.new_event_loop().run_until_complete(main())
+    tail = list(events.ring)[before:]
+    job_begins = {ev["span"] for ev in tail
+                  if ev["name"] == "job" and ev["kind"] == "begin"}
+    job_ends = {ev["span"] for ev in tail
+                if ev["name"] == "job" and ev["kind"] == "end"}
+    work_spans = {ev["span"] for ev in tail
+                  if ev["name"] == "job.work"}
+    assert len(job_begins) == 3
+    assert job_ends <= job_begins and job_ends
+    assert work_spans == job_begins, \
+        "worker job.work spans don't stitch to master job spans"
+    assert all(ev.get("worker") for ev in tail
+               if ev["name"] in ("job", "job.work"))
+
+
+# -- trace export corrupt-line accounting (satellite) -------------------------
+
+def test_trace_export_counts_and_warns_on_corrupt_lines(tmp_path,
+                                                        caplog):
+    import logging
+    from veles_tpu.telemetry.trace_export import export
+    log = tmp_path / "torn.jsonl"
+    good = {"name": "a", "kind": "single", "time": 1.0, "pid": 1,
+            "tid": 1, "duration": 0.5}
+    log.write_bytes(
+        (json.dumps(good) + "\n").encode()
+        + b"[1, 2, 3]\n"            # valid JSON, not an event dict
+        + b"\xff\xfe binary junk\n"  # undecodable garbage
+        + (json.dumps(good) + "\n").encode()
+        + b'{"name": "torn tail')    # crash-truncated final line
+    out = tmp_path / "trace.json"
+    with caplog.at_level(logging.WARNING):
+        assert export(str(log), str(out)) == 2
+    assert any("skipped 3 corrupt" in r.getMessage()
+               for r in caplog.records)
+    trace = json.loads(out.read_text())
+    assert trace["otherData"]["skipped_lines"] == 3

@@ -1,0 +1,59 @@
+"""Tier-1 static guard: every ``jax.jit`` call site inside
+``veles_tpu/`` must route through ``telemetry.track_jit`` so XLA
+compiles (and their cost accounting) can't silently escape the
+registry.  New entry points either wrap with
+``track_jit("name", jax.jit(...))`` or get an explicit allowlist
+entry here with a reason."""
+
+import re
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parent.parent / "veles_tpu"
+
+#: (relative path, line fragment) pairs intentionally NOT tracked
+ALLOWLIST = (
+    # AOT export path: jax_export drives the jit exactly once to
+    # serialize StableHLO — there is no runtime entry point to count
+    ("package_export.py", "jax_export.export(jax.jit(forward))"),
+    # decorator form; the module wraps the decorated function with
+    # track_jit("ops.pallas_uniform", ...) right below the def
+    ("ops/random.py", "@functools.partial(jax.jit,"),
+)
+
+_SITE = re.compile(r"jax\.jit\(|functools\.partial\(\s*jax\.jit")
+#: lines of surrounding context in which the track_jit wrap must
+#: appear (multi-line wrap calls put it a couple of lines above)
+_CONTEXT = 3
+
+
+def test_all_jax_jit_sites_are_tracked():
+    untracked = []
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(PKG).as_posix()
+        lines = path.read_text().splitlines()
+        for i, line in enumerate(lines):
+            if not _SITE.search(line):
+                continue
+            if line.lstrip().startswith("#"):
+                continue
+            if any(rel == p and frag in line for p, frag in ALLOWLIST):
+                continue
+            ctx = "\n".join(lines[max(0, i - _CONTEXT):i + _CONTEXT])
+            if "track_jit" not in ctx:
+                untracked.append("%s:%d: %s" % (rel, i + 1,
+                                                line.strip()))
+    assert not untracked, (
+        "jax.jit call sites not routed through telemetry.track_jit "
+        "(compiles would escape veles_jit_* metrics and cost "
+        "accounting).  Wrap with track_jit(name, jax.jit(...)) or "
+        "allowlist with a reason:\n" + "\n".join(untracked))
+
+
+def test_guard_allowlist_entries_still_exist():
+    """A stale allowlist entry means the exception it documented is
+    gone — prune it so it can't mask a future regression."""
+    for rel, frag in ALLOWLIST:
+        text = (PKG / rel).read_text()
+        assert frag in text, (
+            "allowlist entry (%s, %r) matches nothing — remove it"
+            % (rel, frag))

@@ -233,9 +233,19 @@ class Main:
             apply_config_file(self.args.config)
         for snippet in self.args.config_override:
             apply_override(snippet)
+        if self.args.health_policy:
+            root.common.health.policy = self.args.health_policy
+        if self.args.flightrec_dir:
+            root.common.flightrec.dir = self.args.flightrec_dir
         if self.args.dump_config:
             root.print_()
             return 0
+        # crash forensics from the first real work onward: faulthandler
+        # for native faults, SIGUSR1 for on-demand dumps, excepthook for
+        # unhandled Python errors (telemetry/flight_recorder.py)
+        if root.common.flightrec.get("enabled", True):
+            from veles_tpu.telemetry.flight_recorder import recorder
+            recorder.install()
         if self.args.ensemble_test:
             return self._run_ensemble_test()
         if not self.args.workflow:

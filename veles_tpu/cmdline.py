@@ -107,6 +107,18 @@ def build_parser():
                    help="record the span/event stream to a JSONL FILE "
                         "(convert for Perfetto with python -m "
                         "veles_tpu.telemetry.trace_export)")
+    p.add_argument("--health-policy", default=None,
+                   choices=("warn", "skip_step", "halt"),
+                   help="what a NaN/Inf training step triggers: warn "
+                        "(log+count), skip_step (drop the update "
+                        "in-graph), halt (stop the workflow, keep the "
+                        "process up); sets root.common.health.policy")
+    p.add_argument("--flightrec-dir", default=None, metavar="DIR",
+                   help="write crash flight-recorder bundles "
+                        "(flightrec-<pid>.json) to DIR instead of the "
+                        "snapshot dir; the recorder itself installs "
+                        "on every CLI run unless "
+                        "root.common.flightrec.enabled is False")
     for fn in EXTRA_PARSERS:
         fn(p)
     return p

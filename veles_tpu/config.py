@@ -151,8 +151,26 @@ root.common.update({
     "timings": False,
     "trace": {"run": False, "profiler_dir": None},
     # host-side instrumentation (per-unit spans + metric histograms,
-    # veles_tpu/telemetry/) — on by default, overhead-gated in CI
-    "telemetry": {"enabled": True},
+    # veles_tpu/telemetry/) — on by default, overhead-gated in CI.
+    # cost_analysis: capture XLA cost/memory analysis once per jitted
+    # entry point (one extra AOT compile each; degrades to Nones when
+    # the backend can't report)
+    "telemetry": {"enabled": True, "cost_analysis": True},
+    # training-health monitor (telemetry/health.py): policy is what
+    # happens on a NaN/Inf step — warn | skip_step (drop the update
+    # in-graph) | halt (stop the workflow, keep the process up)
+    "health": {
+        "enabled": True,
+        "policy": "warn",
+        "grad_norm_max": None,
+        "sync_every": 1,
+        "ema_beta": 0.9,
+        "divergence_tolerance": 1.5,
+        "divergence_patience": 3,
+    },
+    # crash flight recorder (telemetry/flight_recorder.py): bundle
+    # lands in `dir` (default: the snapshot dir) on crash/SIGUSR1
+    "flightrec": {"enabled": True, "dir": None, "dump_on_exit": False},
     "web": {"host": "localhost", "port": 8090},
 })
 root.common.protect("dirs")

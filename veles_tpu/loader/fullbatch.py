@@ -153,7 +153,8 @@ class FullBatchLoader(Loader):
             return jnp.where(
                 mask.reshape((-1,) + (1,) * (rows.ndim - 1)), rows, 0)
 
-        self._gather_jit_ = jax.jit(gather)
+        from veles_tpu.telemetry import track_jit
+        self._gather_jit_ = track_jit("loader.gather", jax.jit(gather))
 
     # -- serving ---------------------------------------------------------------
 

@@ -133,6 +133,18 @@ class DecisionGD(Unit, IResultProvider):
         # the validation loss instead (znicz decision tracked epoch_metrics
         # per evaluator kind)
         metric = loss if self._loss_driven() else n_err
+        # loss-history divergence detection (EMA + patience) feeds the
+        # health monitor; a 'halt' verdict ends the run gracefully at
+        # this epoch boundary instead of burning chips on a diverged
+        # model (telemetry/health.py)
+        from veles_tpu.telemetry import health as health_lib
+        if health_lib.health_config()["enabled"]:
+            verdict = health_lib.monitor.observe_loss(loss)
+            if verdict == "halt":
+                self.warning(
+                    "health policy 'halt': validation loss diverged "
+                    "- stopping")
+                self.complete.set(True)
         if self.min_validation_n_err is None \
                 or metric < self.min_validation_n_err:
             self.min_validation_n_err = metric

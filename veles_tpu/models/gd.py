@@ -362,7 +362,21 @@ class GradientDescent(AcceleratedUnit):
     def _make_minibatch_step(self):
         """The per-minibatch fused body shared by the single-step jit and
         the span scan: forward + loss + (cond) backward/solver + epoch
-        accounting."""
+        accounting.
+
+        Health (telemetry/health.py): the step also returns a 5-vector
+        ``[grad_norm, weight_norm, update_ratio, nonfinite, loss]``
+        computed IN-GRAPH (cheap jnp reductions over pytrees XLA fuses
+        into the step) — the host reads one tiny array instead of
+        re-walking the parameters.  Under the ``skip_step`` policy a
+        non-finite update is dropped in the same program: parameters,
+        solver state and the epoch-accounting row keep their pre-step
+        values, so a single poisoned minibatch cannot contaminate the
+        weights before the host even hears about it."""
+        from veles_tpu.telemetry.health import health_config
+        hcfg = health_config()
+        health_on = hcfg["enabled"]
+        skip_nonfinite = health_on and hcfg["policy"] == "skip_step"
         solver = get_solver(self.solver_name)
         schedule = get_schedule(self.lr_schedule, **self.lr_schedule_params)
         hps = {i: {name: self._layer_hp(u, name)
@@ -404,6 +418,14 @@ class GradientDescent(AcceleratedUnit):
                     mask, (pred != target).astype(jnp.int32), 0))
             return loss, n_err
 
+        def sq_norm(tree):
+            leaves = jax.tree_util.tree_leaves(tree)
+            total = jnp.zeros((), jnp.float32)
+            for leaf in leaves:
+                total = total + jnp.sum(
+                    jnp.square(leaf.astype(jnp.float32)))
+            return total
+
         def train_step(params, opt_state, acc, x, target, size, class_id,
                        step_no, lr_mult, key):
             def do_train(args):
@@ -425,15 +447,45 @@ class GradientDescent(AcceleratedUnit):
                             opt_state[i][name], hp)
                         new_params[i][name] = p
                         new_opt[i][name] = s
-                return new_params, new_opt, loss, n_err
+                if not health_on:
+                    return (new_params, new_opt, loss, n_err,
+                            jnp.zeros((5,), jnp.float32))
+                grad_sq = sq_norm(grads)
+                bad = jnp.where(
+                    jnp.isfinite(loss) & jnp.isfinite(grad_sq),
+                    jnp.float32(0), jnp.float32(1))
+                if skip_nonfinite:
+                    keep_old = bad > 0
+                    new_params = jax.tree.map(
+                        lambda new, old: jnp.where(keep_old, old, new),
+                        new_params, params)
+                    new_opt = jax.tree.map(
+                        lambda new, old: jnp.where(keep_old, old, new),
+                        new_opt, opt_state)
+                weight_sq = sq_norm(new_params)
+                update_sq = sq_norm(jax.tree.map(
+                    lambda new, old: new.astype(jnp.float32)
+                    - old.astype(jnp.float32), new_params, params))
+                health = jnp.stack([
+                    jnp.sqrt(grad_sq), jnp.sqrt(weight_sq),
+                    jnp.sqrt(update_sq)
+                    / (jnp.sqrt(weight_sq) + jnp.float32(1e-12)),
+                    bad, loss.astype(jnp.float32)])
+                return new_params, new_opt, loss, n_err, health
 
             def do_eval(args):
                 params, opt_state = args
                 loss, n_err = loss_and_metrics(
                     params, x, target, size, key, False)
-                return params, opt_state, loss, n_err
+                bad = jnp.where(jnp.isfinite(loss), jnp.float32(0),
+                                jnp.float32(1)) if health_on \
+                    else jnp.float32(0)
+                health = jnp.stack([
+                    jnp.float32(0), jnp.float32(0), jnp.float32(0),
+                    bad, loss.astype(jnp.float32)])
+                return params, opt_state, loss, n_err, health
 
-            params, opt_state, loss, n_err = jax.lax.cond(
+            params, opt_state, loss, n_err, health = jax.lax.cond(
                 class_id == TRAIN, do_train, do_eval,
                 (params, opt_state))
             # per-class epoch accounting stays on device: one row of
@@ -450,9 +502,14 @@ class GradientDescent(AcceleratedUnit):
                 per_sample = self.evaluator.metric_units(x)
             row = jnp.stack([n_err.astype(jnp.float32) / per_sample,
                              loss * size, size.astype(jnp.float32)])
+            if skip_nonfinite:
+                # a skipped step never happened: keep its NaN loss out
+                # of the epoch accumulator too (under warn/halt the
+                # poison stays visible on purpose)
+                row = jnp.where(health[3] > 0, jnp.float32(0), row)
             onehot = (jnp.arange(3) == class_id).astype(jnp.float32)
             acc = acc + onehot[:, None] * row[None, :]
-            return params, opt_state, acc, loss, n_err
+            return params, opt_state, acc, loss, n_err, health
 
         return train_step
 
@@ -468,7 +525,7 @@ class GradientDescent(AcceleratedUnit):
             train_step,
             in_shardings=(params_sh, opt_sh, rep, x_sh, tgt_sh,
                           rep, rep, rep, rep, rep),
-            out_shardings=(params_sh, opt_sh, rep, rep, rep),
+            out_shardings=(params_sh, opt_sh, rep, rep, rep, rep),
             donate_argnums=(0, 1, 2)))
 
     def _build_span_step(self):
@@ -486,14 +543,23 @@ class GradientDescent(AcceleratedUnit):
                 x = jnp.take(ds, idx_k, axis=0, mode="clip")
                 tgt = jnp.take(tgt_ds, idx_k, axis=0, mode="clip")
                 key = jax.random.fold_in(base_key, k)
-                params, opt_state, acc, loss, n_err = minibatch_step(
+                (params, opt_state, acc, loss, n_err,
+                 health) = minibatch_step(
                     params, opt_state, acc, x, tgt, size_k, class_id,
                     step0 + k.astype(jnp.float32), lr_mult, key)
-                return (params, opt_state, acc, k + 1), (loss, n_err)
+                return (params, opt_state, acc, k + 1), (loss, n_err,
+                                                         health)
 
-            (params, opt_state, acc, _), (losses, n_errs) = jax.lax.scan(
+            (params, opt_state, acc, _), (losses, n_errs,
+                                          healths) = jax.lax.scan(
                 body, (params, opt_state, acc, jnp.int32(0)), (idx, sizes))
-            return params, opt_state, acc, losses[-1], n_errs[-1]
+            # health over the span: last step's norms/loss, nonfinite
+            # steps SUMMED so a single poisoned minibatch mid-span is
+            # still counted at the boundary read
+            health = jnp.concatenate([
+                healths[-1, :3], jnp.sum(healths[:, 3])[None],
+                healths[-1, 4:]])
+            return params, opt_state, acc, losses[-1], n_errs[-1], health
 
         from veles_tpu.telemetry import track_jit
         if self.mesh is None:
@@ -510,7 +576,7 @@ class GradientDescent(AcceleratedUnit):
             span_step,
             in_shardings=(params_sh, opt_sh, rep, rep, rep, idx_sh,
                           sizes_sh, rep, rep, rep, rep),
-            out_shardings=(params_sh, opt_sh, rep, rep, rep),
+            out_shardings=(params_sh, opt_sh, rep, rep, rep, rep),
             donate_argnums=(0, 1, 2)))
 
     def _ensure_shardings(self):
@@ -620,17 +686,20 @@ class GradientDescent(AcceleratedUnit):
             target = shlib.put(target, tgt_sh)
             params, opt_state = self._mesh_prepare(params, opt_state)
         key = self.prng.peek_key(self.global_step)
-        new_params, new_opt, acc, loss, n_err = self._train_step_(
-            params, opt_state, self.epoch_acc.devmem, x, target,
-            jnp.int32(l.minibatch_size), jnp.int32(l.minibatch_class),
-            jnp.float32(self.global_step),
-            jnp.float32(self.lr_multiplier), key)
+        new_params, new_opt, acc, loss, n_err, health = \
+            self._train_step_(
+                params, opt_state, self.epoch_acc.devmem, x, target,
+                jnp.int32(l.minibatch_size),
+                jnp.int32(l.minibatch_class),
+                jnp.float32(self.global_step),
+                jnp.float32(self.lr_multiplier), key)
         self.epoch_acc.devmem = acc
         self._adopt_state(new_params, new_opt)
         self.loss.devmem = loss
         self.n_err.devmem = n_err
         if l.minibatch_class == TRAIN:
             self.global_step += 1
+            self._observe_health(health)
 
     def _run_span(self):
         """Consume a whole class span in ONE dispatch (lax.scan inside
@@ -660,17 +729,47 @@ class GradientDescent(AcceleratedUnit):
             from veles_tpu.parallel import sharding as shlib
             idx = shlib.put(idx, self._idx_sharding_)
         key = self.prng.peek_key(self.global_step)
-        new_params, new_opt, acc, loss, n_err = self._span_step_(
-            params, opt_state, self.epoch_acc.devmem, ds, tgt,
-            idx, l.span_sizes_,
-            jnp.int32(l.span_class_), jnp.float32(self.global_step),
-            jnp.float32(self.lr_multiplier), key)
+        new_params, new_opt, acc, loss, n_err, health = \
+            self._span_step_(
+                params, opt_state, self.epoch_acc.devmem, ds, tgt,
+                idx, l.span_sizes_,
+                jnp.int32(l.span_class_), jnp.float32(self.global_step),
+                jnp.float32(self.lr_multiplier), key)
         self.epoch_acc.devmem = acc
         self._adopt_state(new_params, new_opt)
         self.loss.devmem = loss
         self.n_err.devmem = n_err
         if l.span_class_ == TRAIN:
             self.global_step += len(l.span_sizes_)
+            self._observe_health(health, force=True)
+
+    def _observe_health(self, health, force=False):
+        """Feed the jitted step's health vector to the process-wide
+        monitor — ONE small device→host read per observed dispatch,
+        decimated by ``root.common.health.sync_every`` on the
+        per-minibatch path (a span boundary always syncs: it is
+        already a host touchpoint).  Acts on the policy verdict: halt
+        stops the workflow gracefully instead of crashing."""
+        from veles_tpu.telemetry import health as health_lib
+        cfg = health_lib.health_config()
+        if not cfg["enabled"]:
+            return
+        self._health_ticks_ = getattr(self, "_health_ticks_", 0) + 1
+        every = max(int(cfg["sync_every"]), 1)
+        if not force and self._health_ticks_ % every:
+            return
+        vals = numpy.asarray(health)
+        action = health_lib.monitor.on_train_step(
+            grad_norm=float(vals[0]), weight_norm=float(vals[1]),
+            update_ratio=float(vals[2]), nonfinite=float(vals[3]),
+            loss=float(vals[4]), unit=self.name)
+        if action == "halt":
+            self.error(
+                "health policy 'halt': non-finite training step - "
+                "stopping the workflow (process stays up; see "
+                "GET /healthz and the flight recorder)")
+            if self._workflow is not None:
+                self._workflow.on_workflow_finished()
 
     # -- elastic DCN sync (parameter-server semantics over the
     #    coordinator, ref: the Znicz GD units' weight-delta exchange the

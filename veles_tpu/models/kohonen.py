@@ -134,7 +134,9 @@ class KohonenTrainer(AcceleratedUnit):
             new_w = weights + lr * gate * (target - weights)
             return new_w, qerr
 
-        return jax.jit(step, donate_argnums=(0,))
+        from veles_tpu.telemetry import track_jit
+        return track_jit("kohonen.step",
+                         jax.jit(step, donate_argnums=(0,)))
 
     def run(self):
         if self._step_ is None:

@@ -103,7 +103,9 @@ class BernoulliRBM(AcceleratedUnit):
             err = jnp.sum(((v0 - vk) * mask) ** 2) / n
             return w, vb, hb, err
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        from veles_tpu.telemetry import track_jit
+        return track_jit("rbm.step",
+                         jax.jit(step, donate_argnums=(0, 1, 2)))
 
     def run(self):
         if self._step_ is None:

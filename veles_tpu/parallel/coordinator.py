@@ -94,6 +94,10 @@ class WorkerDescription:
         self.state = "WAIT"
         self.jobs_done = 0
         self.job_started = None
+        #: trace id of the in-flight job (rides the job frame so the
+        #: worker's event stream stitches to the master's in merged
+        #: Chrome-trace exports)
+        self.trace = None
 
     def __repr__(self):
         return "<worker %s power=%.1f jobs=%d state=%s>" % (
@@ -298,8 +302,13 @@ class Coordinator(Logger):
                 worker.state = "WORK"
                 worker.job_started = time.time()
                 self._metrics["dispatched"].inc()
-                await send_frame(worker.writer, {"cmd": "job",
-                                                 "data": job})
+                from veles_tpu.telemetry import next_span_id
+                worker.trace = next_span_id()
+                self.event("job", "begin", span=worker.trace,
+                           trace=worker.trace, worker=worker.id)
+                await send_frame(worker.writer,
+                                 {"cmd": "job", "data": job,
+                                  "trace": worker.trace})
             elif cmd == "update":
                 if self._done.is_set() or self._stopping:
                     # run already complete — the straggler's update is
@@ -319,6 +328,11 @@ class Coordinator(Logger):
                 self.job_durations.append(dt)
                 self._metrics["completed"].inc()
                 self._metrics["job_seconds"].observe(dt)
+                if worker.trace is not None:
+                    self.event("job", "end", span=worker.trace,
+                               trace=worker.trace, worker=worker.id,
+                               duration=dt)
+                    worker.trace = None
                 worker.state = "WAIT"
                 worker.jobs_done += 1
                 # a completed job proves the worker is healthy — clear
@@ -516,7 +530,18 @@ class WorkerClient(Logger):
                 def on_done(data):
                     update["data"] = data
 
-                self.workflow.do_job(msg["data"], None, on_done)
+                # the master's trace id brackets the local execution so
+                # merged master+worker span logs stitch per job
+                trace = msg.get("trace")
+                self.event("job.work", "begin", span=trace,
+                           trace=trace, worker=self.worker_id)
+                t0 = time.time()
+                try:
+                    self.workflow.do_job(msg["data"], None, on_done)
+                finally:
+                    self.event("job.work", "end", span=trace,
+                               trace=trace, worker=self.worker_id,
+                               duration=time.time() - t0)
                 await send_frame(writer, {"cmd": "update",
                                           "data": update.get("data")})
         finally:

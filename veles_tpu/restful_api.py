@@ -218,6 +218,36 @@ class RESTfulAPI(Unit):
                         return
                     self._reply_json(api.scheduler_.metrics())
                     return
+                if self.path.rstrip("/") == "/healthz":
+                    # liveness + health-policy state: 200 while the
+                    # model is trainable/servable, 503 once the halt
+                    # policy latched (the process stays up for
+                    # forensics — load balancers just stop routing)
+                    import os
+                    from veles_tpu.telemetry.health import monitor
+                    state = monitor.state()
+                    self._reply_json(
+                        {"status": state["status"], "pid": os.getpid(),
+                         "health": state},
+                        code=503 if state["status"] == "halted"
+                        else 200)
+                    return
+                if self.path.rstrip("/").split("?")[0] \
+                        == "/debug/state":
+                    # flight-recorder tail of the LIVE process: recent
+                    # span events + recorder/health state, the same
+                    # ingredients a crash bundle would dump
+                    from veles_tpu.logger import events
+                    from veles_tpu.telemetry.flight_recorder import \
+                        recorder
+                    from veles_tpu.telemetry.health import monitor
+                    self._reply_json({
+                        "flightrec": recorder.state(),
+                        "health": monitor.state(),
+                        "events": list(events.ring)[-100:],
+                        "logs": list(recorder.log_ring)[-50:],
+                    })
+                    return
                 if self.path.rstrip("/").split("?")[0] == "/metrics":
                     # Prometheus text exposition of the process-wide
                     # registry (serving, per-unit, compile series)
@@ -233,9 +263,9 @@ class RESTfulAPI(Unit):
                     return
                 self.send_error(404)
 
-            def _reply_json(self, obj):
-                blob = json.dumps(obj).encode()
-                self.send_response(200)
+            def _reply_json(self, obj, code=200):
+                blob = json.dumps(obj, default=str).encode()
+                self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(blob)))
                 self.end_headers()

@@ -14,7 +14,11 @@ See ``docs/observability.md`` for the metric names and span schema.
 """
 
 from veles_tpu.telemetry.compile_tracker import (  # noqa: F401
-    compile_summary, maybe_profiler_trace, track_jit)
+    compile_summary, cost_summary, maybe_profiler_trace, track_jit)
+from veles_tpu.telemetry.flight_recorder import (  # noqa: F401
+    FlightRecorder, recorder)
+from veles_tpu.telemetry.health import (  # noqa: F401
+    HealthMonitor, health_config, monitor)
 from veles_tpu.telemetry.registry import (  # noqa: F401
     Counter, DEFAULT_BUCKETS, Gauge, Histogram, MS_BUCKETS,
     MetricsRegistry, metrics, nearest_rank)

@@ -21,6 +21,7 @@ writes a TensorBoard-loadable device trace alongside the host spans.
 
 import contextlib
 import functools
+import threading
 import time
 
 from veles_tpu.logger import events
@@ -45,6 +46,117 @@ def _compile_metrics():
             "wall time of the FIRST compiling call per entry point",
             ("fn",)),
     )
+
+
+# -- cost accounting (XLA cost_analysis / memory_analysis) -------------------
+
+#: fields every cost record carries; absent backend support → None
+COST_KEYS = ("flops", "bytes_accessed", "temp_bytes", "argument_bytes",
+             "output_bytes", "generated_code_bytes")
+
+_cost_lock = threading.Lock()
+_cost_records = {}   # entry-point name -> {COST_KEYS: float|int|None}
+_cost_captured = set()
+
+
+def _cost_gauges():
+    return {
+        "flops": metrics.gauge(
+            "veles_jit_cost_flops",
+            "XLA cost_analysis flops of the first compiled executable "
+            "per entry point (roofline numerator)", ("fn",)),
+        "bytes_accessed": metrics.gauge(
+            "veles_jit_cost_bytes_accessed",
+            "XLA cost_analysis bytes accessed per executed step "
+            "(HBM-roofline denominator)", ("fn",)),
+        "temp_bytes": metrics.gauge(
+            "veles_jit_memory_temp_bytes",
+            "XLA memory_analysis peak temp allocation of the compiled "
+            "executable", ("fn",)),
+        "argument_bytes": metrics.gauge(
+            "veles_jit_memory_argument_bytes",
+            "XLA memory_analysis argument bytes of the compiled "
+            "executable", ("fn",)),
+        "output_bytes": metrics.gauge(
+            "veles_jit_memory_output_bytes",
+            "XLA memory_analysis output bytes of the compiled "
+            "executable", ("fn",)),
+        "generated_code_bytes": metrics.gauge(
+            "veles_jit_memory_code_bytes",
+            "XLA memory_analysis generated-code size of the compiled "
+            "executable", ("fn",)),
+    }
+
+
+def _cost_enabled():
+    from veles_tpu.config import root
+    return bool(root.common.telemetry.get("cost_analysis", True))
+
+
+def _nonneg(v):
+    """cost_analysis reports -1 for 'unknown' on some backends — that
+    is an absence, not a value."""
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return None
+    return v if v >= 0 else None
+
+
+def _capture_cost(name, fn, args, kwargs):
+    """Record cost/memory analysis for ``name``'s executable.  Uses
+    the AOT ``lower().compile()`` path (the lowering is cached from
+    the call that just compiled; runs ONCE per entry-point name per
+    process).  Holds no reference to ``args`` beyond this frame —
+    ``lower`` reads avals, not buffers, so donated inputs are fine.
+    Every absence (old jax, backend without cost analysis, sharded
+    lowering quirks) degrades to ``None`` fields, never an error."""
+    rec = dict.fromkeys(COST_KEYS)
+    compiled = None
+    try:
+        compiled = fn.lower(*args, **kwargs).compile()
+    except Exception:
+        pass
+    if compiled is not None:
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if isinstance(ca, dict):
+                rec["flops"] = _nonneg(ca.get("flops"))
+                rec["bytes_accessed"] = _nonneg(
+                    ca.get("bytes accessed"))
+        except Exception:
+            pass
+        try:
+            ma = compiled.memory_analysis()
+            rec["temp_bytes"] = _nonneg(
+                getattr(ma, "temp_size_in_bytes", None))
+            rec["argument_bytes"] = _nonneg(
+                getattr(ma, "argument_size_in_bytes", None))
+            rec["output_bytes"] = _nonneg(
+                getattr(ma, "output_size_in_bytes", None))
+            rec["generated_code_bytes"] = _nonneg(
+                getattr(ma, "generated_code_size_in_bytes", None))
+        except Exception:
+            pass
+    gauges = _cost_gauges()
+    for key, value in rec.items():
+        if value is not None:
+            gauges[key].labels(name).set(value)
+    with _cost_lock:
+        _cost_records[name] = rec
+    return rec
+
+
+def cost_summary():
+    """Per-entry-point cost digest — ``{name: {flops, bytes_accessed,
+    temp_bytes, argument_bytes, output_bytes, generated_code_bytes}}``
+    with explicit ``None`` for anything the backend couldn't report.
+    bench.py records it next to throughput as the roofline
+    denominator."""
+    with _cost_lock:
+        return {name: dict(rec) for name, rec in _cost_records.items()}
 
 
 class _TrackedJit:
@@ -89,6 +201,12 @@ class _TrackedJit:
                     self._first.set(dt)
                 events.record("jit.compile", "single", fn=self.name,
                               duration=dt)
+                # cost/memory accounting once per entry-point NAME per
+                # process (same-name rebuilds share the record): pay
+                # the one AOT recompile only for the first executable
+                if self.name not in _cost_captured and _cost_enabled():
+                    _cost_captured.add(self.name)
+                    _capture_cost(self.name, self.fn, args, kwargs)
         return out
 
     def __getattr__(self, name):

@@ -1,0 +1,267 @@
+"""Crash flight recorder — post-mortem forensics without a daemon.
+
+The repo's own history motivates this: a heap-corruption crash inside
+XLA:CPU span-step execution killed whole test runs with ZERO
+forensics (no stacks, no recent events, no config — just a dead
+process).  The reference framework had the same blind spot: its
+MongoDB event mirror died with the process that fed it.
+
+:class:`FlightRecorder` keeps the answer *inside* the process, ready
+to dump at the moment of death:
+
+- a bounded tail of recent log records (a ``logging`` handler feeding
+  a ring) rides next to the span ring the EventSink already keeps;
+- :meth:`install` registers the crash paths — ``faulthandler`` for
+  native faults (SIGSEGV/SIGABRT stacks to stderr, where worker logs
+  already aggregate), a ``SIGUSR1`` handler for on-demand dumps of a
+  live process, a chained ``sys.excepthook`` for unhandled Python
+  exceptions, and an ``atexit`` hook (opt-in via
+  ``root.common.flightrec.dump_on_exit``);
+- :meth:`dump` writes the debug bundle —
+  ``<snapshot_dir>/flightrec-<pid>.json`` — containing the recent
+  span events, the full metrics-registry snapshot, the effective
+  config, jax/platform environment, per-thread stacks, the health
+  monitor state and the log tail.
+
+``GET /debug/state`` on both HTTP services serves the same bundle
+ingredients from the live process (see ``docs/observability.md``).
+"""
+
+import atexit
+import faulthandler
+import json
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+
+log = logging.getLogger("flightrec")
+
+
+class _LogTail(logging.Handler):
+    """Root-logger handler appending compact records to a ring."""
+
+    def __init__(self, ring):
+        super(_LogTail, self).__init__(level=logging.INFO)
+        self.ring = ring
+
+    def emit(self, record):
+        try:
+            self.ring.append({
+                "time": record.created,
+                "level": record.levelname,
+                "logger": record.name,
+                "message": record.getMessage(),
+            })
+        except Exception:  # a broken record must never break logging
+            pass
+
+
+class FlightRecorder:
+    """Bounded event/log tail + crash hooks + bundle dumper."""
+
+    def __init__(self, max_events=256, max_logs=256):
+        self.max_events = int(max_events)
+        self.log_ring = deque(maxlen=int(max_logs))
+        self._lock = threading.Lock()
+        self._installed = False
+        self._handler = None
+        self._dir = None
+        self._prev_excepthook = None
+        self._prev_signals = {}
+        self._start = time.time()
+        self.dumps = []
+
+    # -- installation ------------------------------------------------------
+
+    def _resolve_dir(self):
+        if self._dir:
+            return self._dir
+        from veles_tpu.config import root
+        return root.common.flightrec.get("dir") \
+            or root.common.dirs.get("snapshots") or "."
+
+    def install(self, directory=None, signals=(signal.SIGUSR1,),
+                excepthook=True, enable_faulthandler=True):
+        """Idempotent; safe off the main thread (signal hooks are then
+        skipped with a debug note — everything else still installs)."""
+        with self._lock:
+            if self._installed:
+                return self
+            self._installed = True
+            self._dir = directory
+            self._handler = _LogTail(self.log_ring)
+            logging.getLogger().addHandler(self._handler)
+            if enable_faulthandler and not faulthandler.is_enabled():
+                # native-fault stacks to stderr: worker/CI logs already
+                # capture stderr, and stderr needs no open file to leak
+                faulthandler.enable()
+            for sig in signals:
+                try:
+                    self._prev_signals[sig] = signal.signal(
+                        sig, self._on_signal)
+                except (ValueError, OSError) as e:
+                    log.debug("cannot hook signal %s: %s", sig, e)
+            if excepthook:
+                self._prev_excepthook = sys.excepthook
+                sys.excepthook = self._excepthook
+            atexit.register(self._on_exit)
+        return self
+
+    def uninstall(self):
+        with self._lock:
+            if not self._installed:
+                return
+            self._installed = False
+            if self._handler is not None:
+                logging.getLogger().removeHandler(self._handler)
+                self._handler = None
+            for sig, prev in self._prev_signals.items():
+                try:
+                    signal.signal(sig, prev)
+                except (ValueError, OSError):
+                    pass
+            self._prev_signals = {}
+            if self._prev_excepthook is not None:
+                sys.excepthook = self._prev_excepthook
+                self._prev_excepthook = None
+            try:
+                atexit.unregister(self._on_exit)
+            except Exception:
+                pass
+
+    # -- crash paths -------------------------------------------------------
+
+    def _on_signal(self, signum, frame):
+        try:
+            name = signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        self.dump("signal:%s" % name)
+
+    def _excepthook(self, exc_type, exc, tb):
+        try:
+            self.dump("exception:%s" % exc_type.__name__,
+                      extra={"exception": "".join(
+                          traceback.format_exception(exc_type, exc,
+                                                     tb))[-4000:]})
+        except Exception:
+            pass
+        (self._prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+    def _on_exit(self):
+        try:
+            from veles_tpu.config import root
+            if root.common.flightrec.get("dump_on_exit"):
+                self.dump("atexit")
+        except Exception:
+            pass
+
+    # -- the bundle --------------------------------------------------------
+
+    def bundle(self, reason, extra=None):
+        """The debug bundle as a plain dict.  Every section guards
+        itself: a dump fired from a crash path must produce whatever
+        it still can, never raise."""
+        info = {"reason": reason, "time": time.time(),
+                "pid": os.getpid(), "argv": list(sys.argv),
+                "uptime_s": round(time.time() - self._start, 3)}
+        if extra:
+            info.update(extra)
+        try:
+            import platform
+            info["platform"] = {"python": sys.version.split()[0],
+                                "system": platform.platform()}
+        except Exception:
+            pass
+        info["env"] = {k: v for k, v in os.environ.items()
+                       if k.startswith(("JAX", "XLA", "VELES", "TPU",
+                                        "LIBTPU", "CUDA_VISIBLE"))}
+        # never IMPORT jax from a crash handler — only describe it when
+        # the process already paid for it
+        if "jax" in sys.modules:
+            try:
+                jax = sys.modules["jax"]
+                info["jax"] = {
+                    "version": jax.__version__,
+                    "backend": jax.default_backend(),
+                    "devices": [str(d) for d in jax.devices()],
+                }
+            except Exception as e:
+                info["jax"] = {"error": repr(e)}
+        try:
+            from veles_tpu.config import root
+            info["config"] = root.__content__()
+        except Exception:
+            pass
+        try:
+            from veles_tpu.telemetry.health import monitor
+            info["health"] = monitor.state()
+        except Exception:
+            pass
+        try:
+            from veles_tpu.telemetry.registry import metrics
+            info["metrics"] = metrics.snapshot()
+        except Exception:
+            pass
+        try:
+            from veles_tpu.logger import events
+            info["events"] = list(events.ring)[-self.max_events:]
+        except Exception:
+            pass
+        info["logs"] = list(self.log_ring)
+        try:
+            names = {t.ident: t.name for t in threading.enumerate()}
+            info["threads"] = {
+                "%s-%d" % (names.get(tid, "?"), tid):
+                    traceback.format_stack(frame)
+                for tid, frame in sys._current_frames().items()}
+        except Exception:
+            pass
+        return info
+
+    def dump(self, reason="manual", extra=None):
+        """Write the bundle to ``<dir>/flightrec-<pid>.json``; returns
+        the path (None when even the write failed)."""
+        try:
+            directory = self._resolve_dir()
+            os.makedirs(directory, exist_ok=True)
+            path = os.path.join(directory,
+                                "flightrec-%d.json" % os.getpid())
+            with open(path, "w") as f:
+                json.dump(self.bundle(reason, extra=extra), f,
+                          default=str, indent=1)
+                f.write("\n")
+        except Exception as e:
+            try:
+                log.error("flight-recorder dump failed: %s", e)
+            except Exception:
+                pass
+            return None
+        self.dumps.append(path)
+        try:
+            log.warning("flight-recorder bundle (%s) -> %s", reason,
+                        path)
+        except Exception:
+            pass
+        return path
+
+    def state(self):
+        """Live-process view for ``GET /debug/state``."""
+        from veles_tpu.logger import events
+        return {
+            "installed": self._installed,
+            "dir": self._resolve_dir() if self._installed else None,
+            "dumps": list(self.dumps),
+            "uptime_s": round(time.time() - self._start, 3),
+            "events_buffered": len(events.ring),
+            "logs_buffered": len(self.log_ring),
+        }
+
+
+#: process-wide recorder (installed by the CLI entry point)
+recorder = FlightRecorder()

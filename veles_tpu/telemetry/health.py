@@ -1,0 +1,297 @@
+"""Training-health monitoring — numeric anomaly detection with policy.
+
+The reference framework surfaced training health as a human reading
+the console: a NaN loss scrolled past in the epoch printout and the
+operator killed the run (veles/znicz decision printed, nothing acted).
+At production scale nobody watches; this module makes model health a
+first-class, *acted-on* signal:
+
+- the jitted trainer steps (:mod:`veles_tpu.models.gd`) compute a
+  cheap health vector in-graph — global grad-norm, weight-norm,
+  update ratio ``|Δw|/|w|`` and a NaN/Inf flag — and return it as aux
+  output, so detection costs one tiny device→host read, not a second
+  pass over the parameters;
+- :class:`HealthMonitor` (the process-wide :data:`monitor`) receives
+  those readings, exports them as ``veles_health_*`` registry series,
+  and applies the configured policy;
+- the ``skip_step`` policy is additionally enforced *inside* the
+  jitted step (``jnp.where`` selecting the pre-step parameters), so a
+  non-finite update never reaches the weights even though the host
+  only learns about it after the dispatch.
+
+Policy (``root.common.health.policy``):
+
+- ``warn`` (default) — count + log, training continues;
+- ``skip_step`` — the anomalous update is dropped in-graph (params
+  and epoch accounting keep their pre-step values), counted, logged;
+- ``halt`` — the monitor latches ``halted``; the trainer stops the
+  workflow gracefully (``GET /healthz`` then answers 503 — the
+  process stays up for forensics, it does not crash).
+
+Loss-history divergence (EMA + patience) is fed by the decision unit
+at epoch boundaries through :meth:`HealthMonitor.observe_loss`.
+"""
+
+import logging
+import math
+import threading
+
+from veles_tpu.telemetry.registry import metrics
+
+POLICIES = ("warn", "skip_step", "halt")
+
+#: status levels for the ``veles_health_status`` gauge / ``/healthz``
+OK, DEGRADED, HALTED = 0, 1, 2
+STATUS_NAMES = {OK: "ok", DEGRADED: "degraded", HALTED: "halted"}
+
+log = logging.getLogger("health")
+
+
+def health_config():
+    """The effective ``root.common.health.*`` knobs (read per call so
+    tests and ``-c`` overrides apply without rebuilds)."""
+    from veles_tpu.config import root
+    cfg = root.common.health
+    policy = str(cfg.get("policy", "warn"))
+    if policy not in POLICIES:
+        log.warning("unknown health policy %r - falling back to 'warn'",
+                    policy)
+        policy = "warn"
+    return {
+        "enabled": bool(cfg.get("enabled", True)),
+        "policy": policy,
+        #: host-side explosion warning threshold (None = off)
+        "grad_norm_max": cfg.get("grad_norm_max"),
+        #: read health back to host every N train dispatches (the
+        #: in-graph skip_step guard is always per step regardless)
+        "sync_every": int(cfg.get("sync_every", 1)),
+        "ema_beta": float(cfg.get("ema_beta", 0.9)),
+        "divergence_tolerance": float(
+            cfg.get("divergence_tolerance", 1.5)),
+        "divergence_patience": int(cfg.get("divergence_patience", 3)),
+    }
+
+
+def _series():
+    return {
+        "nonfinite": metrics.counter(
+            "veles_health_nonfinite_total",
+            "train steps whose loss or gradients were NaN/Inf"),
+        "skipped": metrics.counter(
+            "veles_health_steps_skipped_total",
+            "anomalous updates dropped in-graph by the skip_step "
+            "policy"),
+        "halts": metrics.counter(
+            "veles_health_halts_total",
+            "times the halt policy latched (non-finite step or loss "
+            "divergence)"),
+        "divergence": metrics.counter(
+            "veles_health_divergence_events_total",
+            "loss-divergence events (loss above EMA*tolerance for "
+            "'patience' consecutive observations)"),
+        "explosions": metrics.counter(
+            "veles_health_grad_explosions_total",
+            "finite steps whose global grad-norm exceeded "
+            "root.common.health.grad_norm_max"),
+        "grad_norm": metrics.gauge(
+            "veles_health_grad_norm",
+            "last observed global gradient L2 norm"),
+        "weight_norm": metrics.gauge(
+            "veles_health_weight_norm",
+            "last observed global parameter L2 norm"),
+        "update_ratio": metrics.gauge(
+            "veles_health_update_ratio",
+            "last observed |param update| / |param| ratio"),
+        "loss": metrics.gauge(
+            "veles_health_loss", "last observed training loss"),
+        "loss_ema": metrics.gauge(
+            "veles_health_loss_ema",
+            "EMA of the per-epoch loss fed to divergence detection"),
+        "status": metrics.gauge(
+            "veles_health_status",
+            "health policy state: 0 ok, 1 degraded, 2 halted"),
+    }
+
+
+class HealthMonitor:
+    """Aggregates health readings, applies the policy, answers
+    ``/healthz``.  Thread-safe; one process-wide instance
+    (:data:`monitor`) mirrors the registry convention."""
+
+    #: log the first few anomalies verbosely, then every Nth
+    WARN_HEAD, WARN_EVERY = 5, 100
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = None
+        self.reset()
+
+    def reset(self):
+        """Forget observation state (counters in the registry stay —
+        they are monotonic; tests assert on deltas)."""
+        with self._lock:
+            self.status = OK
+            self.steps = 0
+            self.nonfinite_total = 0
+            self.skipped_total = 0
+            self.halts_total = 0
+            self.divergence_events = 0
+            self.last = {}
+            self.loss_ema = None
+            self.divergence_streak = 0
+            self._warned = 0
+
+    def _m(self):
+        if self._metrics is None:
+            self._metrics = _series()
+        return self._metrics
+
+    @property
+    def halted(self):
+        with self._lock:
+            return self.status == HALTED
+
+    @property
+    def status_name(self):
+        with self._lock:
+            return STATUS_NAMES[self.status]
+
+    def _warn(self, msg, *args):
+        self._warned += 1
+        if self._warned <= self.WARN_HEAD \
+                or self._warned % self.WARN_EVERY == 0:
+            log.warning(msg + " (occurrence %d)", *(args
+                                                    + (self._warned,)))
+
+    def on_train_step(self, grad_norm, weight_norm, update_ratio,
+                      nonfinite, loss=None, unit=None):
+        """One (or one span of) train step(s) observed.  ``nonfinite``
+        is the count of anomalous steps in the reading.  Returns the
+        action taken: ``ok`` / ``warn`` / ``skip_step`` / ``halt``."""
+        cfg = health_config()
+        m = self._m()
+        action = "ok"
+        with self._lock:
+            self.steps += 1
+            self.last = {"grad_norm": grad_norm,
+                         "weight_norm": weight_norm,
+                         "update_ratio": update_ratio,
+                         "loss": loss, "unit": unit}
+            m["grad_norm"].set(grad_norm)
+            m["weight_norm"].set(weight_norm)
+            m["update_ratio"].set(update_ratio)
+            if loss is not None:
+                m["loss"].set(loss)
+            if nonfinite and nonfinite > 0:
+                n = int(nonfinite)
+                self.nonfinite_total += n
+                m["nonfinite"].inc(n)
+                if cfg["policy"] == "halt":
+                    self.status = HALTED
+                    self.halts_total += 1
+                    m["halts"].inc()
+                    action = "halt"
+                elif cfg["policy"] == "skip_step":
+                    self.skipped_total += n
+                    m["skipped"].inc(n)
+                    self.status = max(self.status, DEGRADED)
+                    action = "skip_step"
+                else:
+                    self.status = max(self.status, DEGRADED)
+                    action = "warn"
+                self._warn(
+                    "non-finite training step (x%d) in %s - policy %s",
+                    n, unit or "?", cfg["policy"])
+            elif cfg["grad_norm_max"] is not None \
+                    and math.isfinite(grad_norm) \
+                    and grad_norm > float(cfg["grad_norm_max"]):
+                m["explosions"].inc()
+                self.status = max(self.status, DEGRADED)
+                action = "warn"
+                self._warn(
+                    "gradient explosion: |g|=%.3g > %.3g in %s",
+                    grad_norm, float(cfg["grad_norm_max"]),
+                    unit or "?")
+            m["status"].set(self.status)
+        return action
+
+    def observe_loss(self, loss):
+        """Epoch-level loss for divergence detection (EMA + patience;
+        fed by the decision unit).  Returns ``ok`` / ``diverging`` /
+        ``halt``."""
+        cfg = health_config()
+        m = self._m()
+        action = "ok"
+        with self._lock:
+            loss = float(loss)
+            finite = math.isfinite(loss)
+            if self.loss_ema is None:
+                if finite:
+                    self.loss_ema = loss
+                    m["loss_ema"].set(loss)
+                return "ok"
+            threshold = self.loss_ema * cfg["divergence_tolerance"] \
+                + 1e-12
+            if not finite or loss > threshold:
+                self.divergence_streak += 1
+            else:
+                self.divergence_streak = 0
+            if finite:
+                beta = cfg["ema_beta"]
+                self.loss_ema = beta * self.loss_ema \
+                    + (1.0 - beta) * loss
+                m["loss_ema"].set(self.loss_ema)
+            if self.divergence_streak >= cfg["divergence_patience"]:
+                self.divergence_streak = 0  # re-arm
+                self.divergence_events += 1
+                m["divergence"].inc()
+                self.status = max(self.status, DEGRADED)
+                action = "diverging"
+                if cfg["policy"] == "halt":
+                    self.status = HALTED
+                    self.halts_total += 1
+                    m["halts"].inc()
+                    action = "halt"
+                self._warn(
+                    "loss divergence: %.4g above EMA %.4g for %d "
+                    "epochs - policy %s", loss, self.loss_ema,
+                    cfg["divergence_patience"], cfg["policy"])
+            m["status"].set(self.status)
+        return action
+
+    def state(self):
+        """Plain-dict state for ``/healthz``, the flight recorder and
+        bench.py."""
+        with self._lock:
+            return {
+                "status": STATUS_NAMES[self.status],
+                "policy": health_config()["policy"],
+                "steps_observed": self.steps,
+                "nonfinite_total": self.nonfinite_total,
+                "skipped_total": self.skipped_total,
+                "halts_total": self.halts_total,
+                "divergence_events": self.divergence_events,
+                "loss_ema": self.loss_ema,
+                "divergence_streak": self.divergence_streak,
+                "last": dict(self.last),
+            }
+
+    def summary_line(self):
+        """One-line digest for ``Workflow.print_stats`` (None when no
+        training was observed)."""
+        with self._lock:
+            if not self.steps:
+                return None
+            last = self.last
+            return ("health: %s  steps %d  nonfinite %d  skipped %d  "
+                    "divergence %d  |g| %.3g  |w| %.3g  du/u %.3g"
+                    % (STATUS_NAMES[self.status], self.steps,
+                       self.nonfinite_total, self.skipped_total,
+                       self.divergence_events,
+                       last.get("grad_norm") or 0.0,
+                       last.get("weight_norm") or 0.0,
+                       last.get("update_ratio") or 0.0))
+
+
+#: process-wide monitor (the ``/healthz`` surface)
+monitor = HealthMonitor()

@@ -63,10 +63,16 @@ class span:
         return False
 
 
-def iter_spans(path):
+def iter_spans(path, stats=None):
     """Yield the events of a JSONL span log as dicts; malformed lines
-    (a crashed writer's torn tail) are skipped, not fatal."""
-    with open(path) as f:
+    (a crashed writer's torn tail, binary garbage, non-dict JSON) are
+    skipped, not fatal.  Pass a dict as ``stats`` to learn how many
+    lines were dropped (``stats["skipped"]``) — the trace exporter
+    reports it so a crash-truncated log converts loudly, not
+    silently."""
+    if stats is not None:
+        stats.setdefault("skipped", 0)
+    with open(path, errors="replace") as f:
         for line in f:
             line = line.strip()
             if not line:
@@ -74,6 +80,10 @@ def iter_spans(path):
             try:
                 ev = json.loads(line)
             except ValueError:
+                if stats is not None:
+                    stats["skipped"] += 1
                 continue
             if isinstance(ev, dict):
                 yield ev
+            elif stats is not None:
+                stats["skipped"] += 1

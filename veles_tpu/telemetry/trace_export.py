@@ -22,6 +22,7 @@ numbers readable in the UI.
 """
 
 import json
+import logging
 import sys
 
 from veles_tpu.telemetry.spans import iter_spans
@@ -74,13 +75,25 @@ def spans_to_chrome(events, t0=None):
 
 def export(in_path, out_path):
     """Convert the JSONL span log at ``in_path`` into a Chrome trace
-    JSON at ``out_path``; returns the number of trace events."""
+    JSON at ``out_path``; returns the number of trace events.
+
+    Corrupt/truncated lines (a crashed writer's torn tail) are
+    counted and warned about, never fatal — the point of a flight
+    recording is that it converts AFTER the crash."""
+    stats = {}
     trace = {
-        "traceEvents": spans_to_chrome(iter_spans(in_path)),
+        "traceEvents": spans_to_chrome(iter_spans(in_path, stats)),
         "displayTimeUnit": "ms",
         "otherData": {"source": "veles_tpu.telemetry.trace_export",
                       "input": str(in_path)},
     }
+    skipped = stats.get("skipped", 0)
+    if skipped:
+        trace["otherData"]["skipped_lines"] = skipped
+        logging.getLogger("trace_export").warning(
+            "%s: skipped %d corrupt/truncated line(s) — likely a "
+            "crash-torn tail; the remaining %d events converted",
+            in_path, skipped, len(trace["traceEvents"]))
     with open(out_path, "w") as f:
         json.dump(trace, f)
         f.write("\n")

@@ -255,6 +255,7 @@ class Unit(RegisteredDistributable):
                           unit=self.name, cls=type(self).__name__,
                           span=span_id)
         t0 = time.time()
+        error = None
         try:
             if tracing:
                 import jax.profiler
@@ -263,15 +264,25 @@ class Unit(RegisteredDistributable):
                     self.run()
             else:
                 self.run()
+        except BaseException as e:
+            # the end span names the exception type so the flight
+            # recorder's event tail shows WHICH unit died, not just
+            # that the wave stopped
+            error = type(e).__name__
+            raise
         finally:
             dt = time.time() - t0
             self.timers["run"] += dt
             self.timers["runs"] += 1
             if observing:
+                end_attrs = {"unit": self.name,
+                             "cls": type(self).__name__,
+                             "span": span_id, "duration": dt,
+                             "gate_wait": round(gate_wait, 6)}
+                if error is not None:
+                    end_attrs["error"] = error
                 events.record("unit:%s" % self.name, "end",
-                              unit=self.name, cls=type(self).__name__,
-                              span=span_id, duration=dt,
-                              gate_wait=round(gate_wait, 6))
+                              **end_attrs)
                 if self._telemetry_ is None:
                     run_h, wait_h, runs_c = _unit_metrics()
                     self._telemetry_ = (run_h.labels(self.name),

@@ -266,6 +266,32 @@ class WebStatusServer(Logger):
                     "text/plain; version=0.0.4; charset=utf-8")
                 self.write(registry.render_prometheus())
 
+        class Healthz(tornado.web.RequestHandler):
+            def get(self):
+                # liveness + health-policy state (503 once halted, so
+                # probes/LBs act without parsing the body)
+                import os
+                from veles_tpu.telemetry.health import monitor
+                state = monitor.state()
+                if state["status"] == "halted":
+                    self.set_status(503)
+                self.write({"status": state["status"],
+                            "pid": os.getpid(), "health": state})
+
+        class DebugState(tornado.web.RequestHandler):
+            def get(self):
+                from veles_tpu.logger import events as event_sink
+                from veles_tpu.telemetry.flight_recorder import \
+                    recorder
+                from veles_tpu.telemetry.health import monitor
+                self.write(json.dumps({
+                    "flightrec": recorder.state(),
+                    "health": monitor.state(),
+                    "events": list(event_sink.ring)[-100:],
+                    "logs": list(recorder.log_ring)[-50:],
+                }, default=str))
+                self.set_header("Content-Type", "application/json")
+
         class Events(tornado.web.RequestHandler):
             def get(self, rid):
                 run = server.runs.get(rid)
@@ -287,7 +313,8 @@ class WebStatusServer(Logger):
 
         self.app = tornado.web.Application([
             (r"/update", Update), (r"/", Page), (r"/api/runs", Api),
-            (r"/metrics", Metrics),
+            (r"/metrics", Metrics), (r"/healthz", Healthz),
+            (r"/debug/state", DebugState),
             (r"/graph/(.+)", Graph), (r"/events/(.+)", Events)])
         self._loop = None
         self._thread = None

@@ -321,4 +321,8 @@ class Workflow(Unit):
                 extra += "  gate-wait %.3fs" % wait.sum
             self.info("  %-30s %8.3fs  %6d runs  %5.1f%%%s",
                       name, t, n, 100.0 * t / total, extra)
+        from veles_tpu.telemetry.health import monitor
+        health_line = monitor.summary_line()
+        if health_line:
+            self.info("  %s", health_line)
         return stats
