@@ -216,7 +216,17 @@ def test_nan_step_detected_and_skipped(device, health_policy):
     base_skip = _counter_value("veles_health_steps_skipped_total")
     _step_to_train(loader)
     _poison_minibatch(loader)
+    gd.epoch_acc.map_read()
+    samples_before = float(gd.epoch_acc.mem[TRAIN][2])
+    mb_size = int(loader.minibatch_size)
     gd.run()   # must not raise
+    # the skipped step still advances the TRAIN sample count: the DCN
+    # master gates epoch completion on acc[TRAIN][2] reaching the
+    # class length (decision.py), so dropping it would hang the run
+    gd.epoch_acc.map_read()
+    assert float(gd.epoch_acc.mem[TRAIN][2]) \
+        == samples_before + mb_size, \
+        "skip_step dropped the epoch sample count"
     assert _counter_value("veles_health_nonfinite_total") - base >= 1, \
         "NaN step not detected within one step"
     assert _counter_value(
@@ -233,6 +243,30 @@ def test_nan_step_detected_and_skipped(device, health_policy):
     # the skipped step's NaN never reached the epoch accumulator
     gd.epoch_acc.map_read()
     assert numpy.isfinite(gd.epoch_acc.mem).all()
+
+
+def test_policy_change_rebuilds_cached_step(device, health_policy):
+    """enabled/policy are baked into the jitted step at trace time —
+    changing root.common.health.policy after the first dispatch must
+    invalidate the cached step so the in-graph skip guard follows the
+    config (health_config's contract), not silently keep the old one."""
+    health_policy("warn")
+    wf, loader, layers, gd = _build_mlp(device, "health-rebuild")
+    _step_to_train(loader)
+    gd.run()
+    first = gd._train_step_
+    assert first is not None
+    _step_to_train(loader)
+    gd.run()
+    assert gd._train_step_ is first, "stable config must reuse the step"
+    root.common.health.policy = "skip_step"
+    _step_to_train(loader)
+    _poison_minibatch(loader)
+    gd.run()
+    assert gd._train_step_ is not first, \
+        "policy change did not rebuild the jitted step"
+    assert _params_finite(layers), \
+        "post-change skip_step guard not active in-graph"
 
 
 def test_nan_step_halt_policy_stops_workflow(device, health_policy):
@@ -361,6 +395,11 @@ def test_rest_healthz_and_debug_state(device, health_policy):
         assert code == 200
         assert payload["status"] in ("ok", "degraded")
         assert payload["health"]["policy"] == "warn"
+        # load balancers probe with a query string — must still match
+        code, payload = _get_json(
+            "http://127.0.0.1:%d/healthz?probe=1" % api.port)
+        assert code == 200
+        assert payload["status"] in ("ok", "degraded")
         events.record("debug-state-breadcrumb", "single")
         code, payload = _get_json(
             "http://127.0.0.1:%d/debug/state" % api.port)

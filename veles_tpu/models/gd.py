@@ -369,10 +369,14 @@ class GradientDescent(AcceleratedUnit):
         computed IN-GRAPH (cheap jnp reductions over pytrees XLA fuses
         into the step) — the host reads one tiny array instead of
         re-walking the parameters.  Under the ``skip_step`` policy a
-        non-finite update is dropped in the same program: parameters,
-        solver state and the epoch-accounting row keep their pre-step
-        values, so a single poisoned minibatch cannot contaminate the
-        weights before the host even hears about it."""
+        non-finite update is dropped in the same program: parameters
+        and solver state keep their pre-step values, and the
+        epoch-accounting row contributes only its sample count (the
+        epoch-completion gate still advances), so a single poisoned
+        minibatch cannot contaminate the weights before the host even
+        hears about it.  The policy knobs are baked at trace time;
+        the dispatch sites rebuild the cached steps when they change
+        (:meth:`_maybe_invalidate_steps`)."""
         from veles_tpu.telemetry.health import health_config
         hcfg = health_config()
         health_on = hcfg["enabled"]
@@ -503,10 +507,20 @@ class GradientDescent(AcceleratedUnit):
             row = jnp.stack([n_err.astype(jnp.float32) / per_sample,
                              loss * size, size.astype(jnp.float32)])
             if skip_nonfinite:
-                # a skipped step never happened: keep its NaN loss out
-                # of the epoch accumulator too (under warn/halt the
-                # poison stays visible on purpose)
-                row = jnp.where(health[3] > 0, jnp.float32(0), row)
+                # a skipped TRAIN step keeps its NaN loss/err out of
+                # the epoch accumulator but must still contribute its
+                # SIZE: the DCN master closes epochs when acc[cls][2]
+                # reaches the class lengths (decision.py), so zeroing
+                # the sample count would hang the distributed epoch.
+                # Eval steps are never skipped — their row stays
+                # intact regardless of loss finiteness (under
+                # warn/halt the poison stays visible on purpose).
+                skipped = (health[3] > 0) & (class_id == TRAIN)
+                row = jnp.where(
+                    skipped,
+                    jnp.stack([jnp.float32(0), jnp.float32(0),
+                               size.astype(jnp.float32)]),
+                    row)
             onehot = (jnp.arange(3) == class_id).astype(jnp.float32)
             acc = acc + onehot[:, None] * row[None, :]
             return params, opt_state, acc, loss, n_err, health
@@ -659,11 +673,28 @@ class GradientDescent(AcceleratedUnit):
             opt_state = jax.tree.map(shlib.put, opt_state, opt_sh)
         return params, opt_state
 
+    def _maybe_invalidate_steps(self):
+        """health.py promises config is read per call, but the
+        in-graph skip guard is baked into the step at trace time —
+        rebuild the cached jitted steps when the effective
+        (enabled, skip_step) pair changes so tests and ``-c``
+        overrides of ``root.common.health.*`` keep applying after
+        the first dispatch (one recompile, not silence)."""
+        from veles_tpu.telemetry.health import health_config
+        hcfg = health_config()
+        sig = (hcfg["enabled"],
+               hcfg["enabled"] and hcfg["policy"] == "skip_step")
+        if getattr(self, "_health_sig_", sig) != sig:
+            self._train_step_ = None
+            self._span_step_ = None
+        self._health_sig_ = sig
+
     def run(self):
         l = self.loader
         if getattr(l, "span_fresh_", False):
             self._run_span()
             return
+        self._maybe_invalidate_steps()
         if self._train_step_ is None:
             self._train_step_ = self._build_train_step()
         params, opt_state = self._gather_state()
@@ -706,6 +737,7 @@ class GradientDescent(AcceleratedUnit):
         jit over the loader's index schedule)."""
         l = self.loader
         l.span_fresh_ = False
+        self._maybe_invalidate_steps()
         if self._span_step_ is None:
             self._span_step_ = self._build_span_step()
         params, opt_state = self._gather_state()

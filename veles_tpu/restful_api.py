@@ -212,13 +212,16 @@ class RESTfulAPI(Unit):
                 pass
 
             def do_GET(self):
-                if self.path.rstrip("/") == "/serving/metrics":
+                # drop any query string BEFORE trimming the trailing
+                # slash — load-balancer probes send /healthz?probe=1
+                route = self.path.split("?")[0].rstrip("/")
+                if route == "/serving/metrics":
                     if api.scheduler_ is None:
                         self.send_error(404, "no serving scheduler")
                         return
                     self._reply_json(api.scheduler_.metrics())
                     return
-                if self.path.rstrip("/") == "/healthz":
+                if route == "/healthz":
                     # liveness + health-policy state: 200 while the
                     # model is trainable/servable, 503 once the halt
                     # policy latched (the process stays up for
@@ -232,8 +235,7 @@ class RESTfulAPI(Unit):
                         code=503 if state["status"] == "halted"
                         else 200)
                     return
-                if self.path.rstrip("/").split("?")[0] \
-                        == "/debug/state":
+                if route == "/debug/state":
                     # flight-recorder tail of the LIVE process: recent
                     # span events + recorder/health state, the same
                     # ingredients a crash bundle would dump
@@ -248,7 +250,7 @@ class RESTfulAPI(Unit):
                         "logs": list(recorder.log_ring)[-50:],
                     })
                     return
-                if self.path.rstrip("/").split("?")[0] == "/metrics":
+                if route == "/metrics":
                     # Prometheus text exposition of the process-wide
                     # registry (serving, per-unit, compile series)
                     from veles_tpu.telemetry import metrics as registry

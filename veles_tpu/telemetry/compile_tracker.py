@@ -203,10 +203,18 @@ class _TrackedJit:
                               duration=dt)
                 # cost/memory accounting once per entry-point NAME per
                 # process (same-name rebuilds share the record): pay
-                # the one AOT recompile only for the first executable
-                if self.name not in _cost_captured and _cost_enabled():
-                    _cost_captured.add(self.name)
-                    _capture_cost(self.name, self.fn, args, kwargs)
+                # the one AOT recompile only for the first executable.
+                # Claim the name under the lock — two threads racing
+                # here would each pay the AOT compile — but release it
+                # before the slow _capture_cost (which re-takes it to
+                # store the record).
+                if _cost_enabled():
+                    with _cost_lock:
+                        first = self.name not in _cost_captured
+                        if first:
+                            _cost_captured.add(self.name)
+                    if first:
+                        _capture_cost(self.name, self.fn, args, kwargs)
         return out
 
     def __getattr__(self, name):

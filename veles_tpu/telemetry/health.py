@@ -49,7 +49,11 @@ log = logging.getLogger("health")
 
 def health_config():
     """The effective ``root.common.health.*`` knobs (read per call so
-    tests and ``-c`` overrides apply without rebuilds)."""
+    tests and ``-c`` overrides apply).  Host-side knobs take effect
+    immediately; ``enabled``/``policy`` are also baked into the
+    jitted trainer steps at trace time — the trainer detects a change
+    and rebuilds them on the next dispatch
+    (``GradientDescent._maybe_invalidate_steps``)."""
     from veles_tpu.config import root
     cfg = root.common.health
     policy = str(cfg.get("policy", "warn"))
