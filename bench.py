@@ -774,6 +774,103 @@ def bench_serving(dev, steps=64, clients=8, max_slots=4):
         sch.close()
 
 
+def bench_input_pipeline(dev, steps=40, depth=2):
+    """Asynchronous input pipeline (loader/prefetch.py): a synthetic
+    SLOW streaming loader — ``fill_minibatch`` sleeps ``decode_ms``
+    emulating host decode (image/text pipelines) — trained through the
+    stock MLP stack with prefetch off vs on.
+
+    ``decode_ms`` is CALIBRATED to the measured per-step wall time of
+    the decode-free run (clamped 5..100 ms), i.e. the decode load
+    matches the compute load — the regime where overlap matters and
+    the theoretical gain of hiding one behind the other is 2x.  The
+    synchronous path pays decode + step per wave; the pipeline pays
+    max(decode, step).  Also records the ``veles_input_wait_seconds``
+    p50 both ways — the direct measurement of how long the trainer
+    blocked on input."""
+    import time as _time
+
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.loader.base import Loader
+    from veles_tpu.models.standard import build_mlp_classifier
+    from veles_tpu.telemetry import metrics
+
+    features, mb = 784, 256
+    n_train = mb * 16
+
+    class SlowStreamLoader(Loader):
+        decode_ms = 0.0
+
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            self.class_lengths[:] = [0, 0, n_train]
+            self._base = rng.normal(
+                size=(n_train, features)).astype(numpy.float32)
+            self._lab = (numpy.arange(n_train) % 10).astype(
+                numpy.int32)
+
+        def create_minibatch_data(self):
+            self.minibatch_data.reset(numpy.zeros(
+                (self.max_minibatch_size, features), numpy.float32))
+
+        def fill_minibatch(self):
+            if self.decode_ms:
+                _time.sleep(self.decode_ms / 1e3)
+            idx = self.minibatch_indices.mem[:self.minibatch_size]
+            self.minibatch_data.mem[:self.minibatch_size] = \
+                self._base[idx]
+            self.minibatch_labels.mem[:self.minibatch_size] = \
+                self._lab[idx]
+
+    def run_phase(prefetch, decode_ms, label):
+        wf = AcceleratedWorkflow(None, name=label)
+        loader = SlowStreamLoader(wf, minibatch_size=mb,
+                                  prefetch=prefetch, name=label)
+        loader.decode_ms = decode_ms
+        _, _, _, gd = build_mlp_classifier(
+            dev, loader, hidden=(512, 512), classes=10, workflow=wf,
+            gradient_moment=0.9)
+        for _ in range(3):  # compile + settle (+ pipeline ramp-up)
+            loader.run()
+            gd.run()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loader.run()
+            gd.run()
+        gd.loss.map_read()  # drain the async dispatch queue
+        dt = time.perf_counter() - t0
+        loader.stop()
+        hist = metrics.histogram(
+            "veles_input_wait_seconds",
+            labelnames=("loader", "mode")).labels(
+            label, "prefetch" if prefetch else "sync")
+        return steps * mb / dt, hist.summary()
+
+    # calibrate: decode load == measured compute load
+    sps_calib, _ = run_phase(0, 0.0, "bench-input-calib")
+    decode_ms = min(100.0, max(5.0, 1e3 * mb / sps_calib))
+    sync_sps, sync_wait = run_phase(0, decode_ms, "bench-input-sync")
+    pf_sps, pf_wait = run_phase(depth, decode_ms,
+                                "bench-input-prefetch")
+    return {
+        "input_pipeline_speedup": round(pf_sps / sync_sps, 3),
+        "input_pipeline_prefetch_samples_per_sec": round(pf_sps, 1),
+        "input_pipeline_sync_samples_per_sec": round(sync_sps, 1),
+        "input_pipeline_decode_ms": round(decode_ms, 2),
+        "input_pipeline_depth": depth,
+        "input_pipeline_input_wait_p50_sync_s": sync_wait["p50"],
+        "input_pipeline_input_wait_p50_prefetch_s": pf_wait["p50"],
+        "input_pipeline_config": {
+            "features": features, "minibatch": mb,
+            "n_train": n_train, "steps": steps,
+            "hidden": [512, 512],
+            "methodology":
+                "decode_ms calibrated to the decode-free per-step "
+                "wall time (clamped 5..100 ms); sync pays "
+                "decode+step per wave, prefetch max(decode, step)"},
+    }
+
+
 def bench_dp_scaling(dev):
     """dp-scaling throughput: the MLP trained over a dp mesh spanning
     every chip — activates only when more than one device exists (the
@@ -852,6 +949,10 @@ def main():
     except Exception as e:       # serving rides the same guard
         serving = {"serving_error": repr(e)[:300]}
     mlp_sps, mlp_aud = bench_mlp(dev)
+    try:
+        input_pipe = bench_input_pipeline(dev)
+    except Exception as e:   # a capability entry must not take down
+        input_pipe = {"input_pipeline_error": repr(e)[:300]}
     allreduce = bench_allreduce()
     dp = bench_dp_scaling(dev)
     vs = (alex_sps / ALEXNET_BASELINE_SAMPLES_PER_SEC
@@ -889,6 +990,7 @@ def main():
     record.update(longctx)
     record.update(decode)
     record.update(serving)
+    record.update(input_pipe)
     record.update(allreduce)
     if dp:
         record.update(dp)
@@ -945,12 +1047,14 @@ def main():
         "lm_mfu", "longcontext_tokens_per_sec",
         "decode_tokens_per_sec", "decode_kv_speedup",
         "serving_ttft_ms", "serving_concurrent_tokens_per_sec",
-        "serving_slot_occupancy", "allreduce_p50_us",
+        "serving_slot_occupancy", "input_pipeline_speedup",
+        "input_pipeline_decode_ms", "allreduce_p50_us",
         "allreduce_substrate", "allreduce_quality",
         "dp_samples_per_sec", "compile_seconds_total",
         "compiles_total", "flops_per_step", "hbm_bytes_per_step",
         "health_status", "health_nonfinite_total",
-        "lm_error", "decode_error", "serving_error")
+        "lm_error", "decode_error", "serving_error",
+        "input_pipeline_error")
     compact = {k: record[k] for k in compact_keys if k in record}
     compact["full_record"] = "BENCH.json"
     print(json.dumps(compact))

@@ -352,6 +352,40 @@ def test_rest_serving_error_mapping(f32):
         loader.close()
 
 
+def test_rest_generate_validation_and_caps(f32):
+    """Malformed /generate bodies are CLIENT errors (400 with a
+    message), not 500s from the blanket handler, and the configurable
+    max_steps/max_batch caps reject oversize requests before they pay
+    a giant alloc + compile (ADVICE r5)."""
+    api, loader, post = _serve_api("serving-validate",
+                                   max_steps=8, max_batch=2)
+    try:
+        def expect_400(payload, needle):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post(payload)
+            assert e.value.code == 400, payload
+            body = e.value.read().decode(errors="replace")
+            assert needle in body, (needle, body)
+
+        expect_400({"steps": 2}, "prompt")                # missing
+        expect_400({"prompt": 7, "steps": 2}, "prompt")   # scalar
+        expect_400({"prompt": "hi", "steps": 2}, "prompt")
+        expect_400({"prompt": [3, [1]], "steps": 2}, "flat")  # ragged
+        expect_400({"prompt": [3, 1]}, "steps")           # missing
+        expect_400({"prompt": [3, 1], "steps": "many"}, "steps")
+        expect_400({"prompt": [3, 1], "steps": -1}, "steps")
+        expect_400({"prompt": [3, 1], "steps": 2, "stop": "eos"},
+                   "stop")
+        expect_400({"prompt": [3, 1], "steps": 99}, "max_steps")
+        expect_400({"prompt": [[3], [1], [4]], "steps": 2},
+                   "max_batch")
+        # a well-formed request inside the caps still answers
+        assert len(post({"prompt": [3, 1], "steps": 2})["tokens"]) == 4
+    finally:
+        api.stop()
+        loader.close()
+
+
 def test_rest_serving_off_falls_back(f32):
     """serving=False pins the legacy serialized decode path — the
     endpoint still answers (regression guard for the fallback)."""

@@ -256,8 +256,8 @@ def test_track_jit_counts_compiles():
     assert float(f(numpy.float32(2.0))) == 4.0  # recompile: new dtype
     compiles = metrics.counter(
         "veles_jit_compiles_total",
-        labelnames=("fn",)).labels("test.tracked")
-    assert compiles.value == 2
+        labelnames=("fn", "cache")).labels("test.tracked", "cold")
+    assert compiles.value == 2  # no persistent cache -> all cold
     assert calls.value - base_calls == 3
     hist = metrics.histogram(
         "veles_jit_compile_seconds",
@@ -265,6 +265,37 @@ def test_track_jit_counts_compiles():
     assert hist.count == 2
     # the proxy stays transparent
     assert f._cache_size() >= 2
+
+
+def test_persistent_compilation_cache_hits_labeled(tmp_path):
+    """root.common.trace.compilation_cache_dir wiring: executables
+    persist to disk on first compile, and a re-compile of the same
+    program is served by the on-disk cache — labeled cache="hit" in
+    veles_jit_compiles_total, distinct from the "cold" first one."""
+    import jax
+    from veles_tpu.__main__ import _enable_compilation_cache
+    _enable_compilation_cache(str(tmp_path))
+    try:
+        f = track_jit("test.pcache", jax.jit(lambda x: x * 3 + 1))
+        assert float(f(numpy.float32(2.0))) == 7.0
+        assert list(tmp_path.iterdir()), "no cache files written"
+        fam = metrics.counter("veles_jit_compiles_total",
+                              labelnames=("fn", "cache"))
+        assert fam.labels("test.pcache", "cold").value == 1
+        # a fresh compile of the SAME program loads from disk
+        jax.clear_caches()
+        assert float(f(numpy.float32(2.0))) == 7.0
+        assert fam.labels("test.pcache", "hit").value == 1
+        assert fam.labels("test.pcache", "cold").value == 1
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache)
+            compilation_cache.reset_cache()
+        except Exception:
+            pass
+        jax.clear_caches()
 
 
 def test_compile_summary_shape():

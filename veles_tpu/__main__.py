@@ -27,6 +27,39 @@ from veles_tpu.logger import setup_logging
 from veles_tpu.snapshotter import SnapshotterToFile
 
 
+def _enable_compilation_cache(path):
+    """Point jax at a persistent on-disk compilation cache: the first
+    run writes compiled executables there, every later CLI launch
+    loads them back instead of recompiling (compile_tracker labels
+    those loads ``cache="hit"`` in ``veles_jit_compiles_total``).
+    The thresholds are dropped to zero because CLI runs re-pay even
+    sub-second compiles on every launch; each knob is best-effort
+    across jax versions."""
+    import jax
+    log = logging.getLogger("Main")
+    try:
+        jax.config.update("jax_compilation_cache_dir", str(path))
+    except Exception as e:  # pragma: no cover - ancient jax
+        log.warning("persistent compilation cache unavailable: %s", e)
+        return
+    for knob, value in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(knob, value)
+        except Exception:  # knob not in this jax — keep its default
+            pass
+    try:
+        # the cache initializes lazily at the FIRST compile and then
+        # pins its directory — re-point it if something already jitted
+        from jax.experimental.compilation_cache import (
+            compilation_cache)
+        compilation_cache.reset_cache()
+    except Exception:
+        pass
+    log.info("persistent XLA compilation cache: %s", path)
+
+
 class Main:
     """ref: veles/__main__.py:136."""
 
@@ -237,6 +270,15 @@ class Main:
             root.common.health.policy = self.args.health_policy
         if self.args.flightrec_dir:
             root.common.flightrec.dir = self.args.flightrec_dir
+        if self.args.prefetch is not None:
+            root.common.loader.prefetch.enabled = self.args.prefetch > 0
+            root.common.loader.prefetch.depth = self.args.prefetch
+        if self.args.compilation_cache:
+            root.common.trace.compilation_cache_dir = \
+                self.args.compilation_cache
+        cache_dir = root.common.trace.get("compilation_cache_dir")
+        if cache_dir:
+            _enable_compilation_cache(cache_dir)
         if self.args.dump_config:
             root.print_()
             return 0

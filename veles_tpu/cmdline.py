@@ -113,6 +113,19 @@ def build_parser():
                         "(log+count), skip_step (drop the update "
                         "in-graph), halt (stop the workflow, keep the "
                         "process up); sets root.common.health.policy")
+    p.add_argument("--prefetch", type=int, default=None, nargs="?",
+                   const=2, metavar="DEPTH",
+                   help="asynchronous input pipeline for streaming "
+                        "loaders: decode/upload DEPTH minibatches "
+                        "ahead of the training step (bare flag: "
+                        "depth 2; 0 pins the synchronous path); sets "
+                        "root.common.loader.prefetch")
+    p.add_argument("--compilation-cache", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache directory "
+                        "(jax_compilation_cache_dir) — later runs "
+                        "reuse compiled executables instead of paying "
+                        "multi-second recompiles; sets "
+                        "root.common.trace.compilation_cache_dir")
     p.add_argument("--flightrec-dir", default=None, metavar="DIR",
                    help="write crash flight-recorder bundles "
                         "(flightrec-<pid>.json) to DIR instead of the "

@@ -149,7 +149,21 @@ root.common.update({
         "backend": os.environ.get("VELES_TPU_BACKEND", "auto"),
     },
     "timings": False,
-    "trace": {"run": False, "profiler_dir": None},
+    # compilation_cache_dir: persistent XLA compilation cache
+    # (jax_compilation_cache_dir) — kills multi-second recompiles
+    # across CLI runs; also settable with --compilation-cache
+    "trace": {"run": False, "profiler_dir": None,
+              "compilation_cache_dir": None},
+    # asynchronous input pipeline (loader/prefetch.py): streaming
+    # loaders decode batch k+1 and upload it while step k computes;
+    # depth = batches prepared ahead (0 disables).  Falls back to the
+    # synchronous path for master/slave serving and cross-process
+    # meshes automatically.
+    "loader": {"prefetch": {"enabled": True, "depth": 2}},
+    # REST /generate resource caps (satellite of the input-pipeline
+    # PR): oversize requests get a 400 instead of a giant alloc +
+    # multi-second compile
+    "api": {"max_steps": 2048, "max_batch": 64},
     # host-side instrumentation (per-unit spans + metric histograms,
     # veles_tpu/telemetry/) — on by default, overhead-gated in CI.
     # cost_analysis: capture XLA cost/memory analysis once per jitted

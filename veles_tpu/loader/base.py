@@ -15,6 +15,8 @@ the coordinator serves *index ranges* to workers
 locally from their own dataset copy.
 """
 
+import time
+
 import numpy
 
 from veles_tpu import prng as prng_mod
@@ -57,11 +59,21 @@ class Loader(Unit, ILoader, IDistributable, IResultProvider):
     VIEW_GROUP = "LOADER"
     negotiates_on_connect = True
 
+    #: loaders whose serving cannot be produced ahead of the waves
+    #: (queue-fed interactive streams) opt out of the asynchronous
+    #: input pipeline here
+    prefetchable = True
+
     def __init__(self, workflow, minibatch_size=100, shuffle_limit=None,
                  train_ratio=1.0, normalization_type="none",
-                 normalization_parameters=None, prng_key="loader", **kwargs):
+                 normalization_parameters=None, prng_key="loader",
+                 prefetch=None, **kwargs):
         super(Loader, self).__init__(workflow, **kwargs)
         self.max_minibatch_size = minibatch_size
+        #: asynchronous input pipeline override: None follows
+        #: ``root.common.loader.prefetch``; 0/False pins the
+        #: synchronous path; an int is the prefetch depth
+        self.prefetch = prefetch
         #: how many times shuffle() may still permute the train span
         #: (None = unlimited; 0 = deterministic order, ref base.py)
         self.shuffle_limit = shuffle_limit
@@ -104,6 +116,11 @@ class Loader(Unit, ILoader, IDistributable, IResultProvider):
         self.span_sizes_ = None
         self.span_class_ = None
         self.span_fresh_ = False
+        #: the asynchronous input pipeline (loader/prefetch.py):
+        #: None = undecided (created lazily on the first streaming
+        #: run()), False = decided off, else the live PrefetchPipeline
+        self.prefetch_ = None
+        self._input_wait_ = None
 
     # -- derived quantities ---------------------------------------------------
 
@@ -234,9 +251,81 @@ class Loader(Unit, ILoader, IDistributable, IResultProvider):
         self.pending_minibatches_.pop(None, None)
         if self.span_capable:
             self._serve_span()
+            return
+        pipeline = self._ensure_prefetch()
+        t0 = time.perf_counter()
+        if pipeline is not None:
+            pipeline.pop_into(self)
+            mode = "prefetch"
         else:
             self.serve_next_minibatch(None)
             self._on_successful_serve()
+            mode = "sync"
+        self._observe_input_wait(time.perf_counter() - t0, mode)
+
+    # -- asynchronous input pipeline (loader/prefetch.py) ----------------------
+
+    def _prefetch_depth(self):
+        """The effective prefetch depth for THIS loader: the
+        constructor override wins; otherwise
+        ``root.common.loader.prefetch`` {enabled, depth}.  <= 0 means
+        the synchronous path."""
+        if self.prefetch is not None:
+            return int(self.prefetch)
+        from veles_tpu.config import root
+        cfg = root.common.loader.get_dict(
+            "prefetch", {"enabled": True, "depth": 2})
+        if not cfg.get("enabled", True):
+            return 0
+        return int(cfg.get("depth", 2))
+
+    def _ensure_prefetch(self):
+        """Lazily decide/create the prefetch pipeline.  Falls back to
+        the synchronous path (returns None) for anything the
+        ahead-of-wave production cannot replay exactly: distributed
+        master/slave serving, cross-process meshes, refiled
+        minibatches — and for loaders that opted out."""
+        if self.prefetch_ is False:
+            return None
+        if self.prefetch_ is not None:
+            return self.prefetch_
+        depth = self._prefetch_depth()
+        enabled = (depth > 0 and self.prefetchable
+                   and self.is_standalone
+                   and not self.failed_minibatches)
+        if enabled:
+            import jax
+            enabled = jax.process_count() == 1
+        if not enabled:
+            self.prefetch_ = False
+            return None
+        from veles_tpu.loader.prefetch import PrefetchPipeline
+        self.prefetch_ = PrefetchPipeline(self, depth)
+        self.debug("asynchronous input pipeline on (depth %d)", depth)
+        return self.prefetch_
+
+    def _observe_input_wait(self, dt, mode):
+        """veles_input_wait_seconds: how long THIS wave blocked on
+        input before the trainer could dispatch — the decode+upload
+        cost on the sync path, the pop wait on the prefetch path."""
+        import veles_tpu.telemetry as telemetry
+        if not telemetry.enabled():
+            return
+        if self._input_wait_ is None or self._input_wait_[0] != mode:
+            hist = telemetry.metrics.histogram(
+                "veles_input_wait_seconds",
+                "time the trainer actually blocked on input per "
+                "minibatch wave (sync: decode+normalize+upload; "
+                "prefetch: ready-queue wait)", ("loader", "mode"))
+            self._input_wait_ = (mode, hist.labels(self.name, mode))
+        self._input_wait_[1].observe(dt)
+
+    def stop(self):
+        pipeline = self.prefetch_
+        if pipeline not in (None, False):
+            pipeline.close()
+            self.prefetch_ = None
+        super(Loader, self).stop()
 
     def _serve_span(self):
         """Serve ALL remaining minibatches of the current class span at
@@ -354,22 +443,35 @@ class Loader(Unit, ILoader, IDistributable, IResultProvider):
             self.global_offset >= self.effective_total_samples)
         return self.global_offset, size
 
-    def _update_flags(self):
-        if self.is_slave:
-            return
+    def _epoch_flag_values(self, minibatch_class, global_offset):
+        """The (last_minibatch, epoch_ended) values one serve at
+        ``global_offset`` in ``minibatch_class`` produces — shared by
+        the live flag update below and the prefetch worker, which
+        computes flags ahead of the waves without touching the gate
+        Bools (loader/prefetch.py)."""
+        class_ended = global_offset in self.class_end_offsets \
+            or global_offset == self.effective_total_samples
         # in-flight jobs only gate the flags on the coordinator — in
         # standalone mode the just-served minibatch is still "pending"
         # at this point (ref: base.py:862-878)
-        last_mb = (self.class_ended and not self.failed_minibatches
+        last_mb = (class_ended and not self.failed_minibatches
                    and (not self.is_master
                         or not any(self.pending_minibatches_.values())))
-        self.last_minibatch.set(last_mb)
-        self.epoch_ended.set(last_mb and (
-            self.minibatch_class == VALID or
-            (self.minibatch_class == TEST and
+        epoch_ended = last_mb and (
+            minibatch_class == VALID or
+            (minibatch_class == TEST and
              self.class_lengths[TRAIN] == self.class_lengths[VALID] == 0) or
-            (self.minibatch_class == TRAIN and
-             self.class_lengths[VALID] == 0)))
+            (minibatch_class == TRAIN and
+             self.class_lengths[VALID] == 0))
+        return last_mb, epoch_ended
+
+    def _update_flags(self):
+        if self.is_slave:
+            return
+        last_mb, epoch_ended = self._epoch_flag_values(
+            self.minibatch_class, self.global_offset)
+        self.last_minibatch.set(last_mb)
+        self.epoch_ended.set(epoch_ended)
 
     def _on_successful_serve(self):
         self.samples_served += self.minibatch_size

@@ -15,6 +15,10 @@ class InteractiveLoader(Loader):
     """Samples arrive at run time; every minibatch is TEST class (no
     labels, no epochs — the graph loops while the feed stays open)."""
 
+    #: serving blocks on a live request queue — there is nothing to
+    #: produce ahead of the waves (and run() is overridden anyway)
+    prefetchable = False
+
     def __init__(self, workflow, sample_shape=None, max_wait=30.0,
                  **kwargs):
         super(InteractiveLoader, self).__init__(workflow, **kwargs)

@@ -1,0 +1,476 @@
+"""Asynchronous input pipeline — prefetch + host↔device overlap for
+streaming loaders.
+
+The reference Veles hid input latency behind its thread-pool dataflow
+engine: loader units decoded the next minibatch while trainer units ran
+the current one between gate waves.  Our deterministic worklist
+scheduler serialized them — every wave paid ``fill_minibatch()`` (host
+decode), normalization and the host→HBM upload *before* the trainer
+could dispatch, which caps throughput at ``1/(decode + step)`` on every
+streaming loader (image / text / hdf5 / pickles / sound).  JAX's async
+dispatch makes the fix cheap: while step *k* computes, this pipeline
+decodes batch *k+1..k+depth* on a background thread and uploads them
+from a second one, so the wave consumes an **already-on-device batch
+handle** and throughput becomes ``1/max(decode, step)``.
+
+Three decoupled stages over a rotating pool of host staging buffers:
+
+1. **fill** — a worker thread walks the loader's serving state machine
+   ahead of the waves (shadow copies of ``global_offset`` /
+   ``samples_served`` / the shuffle permutation, using the loader's own
+   prng so the schedule is bit-for-bit the synchronous one) and runs
+   ``fill_minibatch`` + normalization + label mapping + tail padding
+   against a :class:`_StageView` — a stand-in ``self`` whose
+   ``minibatch_*`` attributes point at pooled staging buffers, so the
+   loader's live ``minibatch_data`` mirror is never mutated mid-step;
+2. **upload** — a second thread issues the host→device transfer for
+   each staged batch (through the trainer's input sharding when one is
+   registered, see :meth:`PrefetchPipeline.set_placement`) and funnels
+   it through a tiny jitted copy: ``jax.device_put`` may *alias* the
+   numpy staging buffer (it does on the CPU backend), and an aliased
+   buffer must never be recycled while a step may still read it — the
+   copy gives the device an independent buffer and bounds the staging
+   pool at ``depth + 3`` sets;
+3. **pop** — the loader's ``run()`` (main thread) dequeues the next
+   ready record and *replays* it: scalar walk state, the minibatch
+   arrays (installed zero-copy via :meth:`Array.adopt`) and — last —
+   the ``last_minibatch`` / ``epoch_ended`` / ``train_ended`` gate
+   Bools, so the flag sequence the Decision unit observes is identical
+   to the synchronous path's.
+
+Teardown: ``Loader.stop()`` (fired by ``Workflow.stop`` on halt) joins
+both threads; a worker exception is forwarded through the queue and
+re-raised on the main thread at the next pop (after an eager close, so
+the flight recorder's thread dump shows no orphaned workers).  Both
+loops also watch a weakref to the loader and exit when it is collected.
+
+Config: ``root.common.loader.prefetch`` ``{enabled, depth}`` (CLI:
+``--prefetch N``); ``depth<=0`` or any non-standalone / cross-process /
+failed-minibatch situation falls back to the synchronous path.
+"""
+
+import queue
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy
+
+from veles_tpu.memory import Array, DEV_DIRTY
+from veles_tpu.loader.base import (
+    INDEX_DTYPE, LABEL_DTYPE, TRAIN, VALID)
+
+#: how long blocking queue ops wait before re-checking liveness (s)
+_TICK = 0.1
+#: pop gives up after this long without a batch AND without live
+#: workers (a stall with live workers keeps waiting — a slow decode
+#: is not an error)
+_DEAD_POLL = 0.5
+
+
+def _prefetch_metrics():
+    from veles_tpu.telemetry import metrics
+    return (
+        metrics.gauge(
+            "veles_prefetch_depth",
+            "configured prefetch depth (ready-queue capacity) per "
+            "loader", ("loader",)),
+        metrics.gauge(
+            "veles_prefetch_occupancy",
+            "ready batches waiting in the prefetch queue at pop time "
+            "(0 = the trainer outruns the decode; depth = fully "
+            "hidden input latency)", ("loader",)),
+        metrics.counter(
+            "veles_prefetch_batches_total",
+            "minibatches served through the asynchronous input "
+            "pipeline", ("loader",)),
+    )
+
+
+_copy_lock = threading.Lock()
+_copy_fn = None
+
+
+def _device_copy():
+    """The jitted identity-copy every prefetched upload funnels
+    through.  ``jax.device_put(numpy_buffer)`` may alias the host
+    buffer (CPU backend) — the staging pool would then corrupt
+    in-flight batches on reuse; ``copy_p`` forces an independent
+    device buffer.  One process-wide instance so every pipeline
+    shares the compile cache."""
+    global _copy_fn
+    with _copy_lock:
+        if _copy_fn is None:
+            from veles_tpu.telemetry import track_jit
+            _copy_fn = track_jit(
+                "loader.prefetch_copy",
+                jax.jit(lambda x: jnp.copy(x)))
+        return _copy_fn
+
+
+class _BufferSet(object):
+    """One rotation slot of the host staging pool: staged Arrays for
+    the fill stage to write into, matching the loader's minibatch
+    array shapes/dtypes."""
+
+    __slots__ = ("data", "labels", "indices", "targets", "raw_labels")
+
+    def __init__(self, loader):
+        self.data = Array(numpy.zeros(
+            loader.minibatch_data.shape, loader.minibatch_data.dtype))
+        self.labels = Array(numpy.zeros(
+            loader.minibatch_labels.shape
+            or (loader.max_minibatch_size,),
+            loader.minibatch_labels.dtype or LABEL_DTYPE))
+        self.indices = Array(numpy.zeros(
+            (loader.max_minibatch_size,), INDEX_DTYPE))
+        targets = getattr(loader, "minibatch_targets", None)
+        self.targets = None
+        if isinstance(targets, Array) and bool(targets):
+            self.targets = Array(numpy.zeros(targets.shape,
+                                             targets.dtype))
+        self.raw_labels = [None] * loader.max_minibatch_size
+
+
+def _make_stage(loader, bufs):
+    """Stand-in ``self`` for the subclass fill path
+    (``fill_minibatch`` / ``_normalize_minibatch`` /
+    ``_map_minibatch_labels`` / ``_pad_tail``): a REAL instance of
+    the loader's class (``__init__`` bypassed) whose ``__dict__`` is
+    a shallow copy of the live unit's with the ``minibatch_*``
+    attributes re-pointed at pooled staging buffers — so the live
+    ``minibatch_data`` mirror is never mutated mid-step, while
+    ``isinstance`` checks, properties and ``super()`` calls inside
+    subclass fill paths keep working.  Dataset storage, the
+    normalizer and class offsets are shared by reference (reads);
+    attribute WRITES land on the stage's own ``__dict__`` so a
+    subclass assigning scratch state on ``self`` cannot race the
+    live unit."""
+    from veles_tpu.mutable import unshadow
+    stage = object.__new__(unshadow(type(loader)))
+    stage.__dict__.update(loader.__dict__)
+    stage.__dict__.pop("_linked_attrs_", None)
+    stage.minibatch_data = bufs.data
+    stage.minibatch_labels = bufs.labels
+    stage.minibatch_indices = bufs.indices
+    if bufs.targets is not None:
+        stage.minibatch_targets = bufs.targets
+    stage.raw_minibatch_labels = bufs.raw_labels
+    return stage
+
+
+class _Record(object):
+    """One produced minibatch: staged buffers + uploaded device
+    arrays + the post-serve scalar/flag state to replay at pop."""
+
+    __slots__ = ("bufs", "cls", "size", "offset", "global_offset",
+                 "samples_served", "epoch_number", "shuffle_limit",
+                 "train_ended", "last_minibatch", "epoch_ended",
+                 "permutation", "dev_data", "dev_labels",
+                 "dev_targets", "data_dev_dirty", "targets_dev_dirty",
+                 "error")
+
+    def __init__(self, error=None):
+        self.error = error
+        self.permutation = None
+        self.dev_data = None
+        self.dev_labels = None
+        self.dev_targets = None
+        self.data_dev_dirty = False
+        self.targets_dev_dirty = False
+
+
+class PrefetchPipeline(object):
+    """The double/triple-buffered asynchronous input pipeline (module
+    docstring).  Owned by a :class:`~veles_tpu.loader.base.Loader`
+    as the volatile ``prefetch_`` attribute; created lazily on the
+    first streaming ``run()`` when the config enables it."""
+
+    def __init__(self, loader, depth):
+        self.depth = max(1, int(depth))
+        self.loader_name = loader.name
+        self._loader_ref = weakref.ref(loader)
+        self._stop = threading.Event()
+        self._installed = None
+
+        # shadow walk state — the worker advances these ahead of the
+        # waves; the loader's own attributes stay at the last POPPED
+        # batch so snapshots capture a resumable position
+        loader.shuffled_indices.map_read()
+        self._indices = numpy.array(loader.shuffled_indices.mem)
+        self._offset = int(loader.global_offset)
+        self._samples = int(loader.samples_served)
+        self._shuffle_limit = loader.shuffle_limit
+        self._pending_perm = None
+
+        # placement: None → plain device_put to the array's bound (or
+        # default) device, matching the synchronous Array._upload;
+        # the trainer registers its input NamedShardings here so the
+        # upload lands pre-sharded (set_placement)
+        self._data_sharding = None
+        self._labels_sharding = None
+        self._targets_sharding = None
+        self._data_device = getattr(
+            loader.minibatch_data, "_device_", None)
+
+        self._free = queue.Queue()
+        for _ in range(self.depth + 3):
+            self._free.put(_BufferSet(loader))
+        self._filled = queue.Queue(maxsize=1)
+        self._ready = queue.Queue(maxsize=self.depth)
+
+        depth_g, self._occupancy_g, self._batches_c = \
+            _prefetch_metrics()
+        depth_g.labels(self.loader_name).set(self.depth)
+        self._occupancy_g = self._occupancy_g.labels(self.loader_name)
+        self._batches_c = self._batches_c.labels(self.loader_name)
+
+        self._fill_thread = threading.Thread(
+            target=self._fill_loop, daemon=True,
+            name="prefetch-fill:%s" % self.loader_name)
+        self._upload_thread = threading.Thread(
+            target=self._upload_loop, daemon=True,
+            name="prefetch-upload:%s" % self.loader_name)
+        self._fill_thread.start()
+        self._upload_thread.start()
+
+    # -- placement -----------------------------------------------------------
+
+    def set_placement(self, data_sharding, labels_sharding=None,
+                      targets_sharding=None):
+        """Trainer hook: upload batches straight into the fused step's
+        input shardings (parallel.sharding.put) so the dispatch-time
+        re-place is a no-op.  Idempotent; applies from the next
+        upload."""
+        self._data_sharding = data_sharding
+        self._labels_sharding = labels_sharding
+        self._targets_sharding = targets_sharding
+
+    # -- the fill stage (worker thread) ---------------------------------------
+
+    def _shuffle_shadow(self, loader):
+        """The epoch-wrap reshuffle against the shadow permutation —
+        same prng stream, same call order as Loader.shuffle(), so the
+        schedule stays bit-identical to the synchronous path."""
+        if loader.class_lengths[TRAIN] == 0:
+            return
+        if self._shuffle_limit is not None:
+            if self._shuffle_limit <= 0:
+                return
+            self._shuffle_limit -= 1
+        loader.prng.shuffle(
+            self._indices[loader.class_end_offsets[VALID]:])
+        # pop installs this copy into loader.shuffled_indices at the
+        # first batch of the new epoch — exactly when the sync path's
+        # shuffle would have become visible
+        self._pending_perm = numpy.array(self._indices)
+
+    def _produce_into(self, loader, bufs):
+        total = loader.effective_total_samples
+        if self._offset >= total:
+            self._offset = 0
+            self._shuffle_shadow(loader)
+        cls, remainder = loader._class_by_offset(self._offset)
+        size = min(remainder, loader.max_minibatch_size)
+        self._offset += size
+        offset = self._offset
+        self._samples += size
+
+        rec = _Record()
+        rec.bufs = bufs
+        rec.cls = cls
+        rec.size = size
+        rec.offset = offset
+        rec.global_offset = self._offset
+        rec.samples_served = self._samples
+        rec.epoch_number = self._samples // total if total else 0
+        rec.shuffle_limit = self._shuffle_limit
+        rec.train_ended = self._offset >= total
+        rec.last_minibatch, rec.epoch_ended = \
+            loader._epoch_flag_values(cls, self._offset)
+        rec.permutation, self._pending_perm = self._pending_perm, None
+
+        stage = _make_stage(loader, bufs)
+        stage.minibatch_offset = offset
+        stage.minibatch_size = size
+        stage.minibatch_class = cls
+        bufs.indices.mem[:size] = self._indices[offset - size:offset]
+        stage.fill_minibatch()
+        stage._normalize_minibatch()
+        stage._map_minibatch_labels()
+        if size < loader.max_minibatch_size:
+            stage._pad_tail(size)
+        return rec
+
+    def _fill_loop(self):
+        while not self._stop.is_set():
+            bufs = self._q_get(self._free)
+            if bufs is None:
+                break
+            loader = self._loader_ref()
+            if loader is None:
+                break
+            try:
+                rec = self._produce_into(loader, bufs)
+            except BaseException as e:  # noqa: B036 — forwarded to pop
+                del loader
+                self._q_put(self._filled, _Record(error=e))
+                break
+            del loader
+            if not self._q_put(self._filled, rec):
+                break
+
+    # -- the upload stage (uploader thread) -----------------------------------
+
+    def _put_copy(self, mem, sharding):
+        """Host staging buffer → independent device buffer: place
+        (sharded when the trainer registered one), then the jitted
+        copy (see _device_copy); block only for the transfer — this
+        thread is off the wave's critical path."""
+        if sharding is not None:
+            from veles_tpu.parallel import sharding as shlib
+            staged = shlib.put(mem, sharding)
+        elif self._data_device is not None:
+            staged = jax.device_put(mem,
+                                    self._data_device.jax_device)
+        else:
+            staged = jax.device_put(mem)
+        out = _device_copy()(staged)
+        out.block_until_ready()
+        return out
+
+    def _upload_rec(self, rec):
+        data = rec.bufs.data
+        if data._devmem_ is not None and data._state == DEV_DIRTY:
+            # a device-gather fill (FullBatchLoader host-fallback
+            # variants) already produced a device buffer — adopt it
+            rec.dev_data = data._devmem_
+            rec.data_dev_dirty = True
+            data._devmem_ = None
+        else:
+            rec.dev_data = self._put_copy(data.mem,
+                                          self._data_sharding)
+        rec.dev_labels = self._put_copy(rec.bufs.labels.mem,
+                                        self._labels_sharding)
+        if rec.bufs.targets is not None:
+            tgt = rec.bufs.targets
+            if tgt._devmem_ is not None and tgt._state == DEV_DIRTY:
+                rec.dev_targets = tgt._devmem_
+                rec.targets_dev_dirty = True
+                tgt._devmem_ = None
+            else:
+                rec.dev_targets = self._put_copy(
+                    tgt.mem, self._targets_sharding)
+
+    def _upload_loop(self):
+        while not self._stop.is_set():
+            rec = self._q_get(self._filled)
+            if rec is None:
+                break
+            if rec.error is None:
+                try:
+                    self._upload_rec(rec)
+                except BaseException as e:  # noqa: B036
+                    rec = _Record(error=e)
+            if not self._q_put(self._ready, rec):
+                break
+            if rec.error is not None:
+                break
+
+    # -- the pop stage (main thread, Loader.run) ------------------------------
+
+    def pop_into(self, loader):
+        """Dequeue the next ready batch and replay it onto the live
+        loader: scalar walk state, buffers (zero-copy Array.adopt),
+        then the gate Bools — identical observable sequence to one
+        synchronous serve."""
+        self._occupancy_g.set(self._ready.qsize())
+        while True:
+            try:
+                rec = self._ready.get(timeout=_DEAD_POLL)
+                break
+            except queue.Empty:
+                if self._stop.is_set() or not (
+                        self._fill_thread.is_alive()
+                        and self._upload_thread.is_alive()):
+                    self.close()
+                    raise RuntimeError(
+                        "prefetch pipeline for %s died without "
+                        "delivering a batch" % self.loader_name)
+        if rec.error is not None:
+            # tear down BEFORE re-raising: the flight recorder's
+            # thread dump must show no orphaned prefetch workers
+            self.close()
+            raise rec.error
+        if self._installed is not None:
+            self._free.put(self._installed.bufs)
+        self._installed = rec
+
+        loader.minibatch_class = rec.cls
+        loader.minibatch_offset = rec.offset
+        loader.minibatch_size = rec.size
+        loader.global_offset = rec.global_offset
+        loader.samples_served = rec.samples_served
+        if not loader.is_slave:
+            loader.epoch_number = rec.epoch_number
+        loader.shuffle_limit = rec.shuffle_limit
+        if rec.permutation is not None:
+            loader.shuffled_indices.mem = rec.permutation
+
+        loader.minibatch_data.adopt(
+            rec.bufs.data.mem, rec.dev_data,
+            dev_dirty=rec.data_dev_dirty)
+        loader.minibatch_labels.adopt(rec.bufs.labels.mem,
+                                      rec.dev_labels)
+        loader.minibatch_indices.adopt(rec.bufs.indices.mem)
+        if rec.bufs.targets is not None:
+            loader.minibatch_targets.adopt(
+                rec.bufs.targets.mem, rec.dev_targets,
+                dev_dirty=rec.targets_dev_dirty)
+        loader.raw_minibatch_labels = rec.bufs.raw_labels
+
+        # flags LAST — successors (Decision) read them after this wave
+        loader.train_ended.set(rec.train_ended)
+        loader.last_minibatch.set(rec.last_minibatch)
+        loader.epoch_ended.set(rec.epoch_ended)
+        self._batches_c.inc()
+
+    # -- liveness-aware queue helpers ------------------------------------------
+
+    def _q_get(self, q):
+        while not self._stop.is_set():
+            try:
+                return q.get(timeout=_TICK)
+            except queue.Empty:
+                if self._loader_ref() is None:
+                    self._stop.set()
+        return None
+
+    def _q_put(self, q, item):
+        while not self._stop.is_set():
+            try:
+                q.put(item, timeout=_TICK)
+                return True
+            except queue.Full:
+                if self._loader_ref() is None:
+                    self._stop.set()
+        return False
+
+    # -- teardown --------------------------------------------------------------
+
+    @property
+    def alive(self):
+        return self._fill_thread.is_alive() \
+            or self._upload_thread.is_alive()
+
+    def close(self, timeout=5.0):
+        """Stop both workers and join them (idempotent).  Queue ops
+        poll the stop event every _TICK, so even a blocked put/get
+        exits within one tick; a worker stuck inside a slow user
+        fill_minibatch finishes that batch first."""
+        self._stop.set()
+        for t in (self._fill_thread, self._upload_thread):
+            if t.is_alive() and t is not threading.current_thread():
+                t.join(timeout)
+        self._occupancy_g.set(0)

@@ -44,6 +44,10 @@ class BytePairVocab:
     """Byte-level BPE vocabulary: ids 0..255 are raw bytes, then
     ``specials``, then learned merges (rank order)."""
 
+    #: bound on the per-chunk encode memo (LRU): a long-lived server
+    #: encoding diverse text must not grow the cache without limit
+    CACHE_LIMIT = 65536
+
     def __init__(self, merges, specials=()):
         #: merge list [(left_id, right_id)] in rank order; merged
         #: token i gets id base + i
@@ -60,7 +64,7 @@ class BytePairVocab:
         for left, right in self.merges:
             toks.append(toks[left] + toks[right])
         self._bytes = toks
-        self._cache = {}
+        self._cache = collections.OrderedDict()
 
     # -- construction --------------------------------------------------------
 
@@ -69,10 +73,13 @@ class BytePairVocab:
         """Learn merges on ``text`` until the vocab reaches
         ``vocab_size`` (or no pair clears ``min_freq``).
 
-        Pair counts are maintained INCREMENTALLY: each merge only
-        touches the words that contain the merged pair, so training a
-        512-token vocab on a multi-megabyte corpus stays seconds, not
-        one full corpus pass per merge."""
+        Pair counts are maintained INCREMENTALLY: each merge still
+        scans the chunk vocabulary for containment (O(unique chunks)
+        per merge), but only the words that actually contain the
+        merged pair are re-tokenized and have their pair counts
+        adjusted — far cheaper than a full corpus re-count per merge,
+        so training a 512-token vocab on a multi-megabyte corpus
+        stays seconds."""
         base = 256 + len(specials)
         if vocab_size < base:
             raise ValueError(
@@ -145,6 +152,7 @@ class BytePairVocab:
     def _encode_chunk(self, chunk):
         ids = self._cache.get(chunk)
         if ids is not None:
+            self._cache.move_to_end(chunk)
             return ids
         seq = list(chunk.encode("utf-8"))
         while len(seq) > 1:
@@ -167,6 +175,8 @@ class BytePairVocab:
                     i += 1
             seq = out
         self._cache[chunk] = seq
+        if len(self._cache) > self.CACHE_LIMIT:
+            self._cache.popitem(last=False)  # evict least-recent
         return seq
 
     def encode(self, text):

@@ -143,6 +143,25 @@ class Array(Pickleable):
             Watcher.alloc(self._watch_key(), value.nbytes)
             self._state = DEV_DIRTY
 
+    def adopt(self, mem, devmem=None, dev_dirty=False):
+        """Install a prepared (host mirror, device buffer) pair
+        WITHOUT copying or invalidating — the buffer-pool handoff of
+        the asynchronous input pipeline (loader/prefetch.py).  Unlike
+        the ``mem`` setter (which marks HOST_DIRTY and forces a
+        re-upload on the next :attr:`devmem` read), both views are
+        taken as already in agreement: consumers get the prefetched
+        device handle with no host↔device traffic on the hot path.
+        ``dev_dirty=True`` records that only the device side is live
+        (a device-gather fill) so :meth:`map_read` still fetches."""
+        self._release_devmem()
+        self._mem = mem
+        self._devmem_ = devmem
+        if devmem is not None:
+            Watcher.alloc(self._watch_key(), devmem.nbytes)
+            self._state = DEV_DIRTY if dev_dirty else COHERENT
+        else:
+            self._state = HOST_DIRTY
+
     def _watch_key(self):
         if self._devmem_ is not None:
             try:

@@ -698,14 +698,26 @@ class GradientDescent(AcceleratedUnit):
         if self._train_step_ is None:
             self._train_step_ = self._build_train_step()
         params, opt_state = self._gather_state()
+        # under the asynchronous input pipeline these devmem reads are
+        # already-on-device batch handles installed at pop time
+        # (loader/prefetch.py) — no synchronous host→HBM upload here
         x = l.minibatch_data.devmem
         labels = l.minibatch_labels.devmem
         targets = getattr(l, "minibatch_targets", None)
-        target = targets.devmem if isinstance(self.evaluator, EvaluatorMSE) \
-            else labels
+        is_mse = isinstance(self.evaluator, EvaluatorMSE)
+        target = targets.devmem if is_mse else labels
         if self._shardings_ is not None:
             from veles_tpu.parallel import sharding as shlib
             _, _, x_sh, tgt_sh, _ = self._shardings_
+            pf = getattr(l, "prefetch_", None)
+            if pf not in (None, False) \
+                    and not shlib.is_cross_process(x_sh):
+                # teach the uploader thread the step's input shardings
+                # so the put below becomes a no-op re-place
+                pf.set_placement(
+                    x_sh,
+                    labels_sharding=None if is_mse else tgt_sh,
+                    targets_sharding=tgt_sh if is_mse else None)
             if shlib.is_cross_process(x_sh):
                 # feed the host mirror directly: putting the local device
                 # buffer would download it again just to re-assemble

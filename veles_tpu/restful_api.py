@@ -84,7 +84,7 @@ class RESTfulAPI(Unit):
     def __init__(self, workflow, loader=None, port=0, host="127.0.0.1",
                  request_timeout=30.0, forwards=None, serving=True,
                  max_slots=4, serving_window=None, max_queue=32,
-                 **kwargs):
+                 max_steps=None, max_batch=None, **kwargs):
         super(RESTfulAPI, self).__init__(workflow, **kwargs)
         self.loader = loader
         self.output = None  # linked from the head forward unit
@@ -104,7 +104,22 @@ class RESTfulAPI(Unit):
         self.max_slots = int(max_slots)
         self.serving_window = serving_window
         self.max_queue = int(max_queue)
+        #: /generate resource caps — an unbounded request would pay a
+        #: giant alloc + a multi-second compile before failing; None
+        #: defers to root.common.api.{max_steps,max_batch}
+        self.max_steps = max_steps
+        self.max_batch = max_batch
         self.demand("loader", "output")
+
+    def _cap(self, name, default):
+        """Resolve a /generate resource cap: constructor override,
+        else ``root.common.api.<name>``, else the built-in default —
+        read per request so ``-c`` overrides apply live."""
+        value = getattr(self, name)
+        if value is None:
+            from veles_tpu.config import root
+            value = root.common.api.get(name, default)
+        return int(value)
 
     def _validate_prompt(self, prompt):
         """Reject malformed /generate prompts with a client error
@@ -295,11 +310,31 @@ class RESTfulAPI(Unit):
                         length = int(
                             self.headers.get("Content-Length", 0))
                         body = json.loads(self.rfile.read(length))
-                        raw = body["prompt"]
+                        raw = body.get("prompt")
+                        if not isinstance(raw, list):
+                            # a scalar / missing / object prompt is a
+                            # CLIENT error, not a 500 (ADVICE r5)
+                            self.send_error(
+                                400, "prompt must be a token list or "
+                                "a batch of token lists")
+                            return
                         squeeze = bool(raw) and \
                             not isinstance(raw[0], list)
                         rows = [raw] if squeeze else list(raw)
-                        lens = [len(r) for r in rows]
+                        max_batch = api._cap("max_batch", 64)
+                        if len(rows) > max_batch:
+                            self.send_error(
+                                400, "batch of %d prompts exceeds "
+                                "max_batch %d" % (len(rows),
+                                                  max_batch))
+                            return
+                        try:
+                            lens = [len(r) for r in rows]
+                        except TypeError:
+                            self.send_error(
+                                400, "prompt rows must be flat "
+                                "lists of token ids")
+                            return
                         if not rows or min(lens, default=0) < 1:
                             self.send_error(
                                 400, "prompt rows must be non-empty "
@@ -338,6 +373,33 @@ class RESTfulAPI(Unit):
                                 400, "steps must be a non-negative "
                                 "int")
                             return
+                        max_steps = api._cap("max_steps", 2048)
+                        if steps > max_steps:
+                            # an unbounded steps request costs a
+                            # giant decode-window alloc + a fresh
+                            # multi-second compile — cap it
+                            self.send_error(
+                                400, "steps %d exceeds max_steps %d"
+                                % (steps, max_steps))
+                            return
+                        try:
+                            temperature = float(
+                                body.get("temperature", 0.0))
+                            top_k = int(body.get("top_k", 0))
+                        except (TypeError, ValueError):
+                            self.send_error(
+                                400, "temperature must be a number "
+                                "and top_k an int")
+                            return
+                        stop = body.get("stop")
+                        if stop is not None:
+                            try:
+                                stop = int(stop)
+                            except (TypeError, ValueError):
+                                self.send_error(
+                                    400, "stop must be an int "
+                                    "token id")
+                                return
                         ragged = min(lens) != width
                         try:
                             beam = int(body.get("beam", 0))
@@ -348,13 +410,12 @@ class RESTfulAPI(Unit):
                             self.send_error(400, "beam must be >= 1")
                             return
                         if beam:
-                            if float(body.get("temperature", 0.0)) \
-                                    or int(body.get("top_k", 0)):
+                            if temperature or top_k:
                                 self.send_error(
                                     400, "beam search is deterministic"
                                     " - drop temperature/top_k")
                                 return
-                            if body.get("stop") is not None:
+                            if stop is not None:
                                 self.send_error(
                                     400, "beam search decodes fixed "
                                     "length - drop stop")
@@ -382,7 +443,6 @@ class RESTfulAPI(Unit):
                                          "scores": scores[0]}
                             self._reply_json(reply)
                             return
-                        stop = body.get("stop")
                         if api.scheduler_ is not None and steps >= 1:
                             # continuous batching: rows join decode
                             # slots independently — NO lock, so
@@ -391,10 +451,7 @@ class RESTfulAPI(Unit):
                                 SchedulerError
                             try:
                                 outs = api._generate_scheduled(
-                                    rows, steps,
-                                    float(body.get("temperature",
-                                                   0.0)),
-                                    int(body.get("top_k", 0)),
+                                    rows, steps, temperature, top_k,
                                     body.get("seed"), stop)
                             except ValueError as e:
                                 self.send_error(400, _status_text(e))
@@ -412,9 +469,7 @@ class RESTfulAPI(Unit):
                                  else outs})
                             return
                         tokens = api._decode(
-                            prompt, steps,
-                            float(body.get("temperature", 0.0)),
-                            int(body.get("top_k", 0)),
+                            prompt, steps, temperature, top_k,
                             body.get("seed"),
                             prompt_lens=lens if ragged else None,
                             stop_token=stop)

@@ -33,7 +33,10 @@ def _compile_metrics():
         metrics.counter(
             "veles_jit_compiles_total",
             "XLA compilations per jitted entry point (first call + "
-            "every recompile on a new shape/dtype)", ("fn",)),
+            "every recompile on a new shape/dtype); cache=\"hit\" "
+            "marks compiles satisfied by the persistent compilation "
+            "cache (fast executable loads), cache=\"cold\" real "
+            "XLA compiles", ("fn", "cache")),
         metrics.counter(
             "veles_jit_calls_total",
             "calls into tracked jitted entry points", ("fn",)),
@@ -159,6 +162,45 @@ def cost_summary():
         return {name: dict(rec) for name, rec in _cost_records.items()}
 
 
+# -- persistent-compilation-cache hit detection ------------------------------
+#
+# jax reports persistent-cache hits through jax.monitoring
+# ("/jax/compilation_cache/cache_hits"); one process-wide listener
+# keeps a running count and _TrackedJit diffs it around each call to
+# label the detected compile "hit" (fast executable load from
+# jax_compilation_cache_dir) vs "cold" (a real XLA compile).
+
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_hits_lock = threading.Lock()
+_cache_hits = 0
+_listener_installed = False
+
+
+def _persistent_cache_hits():
+    with _hits_lock:
+        return _cache_hits
+
+
+def _install_cache_listener():
+    global _listener_installed
+    with _hits_lock:
+        if _listener_installed:
+            return
+        _listener_installed = True
+    try:
+        import jax
+
+        def _on_event(event, **kwargs):
+            global _cache_hits
+            if event == _CACHE_HIT_EVENT:
+                with _hits_lock:
+                    _cache_hits += 1
+
+        jax.monitoring.register_event_listener(_on_event)
+    except Exception:  # pragma: no cover - jax without monitoring
+        pass
+
+
 class _TrackedJit:
     """Callable proxy over a jitted function counting compiles.
 
@@ -170,11 +212,12 @@ class _TrackedJit:
         self.fn = fn
         functools.update_wrapper(self, fn, updated=())
         compiles, calls, hist, first = _compile_metrics()
-        self._compiles = compiles.labels(name)
+        self._compiles_family = compiles
         self._calls = calls.labels(name)
         self._hist = hist.labels(name)
         self._first = first.labels(name)
         self._seen_compile = False
+        _install_cache_listener()
 
     def _cache_len(self):
         probe = getattr(self.fn, "_cache_size", None)
@@ -187,6 +230,7 @@ class _TrackedJit:
 
     def __call__(self, *args, **kwargs):
         before = self._cache_len()
+        hits_before = _persistent_cache_hits()
         t0 = time.perf_counter()
         out = self.fn(*args, **kwargs)
         self._calls.inc()
@@ -194,7 +238,11 @@ class _TrackedJit:
             after = self._cache_len()
             if after is not None and after > before:
                 dt = time.perf_counter() - t0
-                self._compiles.inc(after - before)
+                kind = "hit" \
+                    if _persistent_cache_hits() > hits_before \
+                    else "cold"
+                self._compiles_family.labels(self.name, kind).inc(
+                    after - before)
                 self._hist.observe(dt)
                 if not self._seen_compile:
                     self._seen_compile = True
@@ -232,11 +280,16 @@ def track_jit(name, fn):
 
 
 def compile_summary():
-    """Per-entry-point compile digest — ``{name: {compiles, calls,
-    first_compile_s, compile_seconds_total}}`` plus a ``total`` rollup;
-    what ``bench.py`` records next to throughput."""
+    """Per-entry-point compile digest — ``{name: {compiles,
+    compiles_persistent_hit, calls, first_compile_s,
+    compile_seconds_total}}`` plus a ``total`` rollup; what
+    ``bench.py`` records next to throughput.  ``compiles`` counts
+    every executable materialization; ``compiles_persistent_hit`` the
+    subset served by the on-disk compilation cache (cheap loads, not
+    real XLA compiles)."""
     out = {}
     total_compiles = 0
+    total_hits = 0
     total_seconds = 0.0
     fam_compiles = metrics.get("veles_jit_compiles_total")
     fam_calls = metrics.get("veles_jit_calls_total")
@@ -244,20 +297,27 @@ def compile_summary():
     fam_first = metrics.get("veles_jit_first_compile_seconds")
     if fam_compiles is None:
         return {"total": {"compiles": 0, "compile_seconds": 0.0}}
-    for (name,), child in sorted(fam_compiles.children().items()):
-        compiles = int(child.value)
+    per_fn = {}
+    for (name, kind), child in fam_compiles.children().items():
+        agg = per_fn.setdefault(name, {"cold": 0, "hit": 0})
+        agg[kind] = agg.get(kind, 0) + int(child.value)
+    for name, agg in sorted(per_fn.items()):
+        compiles = agg["cold"] + agg["hit"]
         hist = fam_hist.labels(name)
         calls = fam_calls.labels(name)
         first = fam_first.labels(name)
         total_compiles += compiles
+        total_hits += agg["hit"]
         total_seconds += hist.sum
         out[name] = {
             "compiles": compiles,
+            "compiles_persistent_hit": agg["hit"],
             "calls": int(calls.value),
             "first_compile_s": round(first.value, 4),
             "compile_seconds_total": round(hist.sum, 4),
         }
     out["total"] = {"compiles": total_compiles,
+                    "compiles_persistent_hit": total_hits,
                     "compile_seconds": round(total_seconds, 4)}
     return out
 
