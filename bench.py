@@ -774,6 +774,156 @@ def bench_serving(dev, steps=64, clients=8, max_slots=4):
         sch.close()
 
 
+def _serving_chain(dev, d_model, layers, heads, vocab, window, name):
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.memory import Array
+    from veles_tpu.models.standard import make_forwards
+    wf = AcceleratedWorkflow(None, name=name)
+    spec = [{"type": "embedding", "vocab": vocab, "dim": d_model}]
+    spec += [{"type": "transformer_block", "heads": heads,
+              "causal": True} for _ in range(layers)]
+    spec += [{"type": "token_logits", "vocab": vocab}]
+    fw = make_forwards(wf, Array(numpy.zeros((1, window),
+                                             numpy.int32)), spec)
+    for u in fw:
+        u.initialize(device=dev)
+    return fw
+
+
+def bench_serving_sweep(dev):
+    """Paged-KV + chunked-prefill sweep (the PR-5 serving engine):
+
+    - ``serving_decode_tokens_per_sec`` — packed-bucket decode
+      throughput at 1 slot / 25% / 50% / 100% occupancy (the
+      occupancy buckets mean a half-empty batch pays a smaller
+      executable, so low-occupancy throughput-per-stream must not
+      crater the way a fixed full-slot step's would);
+    - ``serving_ttft_p95_ms_mixed`` vs ``_oneshot`` — p95
+      time-to-first-token of short probes submitted BEHIND long
+      prompts, chunked prefill on vs off (the Sarathi win: the long
+      prefill no longer monopolizes the loop);
+    - ``serving_max_streams_paged`` vs ``_dense`` — concurrent
+      streams actually decoding for the SAME KV HBM budget
+      (block-proportional vs window-per-slot admission).
+
+    Sized down hard on CPU so driver runs stay fast."""
+    from veles_tpu.serving import InferenceScheduler
+
+    cpu = dev.jax_device.platform == "cpu"
+    if cpu:
+        d_model, layers, heads, vocab = 64, 2, 2, 256
+        window, block, max_slots = 128, 16, 8
+        steps, p_short, p_long = 24, 8, 112
+    else:
+        d_model, layers, heads, vocab = 1024, 8, 8, 32768
+        window, block, max_slots = 1024, 16, 8
+        steps, p_short, p_long = 128, 64, 896
+    fw = _serving_chain(dev, d_model, layers, heads, vocab, window,
+                        "bench-serving-sweep")
+    rng = numpy.random.default_rng(0)
+    short = rng.integers(0, vocab, (p_short,)).tolist()
+    long_p = rng.integers(0, vocab, (p_long,)).tolist()
+    out = {}
+
+    # -- occupancy sweep: decode throughput at 1/25/50/100% ----------
+    sch = InferenceScheduler(
+        fw, max_slots=max_slots, window=window, max_queue=4 * max_slots,
+        queue_timeout=600.0, kv="paged", block_size=block,
+        prefill_chunk=0).start()
+    try:
+        sch.submit(short, steps).result(600)   # prefill-width warmup
+        occ = {}
+        for n in sorted({1, max_slots // 4, max_slots // 2,
+                         max_slots}):
+            t0 = time.perf_counter()
+            futs = [sch.submit(short, steps, seed=i)
+                    for i in range(n)]
+            toks = sum(len(f.result(600)) - p_short for f in futs)
+            occ["occ_%d" % (100 * n // max_slots)] = round(
+                toks / (time.perf_counter() - t0), 1)
+        out["serving_decode_tokens_per_sec"] = occ
+    finally:
+        sch.close()
+
+    # -- mixed traffic: short-probe TTFT behind long prefills --------
+    def ttft_p95(chunk):
+        sch = InferenceScheduler(
+            fw, max_slots=4, window=window, max_queue=64,
+            queue_timeout=600.0, kv="paged", block_size=block,
+            prefill_chunk=chunk).start()
+        try:
+            # warm both prefill shapes out of the timed region
+            sch.submit(long_p, 1).result(600)
+            sch.submit(short, 1).result(600)
+            lat = []
+            for _ in range(3):
+                noise = [sch.submit(long_p, steps // 2, seed=1)
+                         for _ in range(2)]
+                probes = []
+                for i in range(6):
+                    t0 = time.perf_counter()
+                    probes.append((t0, sch.submit(short, 1, seed=i)))
+                for t0, f in probes:
+                    f.result(600)
+                    lat.append((time.perf_counter() - t0) * 1e3)
+                for f in noise:
+                    f.result(600)
+            lat.sort()
+            return lat[max(0, int(len(lat) * 0.95) - 1)], \
+                sch.metrics()["prefill_chunks"]
+        finally:
+            sch.close()
+
+    chunk = max(block, window // 8)
+    p95_chunked, chunks = ttft_p95(chunk)
+    p95_oneshot, _ = ttft_p95(0)
+    out["serving_ttft_p95_ms_mixed"] = round(p95_chunked, 2)
+    out["serving_ttft_p95_ms_oneshot"] = round(p95_oneshot, 2)
+    out["serving_prefill_chunks"] = chunks
+    out["serving_prefill_chunk_tokens"] = chunk
+
+    # -- admission capacity for the SAME KV HBM budget ---------------
+    # dense reserves window tokens per slot: budget = dense_slots x
+    # window tokens.  paged spends the same budget in blocks, so
+    # short streams pack block-proportionally.
+    dense_slots = max_slots // 2
+    budget_blocks = dense_slots * (window // block)
+    per_req = -(-(p_short + steps) // block)
+    paged_cap = min(4 * max_slots, budget_blocks // per_req)
+
+    def peak_streams(**kw):
+        sch = InferenceScheduler(
+            fw, window=window, max_queue=8 * max_slots,
+            queue_timeout=600.0, prefill_chunk=0,
+            warm_buckets=False, **kw).start()
+        try:
+            futs = [sch.submit(short, steps, seed=i)
+                    for i in range(paged_cap)]
+            peak = 0
+            while any(not f.done() for f in futs):
+                peak = max(peak, sch.metrics()["active_slots"])
+                time.sleep(0.005)
+            for f in futs:
+                f.result(600)
+            return peak
+        finally:
+            sch.close()
+
+    out["serving_max_streams_dense"] = peak_streams(
+        kv="dense", max_slots=dense_slots)
+    out["serving_max_streams_paged"] = peak_streams(
+        kv="paged", max_slots=paged_cap, block_size=block,
+        kv_blocks=budget_blocks)
+    out["serving_sweep_config"] = {
+        "d_model": d_model, "layers": layers, "heads": heads,
+        "vocab": vocab, "window": window, "block_size": block,
+        "max_slots": max_slots, "steps": steps,
+        "prompt_short": p_short, "prompt_long": p_long,
+        "kv_budget_blocks": budget_blocks,
+        "prefill_chunk": chunk}
+    return out
+
+
 def bench_input_pipeline(dev, steps=40, depth=2):
     """Asynchronous input pipeline (loader/prefetch.py): a synthetic
     SLOW streaming loader — ``fill_minibatch`` sleeps ``decode_ms``
@@ -948,6 +1098,10 @@ def main():
         serving = bench_serving(dev)
     except Exception as e:       # serving rides the same guard
         serving = {"serving_error": repr(e)[:300]}
+    try:
+        serving_sweep = bench_serving_sweep(dev)
+    except Exception as e:
+        serving_sweep = {"serving_sweep_error": repr(e)[:300]}
     mlp_sps, mlp_aud = bench_mlp(dev)
     try:
         input_pipe = bench_input_pipeline(dev)
@@ -990,6 +1144,7 @@ def main():
     record.update(longctx)
     record.update(decode)
     record.update(serving)
+    record.update(serving_sweep)
     record.update(input_pipe)
     record.update(allreduce)
     if dp:
@@ -1047,14 +1202,16 @@ def main():
         "lm_mfu", "longcontext_tokens_per_sec",
         "decode_tokens_per_sec", "decode_kv_speedup",
         "serving_ttft_ms", "serving_concurrent_tokens_per_sec",
-        "serving_slot_occupancy", "input_pipeline_speedup",
+        "serving_slot_occupancy", "serving_ttft_p95_ms_mixed",
+        "serving_ttft_p95_ms_oneshot", "serving_max_streams_dense",
+        "serving_max_streams_paged", "input_pipeline_speedup",
         "input_pipeline_decode_ms", "allreduce_p50_us",
         "allreduce_substrate", "allreduce_quality",
         "dp_samples_per_sec", "compile_seconds_total",
         "compiles_total", "flops_per_step", "hbm_bytes_per_step",
         "health_status", "health_nonfinite_total",
         "lm_error", "decode_error", "serving_error",
-        "input_pipeline_error")
+        "serving_sweep_error", "input_pipeline_error")
     compact = {k: record[k] for k in compact_keys if k in record}
     compact["full_record"] = "BENCH.json"
     print(json.dumps(compact))

@@ -49,6 +49,29 @@ def test_all_jax_jit_sites_are_tracked():
         "allowlist with a reason:\n" + "\n".join(untracked))
 
 
+#: stable track_jit names the serving subsystem must register its
+#: compiled entry points under — bench and the compile dashboards key
+#: on them, and an unregistered paged-attention jit would silently
+#: escape veles_jit_* cost accounting
+SERVING_ENTRY_POINTS = (
+    ("serving/engine.py", "serving.slot_step"),
+    ("serving/engine.py", "serving.paged_step"),
+    ("serving/engine.py", "serving.sample_first"),
+    ("serving/prefill.py", "serving.prefill"),
+    ("serving/prefill.py", "serving.prefill_chunk"),
+    ("serving/kv_slots.py", "serving.kv_insert_row"),
+    ("serving/kv_slots.py", "serving.kv_insert_blocks"),
+)
+
+
+def test_serving_jit_entry_points_registered():
+    for rel, name in SERVING_ENTRY_POINTS:
+        text = (PKG / rel).read_text()
+        assert 'track_jit("%s"' % name in text, (
+            "%s must register its compiled entry point with "
+            'track_jit("%s", jax.jit(...))' % (rel, name))
+
+
 def test_guard_allowlist_entries_still_exist():
     """A stale allowlist entry means the exception it documented is
     gone — prune it so it can't mask a future regression."""

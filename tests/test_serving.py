@@ -1,8 +1,11 @@
 """Continuous-batching serving subsystem (``veles_tpu/serving/``):
-batched prefill parity, slot-step shapes, scheduler semantics,
-admission control, and the REST concurrency soak."""
+batched/chunked prefill parity, slot-step shapes, the paged KV cache
+(block churn, paged-vs-dense token parity, memory-proportional
+admission), scheduler semantics, admission control, and the REST
+concurrency soak."""
 
 import json
+import random
 import threading
 import time
 import urllib.error
@@ -15,6 +18,8 @@ import pytest
 from veles_tpu.backends import Device
 from veles_tpu.config import root
 from veles_tpu.memory import Array
+
+pytestmark = pytest.mark.serving
 
 
 @pytest.fixture
@@ -126,6 +131,178 @@ def test_slot_step_matches_scalar_step(f32):
         numpy.testing.assert_allclose(
             numpy.asarray(c_scalar[part]),
             numpy.asarray(c_slots[part]), atol=1e-6)
+
+
+# -- paged KV cache -----------------------------------------------------------
+
+def test_paged_cache_block_churn(f32):
+    """Alloc/free under randomized churn never double-frees, leaks or
+    double-owns a block; exhaustion returns None; a full drain
+    restores the whole pool."""
+    from veles_tpu.serving.kv_slots import PagedKVCache
+    fw = _tiny_fw("paged-churn", window=32)
+    cache = PagedKVCache(fw, max_slots=4, window=32, block_size=4,
+                         kv_blocks=16)
+    assert cache.free_blocks == 16 and cache.used_blocks == 0
+    rng = random.Random(7)
+    live = []
+    for _ in range(200):
+        if live and (rng.random() < 0.45 or len(live) == 4):
+            cache.release(live.pop(rng.randrange(len(live))))
+        else:
+            slot = cache.alloc(rng.randrange(1, 33))
+            if slot is not None:
+                live.append(slot)
+        cache.check()
+    for slot in live:
+        cache.release(slot)
+    cache.check()
+    assert cache.free_blocks == 16 and cache.used_blocks == 0
+    assert cache.free_slots == 4
+    # double-free is a loud programming error, not silent corruption
+    slot = cache.alloc(8)
+    cache.release(slot)
+    with pytest.raises(ValueError, match="double-freed"):
+        cache.release(slot)
+    # a request longer than the per-slot table is a programming error
+    with pytest.raises(ValueError, match="table width"):
+        cache.alloc(60)
+    # block exhaustion: slots free but no memory -> no admission
+    a = cache.alloc(32)   # 8 blocks
+    b = cache.alloc(28)   # 7 blocks -> 1 of 16 left
+    assert a is not None and b is not None
+    assert cache.free_blocks == 1 and cache.free_slots == 2
+    assert not cache.can_admit(8)
+    assert cache.alloc(8) is None
+    assert cache.can_admit(4) and cache.alloc(4) is not None
+    cache.check()
+
+
+def test_paged_vs_dense_token_parity(f32):
+    """Acceptance: the paged cache (multi-block tables, packed
+    occupancy buckets) and chunked prefill produce token streams
+    IDENTICAL to the dense slot cache — greedy and seeded sampling,
+    ragged prompts decoding concurrently."""
+    from veles_tpu.models.generate import generate
+    from veles_tpu.serving import InferenceScheduler
+    fw = _tiny_fw("paged-parity", blocks=2)
+    prompts = [[3, 1, 4], [5], [7, 2, 9, 1], [2, 2], [11, 3, 5]]
+
+    def run(**kw):
+        sch = InferenceScheduler(fw, max_slots=3, window=16,
+                                 **kw).start()
+        try:
+            futs = [sch.submit(p, 5, seed=0) for p in prompts]
+            futs += [sch.submit(p, 5, temperature=0.9, top_k=5,
+                                seed=13 + i)
+                     for i, p in enumerate(prompts)]
+            return [f.result(240) for f in futs]
+        finally:
+            sch.close()
+
+    dense = run(kv="dense", prefill_chunk=0)
+    paged = run(kv="paged", block_size=4, prefill_chunk=0)
+    assert paged == dense
+    # chunked prefill on top: chunks of 2 over the same prompts
+    chunked = run(kv="paged", block_size=4, prefill_chunk=2)
+    assert chunked == dense
+    # and the dense path still equals the reference generate()
+    for p, out in zip(prompts, dense):
+        ref = numpy.asarray(generate(
+            fw, numpy.asarray([p], numpy.int32), 5,
+            kv_cache=True))[0].tolist()
+        assert out == ref, (p, out, ref)
+
+
+def test_paged_memory_admission(f32):
+    """Admission is memory-proportional: a request queues while the
+    block pool is exhausted (even with slots free) and joins once
+    blocks release; an over-pool request is a client error at
+    submit."""
+    from veles_tpu.serving import InferenceScheduler
+    fw = _tiny_fw("paged-mem", window=16)
+    sch = InferenceScheduler(fw, max_slots=4, window=16,
+                             kv="paged", block_size=4, kv_blocks=3,
+                             prefill_chunk=0).start()
+    try:
+        with pytest.raises(ValueError, match="kv_blocks"):
+            sch.submit([1] * 8, 6)            # 14 tokens > 12-token pool
+        a = sch.submit([1, 2, 3, 4], 4)       # 8 tokens = 2 blocks
+        b = sch.submit([5, 6, 7], 5)          # 8 tokens = 2 blocks
+        assert len(a.result(240)) == 8
+        assert len(b.result(240)) == 8        # admitted after a freed
+        snap = sch.metrics()
+        assert snap["kv_mode"] == "paged"
+        assert snap["kv_blocks_total"] == 3
+        assert snap["kv_blocks_used"] == 0    # drained
+        assert snap["kv_blocks_free"] == 3
+    finally:
+        sch.close()
+
+
+# -- chunked prefill ----------------------------------------------------------
+
+def test_chunked_prefill_matches_oneshot(f32):
+    """Chunk-by-chunk prefill reproduces the one-shot pass: identical
+    staging K/V rows and last-position logits (the first-token
+    edge)."""
+    from veles_tpu import dtypes
+    from veles_tpu.serving import prefill, prefill_chunk
+    fw = _tiny_fw("chunked", blocks=2)
+    p = [3, 1, 4, 1, 5, 9, 2]
+    w, c = 8, 2
+    padded = numpy.zeros((1, w), numpy.int32)
+    padded[0, :len(p)] = p
+    ref_caches, ref_last = prefill(fw, padded, prompt_lens=[len(p)],
+                                   window=w)
+    caches = {i: u.init_cache(1, w, dtypes.compute_dtype())
+              for i, u in enumerate(fw) if hasattr(u, "init_cache")}
+    off = 0
+    while off < len(p):
+        end = min(off + c, len(p))
+        chunk = numpy.zeros((1, c), numpy.int32)
+        chunk[0, :end - off] = p[off:end]
+        kw = c
+        while kw < off + c:
+            kw *= 2
+        caches, last = prefill_chunk(fw, chunk, off, [end - off],
+                                     caches, key_width=min(kw, w))
+        off = end
+    for i in ref_caches:
+        for part in ("k", "v"):
+            numpy.testing.assert_allclose(
+                numpy.asarray(caches[i][part]),
+                numpy.asarray(ref_caches[i][part]), atol=1e-5,
+                err_msg="layer %d %s" % (i, part))
+    numpy.testing.assert_allclose(numpy.asarray(last),
+                                  numpy.asarray(ref_last), atol=1e-4)
+
+
+def test_chunked_prefill_interleaves_decode(f32):
+    """A long prompt joining mid-traffic prefills in chunks: the
+    chunk counters move, short in-flight requests keep decoding, and
+    the long request's output still equals its solo decode."""
+    from veles_tpu.models.generate import generate
+    from veles_tpu.serving import InferenceScheduler
+    fw = _tiny_fw("chunked-mix", window=64)
+    sch = InferenceScheduler(fw, max_slots=2, window=64, kv="paged",
+                             block_size=8, prefill_chunk=8).start()
+    try:
+        short = sch.submit([4, 2], 30)
+        long_p = list(range(1, 12)) * 3     # 33 tokens, 5 chunks
+        long_p = [t % 12 for t in long_p]
+        fut = sch.submit(long_p, 6)
+        out = fut.result(240)
+        ref = numpy.asarray(generate(
+            fw, numpy.asarray([long_p], numpy.int32), 6,
+            kv_cache=True))[0].tolist()
+        assert out == ref
+        assert len(short.result(240)) == 32
+        snap = sch.metrics()
+        assert snap["prefill_chunks"] >= 5
+        assert snap["prefill_chunk_tokens"] >= 33
+    finally:
+        sch.close()
 
 
 # -- scheduler ----------------------------------------------------------------
@@ -327,6 +504,12 @@ def test_rest_serving_concurrent_soak(f32):
         assert snap["tokens_generated"] >= steps * n_clients
         assert 0.0 < snap["slot_occupancy"] <= 1.0
         assert snap["ttft_ms_p50"] is not None
+        # operators watch block headroom for admission pressure: all
+        # requests drained, so every block is back in the free pool
+        assert snap["kv_mode"] == "paged"
+        assert snap["kv_blocks_used"] == 0
+        assert snap["kv_blocks_free"] == snap["kv_blocks_total"] > 0
+        assert snap["queue_depth"] == 0
     finally:
         api.stop()
         loader.close()

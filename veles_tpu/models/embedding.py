@@ -86,6 +86,23 @@ class Embedding(ForwardBase):
             y = y + row[None]
         return y
 
+    def apply_chunk(self, params, x, offset):
+        """Chunked-prefill lookup: x [batch, C] token ids occupying
+        sequence positions [offset, offset+C) (``offset`` traced).
+        The positional rows are gathered per index with clamping, so a
+        tail chunk whose padding overruns the learned table reads a
+        (masked-off) clamped row instead of shifting valid rows the
+        way a clamped dynamic_slice would."""
+        from veles_tpu import dtypes
+        cd = dtypes.compute_dtype()
+        y = jnp.take(params["weights"].astype(cd),
+                     x.astype(jnp.int32), axis=0)
+        if self.learned_positions:
+            rows = jnp.take(params["positions"].astype(cd),
+                            offset + jnp.arange(x.shape[1]), axis=0)
+            y = y + rows[None]
+        return y
+
     def apply_step_slots(self, params, x, pos):
         """Per-slot decode step (serving path): x [batch, 1] token
         ids where row n sits at ITS OWN sequence index ``pos[n]``

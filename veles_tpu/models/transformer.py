@@ -174,21 +174,27 @@ class TransformerBlock(ForwardBase):
 
         return proj("wq"), proj("wk"), proj("wv")
 
-    def _attn_out(self, params, x, probs, vh):
-        """probs·V + output projection + residual + FFN half (the
-        shared tail of every decode-step variant)."""
+    def _attn_tail(self, params, x, o):
+        """Output projection + residual + FFN half over an attention
+        context ``o`` [b, s, d] (the shared tail of every decode-step
+        variant; the paged step computes ``o`` in
+        ``ops.paged_attention``)."""
         from veles_tpu import dtypes
         cd = dtypes.compute_dtype()
         ad = dtypes.accum_dtype()
         prec = dtypes.matmul_precision()
-        b, s, d = x.shape
-        o = jnp.einsum("bhqk,bkhd->bqhd", probs, vh).reshape(b, s, d)
         attn = jnp.einsum("bsd,de->bse", o.astype(cd),
                           params["wo"].astype(cd), precision=prec,
                           preferred_element_type=ad).astype(x.dtype)
         y = x + attn
         return y + self._ffn(params, _layer_norm(
             y, params["ln2_scale"], params["ln2_bias"]))
+
+    def _attn_out(self, params, x, probs, vh):
+        """probs·V + the shared tail."""
+        b, s, d = x.shape
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs, vh).reshape(b, s, d)
+        return self._attn_tail(params, x, o)
 
     def apply_prefill(self, params, x, cache, lens=None):
         """Batched prompt prefill: consume ALL of x [batch, P, d] in
@@ -230,6 +236,76 @@ class TransformerBlock(ForwardBase):
         probs = jax.nn.softmax(logits, axis=-1)
         return self._attn_out(params, x, probs, vh), \
             {"k": ck, "v": cv}
+
+    def apply_prefill_chunk(self, params, x, cache, offset,
+                            chunk_lens=None, key_width=None):
+        """CHUNKED prefill continuation: consume x [b, C, d] — the
+        prompt's positions [offset, offset+C) (``offset`` a traced
+        scalar, a multiple of C) — writing the chunk's K/V into cache
+        rows [offset, offset+C) and attending each query over cached
+        keys [0, key_width) with the causal mask ``key ≤ offset + q``.
+        Chunk-for-chunk the same math as :meth:`apply_prefill` (which
+        is the offset-0, single-chunk special case), so running the
+        chunks sequentially reproduces the one-shot cache rows and
+        last-position logits.
+
+        ``chunk_lens`` (optional [b] ints, traced): rows whose prompt
+        ends inside this chunk — K/V rows at or past
+        ``offset + chunk_lens[n]`` are ZEROED (matching the staging
+        cache's init zeros) and output rows past the length are
+        garbage the caller must not read.  ``key_width`` (static int,
+        default the cache width) bounds the attended key range — the
+        caller buckets it to a power of two ≥ offset + C so shallow
+        chunks don't pay full-window attention."""
+        from veles_tpu import dtypes
+        cd = dtypes.compute_dtype()
+        b, c, d = x.shape
+        h = self.heads
+        hd = d // h
+        q, k_new, v_new = self._qkv(params, x)
+        if chunk_lens is not None:
+            keep = (jnp.arange(c)[None, :]
+                    < chunk_lens[:, None])[..., None]
+            k_new = jnp.where(keep, k_new, 0).astype(k_new.dtype)
+            v_new = jnp.where(keep, v_new, 0).astype(v_new.dtype)
+        ck = jax.lax.dynamic_update_slice(
+            cache["k"], k_new.astype(cache["k"].dtype),
+            (jnp.int32(0), offset, jnp.int32(0)))
+        cv = jax.lax.dynamic_update_slice(
+            cache["v"], v_new.astype(cache["v"].dtype),
+            (jnp.int32(0), offset, jnp.int32(0)))
+        kw = int(key_width or ck.shape[1])
+        qh = q.reshape(b, c, h, hd)
+        kh = ck[:, :kw].astype(cd).reshape(b, kw, h, hd)
+        vh = cv[:, :kw].astype(cd).reshape(b, kw, h, hd)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) \
+            * (1.0 / jnp.sqrt(hd))
+        mask = (jnp.arange(kw)[None, :]
+                <= (offset + jnp.arange(c))[:, None])[None, None]
+        logits = jnp.where(mask, logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return self._attn_out(params, x, probs, vh), \
+            {"k": ck, "v": cv}
+
+    def init_block_pool(self, num_blocks, block_size, dtype):
+        """Zeroed paged K/V pools, [num_blocks, block_size, d] each —
+        the block-granular counterpart of :meth:`init_cache` (see
+        serving/kv_slots.PagedKVCache)."""
+        return self.init_cache(num_blocks, block_size, dtype)
+
+    def apply_step_paged(self, params, x, pos, tables, pool):
+        """Decode ONE position PER ROW against a PAGED KV pool: x
+        [batch, 1, d] with row n at sequence index ``pos[n]``, reading
+        and writing through ``tables`` [batch, T] physical block ids
+        (serving/kv_slots.PagedKVCache).  Row-for-row the same math as
+        :meth:`apply_step_slots` restricted to the gathered blocks —
+        greedy token parity with the dense slot cache is tested."""
+        from veles_tpu.ops.paged_attention import paged_decode_attention
+        q, k_new, v_new = self._qkv(params, x)
+        pk, pv, o = paged_decode_attention(
+            q, k_new, v_new, pool["k"], pool["v"], tables, pos,
+            self.heads)
+        return self._attn_tail(params, x, o), {"k": pk, "v": pv}
 
     def apply_step_slots(self, params, x, pos, cache):
         """Decode ONE position PER ROW: x [batch, 1, d] where row n

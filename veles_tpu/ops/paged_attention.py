@@ -1,0 +1,77 @@
+"""Block-table (paged) decode attention — the serving-side attention
+core over a PagedAttention-style KV layout (Kwon et al., SOSP 2023).
+
+K/V live in per-layer POOLS of fixed-size blocks
+(``[num_blocks, block_size, d]``); a request owns a *block table* — the
+ordered list of physical block ids holding its sequence — instead of a
+dense ``[window, d]`` row.  The decode step then
+
+- **scatters** the new token's K/V into ``table[pos // bs]`` at row
+  ``pos % bs`` (each live block belongs to exactly ONE slot, so the
+  scatter never races another request), and
+- **gathers** only the table's blocks — ``[B, T·bs, d]`` where ``T``
+  is the caller's *block bucket* (power-of-two over the deepest active
+  slot), not the full window — before the usual masked softmax.
+
+Table entries past a slot's live blocks point at physical block 0 (the
+reserved TRASH block — never allocated to a request), so the gather
+reads garbage that the causal mask (`key ≤ pos`) zeroes exactly:
+``softmax`` turns the ``-inf`` scores into probability 0.0, and
+``0.0 · v`` contributes nothing for any finite v (pools start zeroed
+and only ever receive finite projections).  Padding rows of an
+occupancy bucket follow the same convention: an all-zero table writes
+into and reads from the trash block.
+
+The math is row-for-row the dense per-slot step
+(``TransformerBlock.apply_step_slots``) restricted to the gathered
+key range — same projection dtypes, 1/sqrt(hd) scale and softmax
+conventions — so greedy token streams are identical to the dense slot
+cache (tested in tests/test_serving.py).  This jnp formulation lowers
+to a gather + batched GEMM on every backend; a fused pallas kernel
+(keeping the gathered blocks in VMEM) would slot in behind the same
+signature, the way ``ops/flash.py`` fronts the training attention.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def paged_decode_attention(q, k_new, v_new, pool_k, pool_v, tables,
+                           pos, heads):
+    """One decode position per row against a paged KV pool.
+
+    ``q``/``k_new``/``v_new`` [B, 1, d] — the new token's projections
+    (row n at ITS OWN sequence index ``pos[n]``); ``pool_k``/``pool_v``
+    [num_blocks, block_size, d]; ``tables`` [B, T] physical block ids
+    in sequence order (T·block_size must cover ``max(pos) + 1``);
+    ``pos`` [B] ints, traced.
+
+    Returns ``(pool_k', pool_v', context)`` — the pools with the new
+    K/V scattered in, and the attention context [B, 1, d] (same dtype
+    conventions as the dense slot step)."""
+    from veles_tpu import dtypes
+    cd = dtypes.compute_dtype()
+    b, _, d = q.shape
+    h = heads
+    hd = d // h
+    bs = pool_k.shape[1]
+    blk = jnp.take_along_axis(tables, (pos // bs)[:, None], axis=1)[:, 0]
+    off = pos % bs
+    pk = pool_k.at[blk, off].set(k_new[:, 0].astype(pool_k.dtype))
+    pv = pool_v.at[blk, off].set(v_new[:, 0].astype(pool_v.dtype))
+    # gather ONLY the table's blocks — [B, T, bs, d] -> [B, T·bs, d];
+    # the window never materializes
+    kg = pk[tables]
+    vg = pv[tables]
+    length = kg.shape[1] * bs
+    qh = q.reshape(b, 1, h, hd)
+    kh = kg.astype(cd).reshape(b, length, h, hd)
+    vh = vg.astype(cd).reshape(b, length, h, hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) \
+        * (1.0 / jnp.sqrt(hd))
+    mask = (jnp.arange(length)[None, :]
+            <= pos[:, None])[:, None, None, :]
+    logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return pk, pv, jnp.einsum("bhqk,bkhd->bqhd", probs,
+                              vh).reshape(b, 1, d)

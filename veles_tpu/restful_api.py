@@ -74,9 +74,11 @@ class RESTfulAPI(Unit):
     token boundary, so concurrent clients genuinely interleave — there
     is no decode lock on this path.  Admission control surfaces as
     HTTP 503 (queue full) / 408 (queue deadline), and GET
-    /serving/metrics reports TTFT, throughput, queue depth and slot
-    occupancy.  Beam requests (and chains the scheduler cannot serve)
-    fall back to the serialized legacy decode.
+    /serving/metrics reports TTFT, throughput, queue depth, slot
+    occupancy and free/used KV blocks — the memory-pressure headroom
+    that predicts admission stalls under the paged cache.  Beam
+    requests (and chains the scheduler cannot serve) fall back to the
+    serialized legacy decode.
     """
 
     VIEW_GROUP = "SERVICE"
@@ -84,7 +86,9 @@ class RESTfulAPI(Unit):
     def __init__(self, workflow, loader=None, port=0, host="127.0.0.1",
                  request_timeout=30.0, forwards=None, serving=True,
                  max_slots=4, serving_window=None, max_queue=32,
-                 max_steps=None, max_batch=None, **kwargs):
+                 max_steps=None, max_batch=None, serving_kv=None,
+                 serving_block_size=None, serving_kv_blocks=None,
+                 serving_prefill_chunk=None, **kwargs):
         super(RESTfulAPI, self).__init__(workflow, **kwargs)
         self.loader = loader
         self.output = None  # linked from the head forward unit
@@ -104,6 +108,12 @@ class RESTfulAPI(Unit):
         self.max_slots = int(max_slots)
         self.serving_window = serving_window
         self.max_queue = int(max_queue)
+        #: paged-KV / chunked-prefill knobs (None defers to
+        #: ``root.common.serving.*`` — see serving/scheduler.py)
+        self.serving_kv = serving_kv
+        self.serving_block_size = serving_block_size
+        self.serving_kv_blocks = serving_kv_blocks
+        self.serving_prefill_chunk = serving_prefill_chunk
         #: /generate resource caps — an unbounded request would pay a
         #: giant alloc + a multi-second compile before failing; None
         #: defers to root.common.api.{max_steps,max_batch}
@@ -210,11 +220,18 @@ class RESTfulAPI(Unit):
                     self.forwards, max_slots=self.max_slots,
                     window=self.serving_window,
                     max_queue=self.max_queue,
-                    queue_timeout=self.request_timeout).start()
+                    queue_timeout=self.request_timeout,
+                    kv=self.serving_kv,
+                    block_size=self.serving_block_size,
+                    kv_blocks=self.serving_kv_blocks,
+                    prefill_chunk=self.serving_prefill_chunk).start()
                 self.info(
                     "serving scheduler: %d slots, window %d, "
-                    "queue cap %d", self.scheduler_.max_slots,
-                    self.scheduler_.window, self.max_queue)
+                    "queue cap %d, kv=%s (block %d), prefill "
+                    "chunk %d", self.scheduler_.max_slots,
+                    self.scheduler_.window, self.max_queue,
+                    self.scheduler_.kv, self.scheduler_.block_size,
+                    self.scheduler_.prefill_chunk)
             else:
                 self.info("chain not slot-servable; /generate stays "
                           "on the serialized decode path")

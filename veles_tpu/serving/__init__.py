@@ -7,27 +7,39 @@ per-token scan.  Here:
 
 - :mod:`veles_tpu.serving.prefill` — batched prefill: ONE jitted
   forward over the whole prompt fills the KV cache (TTFT O(1)
-  compiled steps instead of O(prompt_len));
-- :mod:`veles_tpu.serving.kv_slots` — a slot-based batched KV cache
-  (fixed ``max_slots × window`` buffers, per-slot lengths) so requests
-  at different decode positions share one compiled step;
-- :mod:`veles_tpu.serving.engine` — that shared compiled step:
-  per-slot positions, per-slot sampler settings, per-request PRNG
-  streams;
+  compiled steps instead of O(prompt_len)), and CHUNKED prefill
+  (:func:`prefill_chunk`) splits long prompts into fixed-size chunks
+  the scheduler interleaves with decode steps (Sarathi-style) so a
+  joining long prompt cannot stall in-flight streams;
+- :mod:`veles_tpu.serving.kv_slots` — the KV caches: the default
+  block-PAGED cache (:class:`PagedKVCache` — vLLM PagedAttention
+  lineage: per-layer block pools + per-slot block tables, so memory
+  scales with each request's actual length and admission is
+  memory-proportional) and the legacy dense :class:`SlotKVCache`
+  (fixed ``max_slots × window`` rows — the parity baseline);
+- :mod:`veles_tpu.serving.engine` — the shared compiled decode
+  steps: per-slot positions, per-slot sampler settings, per-request
+  PRNG streams; the paged step packs only the active slots into
+  power-of-two occupancy buckets and bounds attention by a block
+  bucket over the deepest request;
 - :mod:`veles_tpu.serving.scheduler` — the continuous-batching
-  scheduler: requests join free slots at token boundaries and leave
-  on stop-token/step-limit, with admission control (queue-depth cap →
-  503, queue deadline → 408) and a background decode loop;
+  scheduler: requests join free slots (and, paged, claim their block
+  budget) at token boundaries and leave on stop-token/step-limit,
+  with admission control (queue-depth cap → 503, queue deadline →
+  408) and a background decode loop;
 - :mod:`veles_tpu.serving.metrics` — per-request TTFT, tokens/sec,
-  queue depth and slot occupancy, exposed through the JSONL event
-  sink (:mod:`veles_tpu.logger`) and a ``snapshot()`` dict.
+  queue depth, slot occupancy, KV-block occupancy and prefill-chunk
+  stalls, exposed through the JSONL event sink
+  (:mod:`veles_tpu.logger`) and a ``snapshot()`` dict.
 """
 
-from veles_tpu.serving.engine import slot_decode_step  # noqa: F401
-from veles_tpu.serving.kv_slots import SlotKVCache  # noqa: F401
+from veles_tpu.serving.engine import (  # noqa: F401
+    paged_decode_step, slot_decode_step)
+from veles_tpu.serving.kv_slots import (  # noqa: F401
+    PagedKVCache, SlotKVCache, paged_supported)
 from veles_tpu.serving.metrics import ServingMetrics  # noqa: F401
 from veles_tpu.serving.prefill import (  # noqa: F401
-    prefill, serving_supported)
+    chunked_supported, prefill, prefill_chunk, serving_supported)
 from veles_tpu.serving.scheduler import (  # noqa: F401
     DeadlineExceededError, InferenceScheduler, QueueFullError,
     SchedulerError)

@@ -1,16 +1,28 @@
-"""The shared compiled decode step over serving slots.
+"""The shared compiled decode steps over serving slots.
 
 ONE executable serves every mix of in-flight requests: per-slot
-positions (``apply_step_slots`` — slots at different decode depths),
-per-slot sampler settings (temperature / top-k ride as traced
-vectors), and per-REQUEST PRNG streams (token ``t`` of a request with
-seed ``s`` is drawn with ``fold_in(key(s), t)`` — reproducible per
-seed no matter which slot the request landed in or what traffic it
-shared the batch with).
+positions (slots at different decode depths), per-slot sampler
+settings (temperature / top-k ride as traced vectors), and
+per-REQUEST PRNG streams (token ``t`` of a request with seed ``s`` is
+drawn with ``fold_in(key(s), t)`` — reproducible per seed no matter
+which slot the request landed in or what traffic it shared the batch
+with).
 
-Free slots decode garbage rows (position 0, token 0) rather than
-splitting the executable on an activity mask — their cache rows are
-wholesale-replaced at the next insert, so the garbage never escapes.
+Two step families:
+
+- :func:`slot_decode_step` — the legacy DENSE path
+  (``apply_step_slots`` over a SlotKVCache).  Always runs the full
+  ``max_slots`` batch; free slots decode garbage rows whose cache
+  rows the next occupant's attention never reads.
+- :func:`paged_decode_step` — the PAGED path (``apply_step_paged``
+  over a PagedKVCache): the scheduler PACKS only the active slots
+  into a power-of-two *occupancy bucket* ``B`` and bounds the
+  attended range by a power-of-two *block bucket* ``T`` over the
+  deepest active slot, so a half-empty batch of shallow requests
+  pays neither full-batch nor full-window compute.  Executables are
+  cached per (chain, B, T) — O(log slots · log window) variants.
+  Sampling is row-wise (per-request keys), so token streams are
+  independent of packing order.
 """
 
 import functools
@@ -85,9 +97,11 @@ def _step_cached(cache_key, closure):
 
 
 def clear_step_cache():
-    """Drop the compiled slot-step cache (entries pin the chain's
-    units — same lifetime note as ``generate.clear_decode_caches``)."""
+    """Drop the compiled slot/paged-step caches (entries pin the
+    chain's units — same lifetime note as
+    ``generate.clear_decode_caches``)."""
     _step_cached.cache_clear()
+    _paged_step_cached.cache_clear()
 
 
 def slot_decode_step(forwards, cache, toks, pos, temps, topks, seeds,
@@ -113,6 +127,66 @@ def slot_decode_step(forwards, cache, toks, pos, temps, topks, seeds,
         jnp.asarray(topks, jnp.int32),
         jnp.asarray(seeds, jnp.uint32),
         jnp.asarray(counts, jnp.int32), cache.caches)
+    return nxt
+
+
+def _make_paged_step(forwards):
+    cacheable = frozenset(i for i, u in enumerate(forwards)
+                          if hasattr(u, "init_cache"))
+
+    def step(params, toks, pos, tables, temps, topks, seeds, counts,
+             pools):
+        h = toks
+        out = dict(pools)
+        for i, u in enumerate(forwards):
+            if i in cacheable:
+                h, out[i] = u.apply_step_paged(params[i], h, pos,
+                                               tables, pools[i])
+            elif hasattr(u, "apply_step_slots"):
+                h = u.apply_step_slots(params[i], h, pos)
+            else:
+                h = u.apply(params[i], h)
+        logits = h[:, 0].astype(jnp.float32)
+        keys = _fold_keys(seeds, counts)
+        return sample_slots(logits, temps, topks, keys), out
+    return step
+
+
+@functools.lru_cache(maxsize=64)
+def _paged_step_cached(cache_key, closure):
+    return track_jit("serving.paged_step", jax.jit(closure.fn))
+
+
+def paged_decode_step(forwards, cache, toks, pos, tables, temps,
+                      topks, seeds, counts):
+    """Run ONE decode step over a PACKED batch of active slots
+    against ``cache`` (:class:`serving.kv_slots.PagedKVCache`,
+    updated in place).
+
+    All arrays are packed to the caller's occupancy bucket ``B``
+    (padding rows: token 0, position 0, an all-zero table — they
+    write into and read from the reserved trash block): ``toks``
+    [B, 1], ``pos``/``temps``/``topks``/``seeds``/``counts`` [B],
+    ``tables`` [B, T] physical block ids (T·block_size must cover
+    ``max(pos) + 1``).  Returns the [B] next tokens; the caller maps
+    packed rows back to its slots."""
+    from veles_tpu import dtypes
+    params = _device_params(forwards)
+    tables = jnp.asarray(tables, jnp.int32)
+    b, t = tables.shape
+    cache_key = (_arch_sig(forwards), b, t, cache.block_size,
+                 cache.capacity_blocks,
+                 str(dtypes.compute_dtype()),
+                 str(dtypes.matmul_precision()))
+    fn = _paged_step_cached(cache_key,
+                            _StepClosure(_make_paged_step(forwards)))
+    nxt, cache.pools = fn(
+        params, jnp.asarray(toks, jnp.int32),
+        jnp.asarray(pos, jnp.int32), tables,
+        jnp.asarray(temps, jnp.float32),
+        jnp.asarray(topks, jnp.int32),
+        jnp.asarray(seeds, jnp.uint32),
+        jnp.asarray(counts, jnp.int32), cache.pools)
     return nxt
 
 

@@ -1,35 +1,105 @@
-"""Slot-based batched KV cache.
+"""Serving KV caches: block-paged (default) and dense slot rows.
 
-One fixed ``[max_slots, window, d]`` K/V buffer pair per cacheable
-block, shared by every in-flight request: request ↔ slot row.  A slot
-row's lifecycle:
+:class:`PagedKVCache` — vLLM-lineage PagedAttention layout (Kwon et
+al., SOSP 2023): K/V live in per-layer POOLS of fixed-size blocks
+(``[num_blocks, block_size, d]``) plus a per-slot *block table*, so a
+request holds ``ceil((prompt + steps) / block_size)`` blocks instead
+of a full ``window`` row.  Admission capacity becomes
+memory-proportional — short requests pack many more concurrent
+streams into the same HBM — and the pool size (``kv_blocks``) is a
+knob independent of ``max_slots``.  Physical block 0 is the reserved
+TRASH block: never allocated, it absorbs the writes of occupancy-
+bucket padding rows and backs the stale tail entries of every table
+(see ops/paged_attention.py for why the garbage is exactly masked).
 
-- **alloc** — a request leaves the queue and claims a free slot;
-- **insert** — its batched prefill row (window-width, rows past the
-  prompt zeroed) REPLACES the slot row wholesale, so stale K/V from
-  the previous occupant can never leak into the newcomer's attention;
-- **decode** — the shared compiled step (:mod:`serving.engine`)
-  writes position ``len-1`` and attends over ``[0, len)`` per slot;
-- **release** — stop-token / step-limit frees the row for the next
-  request (no zeroing needed: insert overwrites).
+:class:`SlotKVCache` — the legacy dense layout (one fixed
+``[max_slots, window, d]`` buffer pair per cacheable block, request ↔
+slot row), kept as the parity baseline and the fallback for chains
+without a paged step.
+
+A slot's lifecycle in either cache: **alloc** (a request leaves the
+queue and claims a slot — and, paged, its whole block budget, so
+decode can never die of mid-flight block starvation), **insert** (the
+prefilled batch-1 staging row is copied in — block-scattered or
+row-replaced), **decode** (the shared compiled step writes position
+``len-1`` and attends over ``[0, len)``), **release** (stop-token /
+step-limit frees slot + blocks; no zeroing needed — every attended
+row [0, len) was written by the current occupant).
 
 All methods must be called from ONE thread (the scheduler's decode
 loop) — the arrays are plain jax values, swapped functionally.
 """
 
+import numpy
+
 import jax
 import jax.numpy as jnp
 
+from veles_tpu.telemetry import track_jit
 
-@jax.jit
-def _insert_row(dst, src, slot):
-    # slot rides traced so every insert shares one executable
-    return jax.lax.dynamic_update_slice(
-        dst, src.astype(dst.dtype), (slot, jnp.int32(0), jnp.int32(0)))
+
+def _row_pair(dst_k, dst_v, src_k, src_v, slot):
+    # ONE dispatch per layer for the K/V pair (the per-tensor-name
+    # variant paid two); slot rides traced so inserts share the
+    # executable, src may be narrower than the row (decode rewrites
+    # [prompt, len) itself, and rows ≥ len are masked)
+    start = (slot, jnp.int32(0), jnp.int32(0))
+    return (jax.lax.dynamic_update_slice(
+                dst_k, src_k.astype(dst_k.dtype), start),
+            jax.lax.dynamic_update_slice(
+                dst_v, src_v.astype(dst_v.dtype), start))
+
+
+_insert_row_pair = track_jit("serving.kv_insert_row",
+                             jax.jit(_row_pair))
+
+
+def _block_pair(pool_k, pool_v, src_k, src_v, ids):
+    # batched block copy, K and V in ONE dispatch: src [1, W, d]
+    # staging rows -> the table's physical blocks (W and the block
+    # count are static through the shapes; one executable per bucket)
+    n = ids.shape[0]
+    bs = pool_k.shape[1]
+    sk = src_k[0, :n * bs].reshape(n, bs, -1)
+    sv = src_v[0, :n * bs].reshape(n, bs, -1)
+    return (pool_k.at[ids].set(sk.astype(pool_k.dtype)),
+            pool_v.at[ids].set(sv.astype(pool_v.dtype)))
+
+
+_insert_blocks = track_jit("serving.kv_insert_blocks",
+                           jax.jit(_block_pair))
+
+
+def _insert_layer(layer, src, fn, *args):
+    """Insert one layer's staging K/V via the paired jitted call,
+    falling back per-name for exotic cache pytrees."""
+    if set(layer) == {"k", "v"}:
+        k, v = fn(layer["k"], layer["v"], src["k"], src["v"], *args)
+        return {"k": k, "v": v}
+    out = {}
+    for name in layer:
+        out[name], _ = fn(layer[name], layer[name], src[name],
+                          src[name], *args)
+    return out
+
+
+def paged_supported(forwards):
+    """True when every cacheable block speaks the paged decode step
+    (``apply_step_paged``) — the scheduler otherwise falls back to the
+    dense slot cache."""
+    has = False
+    for u in forwards:
+        if hasattr(u, "init_cache"):
+            has = True
+            if not hasattr(u, "apply_step_paged"):
+                return False
+    return has
 
 
 class SlotKVCache:
-    """Per-layer slot-major K/V buffers + free-slot bookkeeping."""
+    """Per-layer dense slot-major K/V buffers + free-slot
+    bookkeeping (the legacy layout; parity baseline for the paged
+    cache)."""
 
     def __init__(self, forwards, max_slots, window):
         from veles_tpu import dtypes
@@ -55,19 +125,172 @@ class SlotKVCache:
     def active_slots(self):
         return self.max_slots - len(self._free)
 
-    def alloc(self):
+    def can_admit(self, total_tokens):
+        """A dense slot reserves the full window row regardless of
+        the request's length — a free slot is the only requirement."""
+        return bool(self._free)
+
+    def alloc(self, total_tokens=0):
         """Claim a free slot index, or None when all are busy."""
         return self._free.pop() if self._free else None
 
     def release(self, slot):
-        self._free.append(int(slot))
+        slot = int(slot)
+        if slot in self._free:
+            raise ValueError("slot %d double-freed" % slot)
+        self._free.append(slot)
 
-    def insert(self, slot, row_caches):
-        """Adopt a prefilled batch-1, window-width cache row
-        (:func:`serving.prefill.prefill` output) into ``slot`` —
-        replaces the whole row, clearing any previous occupant."""
+    def insert(self, slot, row_caches, length=None):
+        """Adopt a prefilled batch-1 staging row (serving/prefill.py
+        output, width ≤ window) into ``slot``.  Rows the staging
+        didn't cover are stale from the previous occupant — harmless:
+        decode attends only over [0, len) and writes every position
+        ≥ prompt_len itself, so stale K/V is never read."""
         s = jnp.int32(slot)
+        w = self.window
         for i, layer in self.caches.items():
-            self.caches[i] = {
-                name: _insert_row(layer[name], row_caches[i][name], s)
-                for name in layer}
+            src = {n: a[:, :w] if a.shape[1] > w else a
+                   for n, a in row_caches[i].items()}
+            self.caches[i] = _insert_layer(layer, src,
+                                           _insert_row_pair, s)
+
+
+class PagedKVCache:
+    """Block-paged K/V pools + per-slot block tables.
+
+    ``block_size`` tokens per block; ``kv_blocks`` — the pool's
+    usable capacity in blocks (default: the dense equivalent,
+    ``max_slots · ceil(window / block_size)``, so a default-sized pool
+    admits everything the dense cache would).  ``window`` stays the
+    per-request length bound (the positional-table limit), NOT a
+    per-request memory reservation."""
+
+    def __init__(self, forwards, max_slots, window, block_size=16,
+                 kv_blocks=None):
+        from veles_tpu import dtypes
+        self.max_slots = int(max_slots)
+        self.window = int(window)
+        self.block_size = int(block_size)
+        if self.max_slots < 1 or self.window < 2:
+            raise ValueError("need max_slots >= 1 and window >= 2")
+        if self.block_size < 1:
+            raise ValueError("need block_size >= 1")
+        self.blocks_per_slot = -(-self.window // self.block_size)
+        self.capacity_blocks = int(
+            kv_blocks or self.max_slots * self.blocks_per_slot)
+        if self.capacity_blocks < 1:
+            raise ValueError("need kv_blocks >= 1")
+        num = self.capacity_blocks + 1          # + the trash block 0
+        self.pools = {
+            i: u.init_cache(num, self.block_size,
+                            dtypes.compute_dtype())
+            for i, u in enumerate(forwards)
+            if hasattr(u, "init_cache")}
+        if not self.pools:
+            raise ValueError("chain has no cacheable blocks")
+        self._free_slots = list(range(self.max_slots - 1, -1, -1))
+        self._free_blocks = list(range(num - 1, 0, -1))
+        #: host-side tables [max_slots, blocks_per_slot]; entries past
+        #: a slot's live count stay 0 (the trash block)
+        self.tables = numpy.zeros(
+            (self.max_slots, self.blocks_per_slot), numpy.int32)
+        self.n_blocks = numpy.zeros((self.max_slots,), numpy.int32)
+
+    # -- occupancy reads ------------------------------------------------
+
+    @property
+    def free_slots(self):
+        return len(self._free_slots)
+
+    @property
+    def active_slots(self):
+        return self.max_slots - len(self._free_slots)
+
+    @property
+    def free_blocks(self):
+        return len(self._free_blocks)
+
+    @property
+    def used_blocks(self):
+        return self.capacity_blocks - len(self._free_blocks)
+
+    def blocks_needed(self, total_tokens):
+        return -(-max(int(total_tokens), 1) // self.block_size)
+
+    def can_admit(self, total_tokens):
+        """Memory-proportional admission: a free slot AND enough free
+        blocks for the request's WHOLE budget (prompt + steps — the
+        full reservation up front means decode can never starve for a
+        block mid-flight)."""
+        return bool(self._free_slots) \
+            and self.blocks_needed(total_tokens) <= len(self._free_blocks)
+
+    def alloc(self, total_tokens):
+        """Claim a slot and its full block budget, or None when slots
+        or blocks are exhausted."""
+        need = self.blocks_needed(total_tokens)
+        if need > self.blocks_per_slot:
+            raise ValueError(
+                "request of %d tokens needs %d blocks > %d per-slot "
+                "table width" % (total_tokens, need,
+                                 self.blocks_per_slot))
+        if not self._free_slots or need > len(self._free_blocks):
+            return None
+        slot = self._free_slots.pop()
+        ids = [self._free_blocks.pop() for _ in range(need)]
+        self.tables[slot, :need] = ids
+        self.tables[slot, need:] = 0
+        self.n_blocks[slot] = need
+        return slot
+
+    def release(self, slot):
+        slot = int(slot)
+        if slot in self._free_slots:
+            raise ValueError("slot %d double-freed" % slot)
+        n = int(self.n_blocks[slot])
+        self._free_blocks.extend(int(b) for b in
+                                 self.tables[slot, :n][::-1])
+        self.tables[slot, :] = 0
+        self.n_blocks[slot] = 0
+        self._free_slots.append(slot)
+
+    def check(self):
+        """Invariant sweep (tests): every block is exactly one of
+        {trash, free, owned-by-one-slot}."""
+        live = []
+        for slot in range(self.max_slots):
+            if slot not in self._free_slots:
+                live.extend(int(b)
+                            for b in self.tables[slot,
+                                                 :self.n_blocks[slot]])
+        owned = live + [int(b) for b in self._free_blocks]
+        assert 0 not in owned, "trash block leaked into circulation"
+        assert len(owned) == len(set(owned)), "block double-owned"
+        assert len(owned) == self.capacity_blocks, \
+            "block leaked: %d tracked of %d" % (len(owned),
+                                                self.capacity_blocks)
+
+    def table_rows(self, slots, width):
+        """The packed [len(slots), width] block-table batch the
+        compiled paged step gathers through."""
+        return self.tables[numpy.asarray(slots, numpy.intp), :width]
+
+    def insert(self, slot, row_caches, length):
+        """Block-scatter a prefilled batch-1 staging row (width a
+        multiple of block_size, rows ≥ length zeroed) into ``slot``'s
+        first ``ceil(length / block_size)`` table blocks."""
+        need = self.blocks_needed(length)
+        if need > int(self.n_blocks[slot]):
+            raise ValueError(
+                "insert of %d tokens exceeds slot %d's %d-block "
+                "budget" % (length, slot, int(self.n_blocks[slot])))
+        ids = jnp.asarray(self.tables[slot, :need])
+        for i, layer in self.pools.items():
+            src = row_caches[i]
+            wk = next(iter(src.values())).shape[1]
+            if wk < need * self.block_size:
+                raise ValueError(
+                    "staging width %d < %d blocks x %d" %
+                    (wk, need, self.block_size))
+            self.pools[i] = _insert_layer(layer, src, _insert_blocks,
+                                          ids)

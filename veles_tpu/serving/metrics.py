@@ -56,6 +56,24 @@ def _registry_series():
             "veles_serving_queued_ms",
             "submit-to-slot-admission latency (ms)",
             buckets=MS_BUCKETS),
+        "kv_blocks_used": metrics.gauge(
+            "veles_serving_kv_blocks_used",
+            "paged-KV blocks currently owned by in-flight requests"),
+        "kv_blocks_free": metrics.gauge(
+            "veles_serving_kv_blocks_free",
+            "paged-KV blocks available for admission (memory-pressure"
+            " rejections start when a prompt's budget exceeds this)"),
+        "prefill_chunks": metrics.counter(
+            "veles_serving_prefill_chunk_total",
+            "prompt chunks prefilled (chunked-prefill path)"),
+        "prefill_chunk_tokens": metrics.counter(
+            "veles_serving_prefill_chunk_tokens_total",
+            "prompt tokens prefilled through the chunked path"),
+        "prefill_chunk_ms": metrics.histogram(
+            "veles_serving_prefill_chunk_ms",
+            "wall time of one prefill chunk — the decode-stall bound "
+            "each loop iteration pays for a joining long prompt",
+            buckets=MS_BUCKETS),
     }
 
 
@@ -71,6 +89,8 @@ class ServingMetrics:
         self.tokens_generated = 0
         self.slot_busy_steps = 0
         self.slot_total_steps = 0
+        self.prefill_chunks = 0
+        self.prefill_chunk_tokens = 0
         # instance-lifetime latency histograms (the shared telemetry
         # type: bounded reservoir + bucket counts), window = `recent`
         self._ttft = Histogram("ttft_ms", buckets=MS_BUCKETS,
@@ -108,6 +128,18 @@ class ServingMetrics:
         self._queued.observe(queued_ms)
         self._global["ttft_ms"].observe(ttft_ms)
         self._global["queued_ms"].observe(queued_ms)
+
+    def record_prefill_chunk(self, tokens, chunk_ms):
+        with self._lock:
+            self.prefill_chunks += 1
+            self.prefill_chunk_tokens += int(tokens)
+        self._global["prefill_chunks"].inc()
+        self._global["prefill_chunk_tokens"].inc(int(tokens))
+        self._global["prefill_chunk_ms"].observe(chunk_ms)
+
+    def set_kv_blocks(self, used, free):
+        self._global["kv_blocks_used"].set(int(used))
+        self._global["kv_blocks_free"].set(int(free))
 
     def record_step(self, active, slots):
         with self._lock:
@@ -148,7 +180,8 @@ class ServingMetrics:
                 return None
             return toks / (t_last - t_first)
 
-    def snapshot(self, queue_depth=0, active_slots=0, max_slots=0):
+    def snapshot(self, queue_depth=0, active_slots=0, max_slots=0,
+                 kv=None):
         with self._lock:
             occ = (self.slot_busy_steps / self.slot_total_steps
                    if self.slot_total_steps else 0.0)
@@ -162,8 +195,12 @@ class ServingMetrics:
                 "active_slots": int(active_slots),
                 "max_slots": int(max_slots),
                 "slot_occupancy": round(occ, 4),
+                "prefill_chunks": self.prefill_chunks,
+                "prefill_chunk_tokens": self.prefill_chunk_tokens,
                 "uptime_s": round(time.monotonic() - self._t0, 3),
             }
+        if kv:  # paged-cache occupancy (operator admission headroom)
+            out.update(kv)
         out["ttft_ms_p50"] = self._ttft.percentile(0.50)
         out["ttft_ms_p95"] = self._ttft.percentile(0.95)
         out["ttft_ms_p99"] = self._ttft.percentile(0.99)

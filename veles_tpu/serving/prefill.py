@@ -65,6 +65,110 @@ def serving_window(forwards):
     return best
 
 
+def chunked_supported(forwards):
+    """True when the chain can prefill in chunks: every cacheable
+    block continues from an offset (``apply_prefill_chunk``) and every
+    other sequence-positioned unit speaks chunk offsets
+    (``apply_chunk``) or is position-wise."""
+    has = False
+    for u in forwards:
+        if hasattr(u, "init_cache"):
+            has = True
+            if not hasattr(u, "apply_prefill_chunk"):
+                return False
+        elif getattr(u, "positions", None) is not None \
+                and not hasattr(u, "apply_chunk"):
+            return False
+    return has
+
+
+def _make_chunk_fn(forwards, key_width):
+    cacheable = frozenset(i for i, u in enumerate(forwards)
+                          if hasattr(u, "init_cache"))
+
+    def run(params, chunk, offset, chunk_lens, caches):
+        h = chunk
+        out = dict(caches)
+        for i, u in enumerate(forwards):
+            if i in cacheable:
+                h, out[i] = u.apply_prefill_chunk(
+                    params[i], h, caches[i], offset,
+                    chunk_lens=chunk_lens, key_width=key_width)
+            elif hasattr(u, "apply_chunk"):
+                h = u.apply_chunk(params[i], h, offset)
+            else:
+                h = u.apply(params[i], h)
+        last = jnp.take_along_axis(
+            h, (chunk_lens - 1)[:, None, None], axis=1)[:, 0]
+        return out, last.astype(jnp.float32)
+    return run
+
+
+@functools.lru_cache(maxsize=64)
+def _chunk_cached(cache_key, closure):
+    return track_jit("serving.prefill_chunk", jax.jit(closure.fn))
+
+
+def clear_chunk_cache():
+    """Drop the compiled chunk-prefill cache (same lifetime note as
+    :func:`clear_prefill_cache`)."""
+    _chunk_cached.cache_clear()
+
+
+def prefill_chunk(forwards, chunk, offset, chunk_lens, caches,
+                  key_width=None):
+    """Prefill ONE chunk — ``chunk`` [batch, C] int32 tokens at
+    sequence positions [offset, offset+C) — into existing staging
+    ``caches`` (``{chain index: {"k", "v"} [batch, W, d]}``; W a
+    multiple of C, rows still zero past every previously-written
+    position).
+
+    ``offset`` (a host int, multiple of C) rides the executable as a
+    traced scalar; ``chunk_lens`` [batch] ints mark how much of the
+    chunk each row's prompt actually covers (pad the rest — its K/V
+    rows are zeroed like one-shot prefill's ragged rows).
+    ``key_width`` (static, default W) bounds the attended key range;
+    callers bucket it to a power of two ≥ offset + C.
+
+    Returns ``(caches', last_logits)`` where ``last_logits``
+    [batch, vocab] (f32) sit at each row's position
+    ``offset + chunk_lens[n] - 1`` — the first-token logits once the
+    final chunk lands.  Running the chunks in order reproduces the
+    one-shot :func:`prefill` cache rows and logits (tested)."""
+    from veles_tpu import dtypes
+    if not chunked_supported(forwards):
+        raise ValueError("chain cannot prefill in chunks (see "
+                         "chunked_supported)")
+    params = _device_params(forwards)
+    chunk = jnp.asarray(chunk, jnp.int32)
+    b, c = chunk.shape
+    widths = {tuple(a.shape[1] for a in layer.values())
+              for layer in caches.values()}
+    w = next(iter(widths))[0]
+    if any(x != w for tup in widths for x in tup):
+        raise ValueError("staging caches disagree on width")
+    if w % c or offset % c or offset + c > w:
+        raise ValueError(
+            "chunk [%d, %d) must tile the staging width %d"
+            % (offset, offset + c, w))
+    kw = int(key_width or w)
+    if kw > w or kw < min(offset + c, w):
+        raise ValueError("key_width %d outside [%d, %d]"
+                         % (kw, offset + c, w))
+    lens_np = numpy.asarray(chunk_lens, numpy.int32)
+    if lens_np.shape != (b,):
+        raise ValueError("chunk_lens must be [batch] ints")
+    if lens_np.min() < 1 or lens_np.max() > c:
+        raise ValueError("chunk_lens must be in [1, %d]" % c)
+    cache_key = (_arch_sig(forwards), b, c, w, kw,
+                 str(dtypes.compute_dtype()),
+                 str(dtypes.matmul_precision()))
+    fn = _chunk_cached(cache_key,
+                       _StepClosure(_make_chunk_fn(forwards, kw)))
+    return fn(params, chunk, jnp.int32(offset),
+              jnp.asarray(lens_np), caches)
+
+
 def _make_prefill_fn(forwards, window):
     cacheable = frozenset(i for i, u in enumerate(forwards)
                           if hasattr(u, "init_cache"))

@@ -6,26 +6,50 @@ uploads and the compile caches are not thread-safe against concurrent
 mutation, and a single loop is what lets every in-flight request share
 one compiled step):
 
-1. **admit** — while free slots exist, the oldest queued request
-   claims one: its prompt prefills in ONE compiled pass (bucketed
-   widths bound the executable count), the K/V row is inserted into
-   the slot cache, and its first token samples from the prefill
-   logits (that's the TTFT edge);
-2. **step** — all active slots advance one token through the shared
-   compiled step (:func:`serving.engine.slot_decode_step`) — requests
-   at different depths, temperatures and seeds genuinely interleave;
-3. **retire** — a slot that generated its stop token or hit its step
-   limit completes its future and frees at the token boundary, where
-   the next queued request joins.
+1. **admit** — while capacity allows, the oldest queued request
+   claims a slot.  Under the default PAGED KV cache
+   (:class:`serving.kv_slots.PagedKVCache`) admission is
+   memory-proportional: the request also claims its whole block
+   budget (``ceil((prompt + steps) / block_size)`` blocks), so short
+   requests pack many more concurrent streams into the same HBM than
+   the dense window-per-slot layout;
+2. **prefill** — prompts up to ``prefill_chunk`` prefill in ONE
+   compiled pass; longer prompts prefill in ``prefill_chunk``-token
+   CHUNKS, at most one chunk per loop iteration, INTERLEAVED with the
+   decode step below (Sarathi-style chunked prefill) — a joining long
+   prompt stalls in-flight decode streams by one chunk per iteration,
+   not by its whole prefill, which flattens the TTFT tail of short
+   requests stuck behind long ones.  Either way the K/V staging row
+   is inserted into the cache and the first token samples from the
+   final logits (the TTFT edge);
+3. **step** — active slots advance one token through the shared
+   compiled step.  The paged path packs ONLY the active slots into a
+   power-of-two occupancy bucket and bounds attention by a
+   power-of-two block bucket over the deepest request
+   (:func:`serving.engine.paged_decode_step`), so a half-empty batch
+   of shallow requests pays neither full-batch nor full-window
+   compute; the dense fallback runs the fixed full-slot step;
+4. **retire** — a slot that generated its stop token or hit its step
+   limit completes its future and frees slot + blocks at the token
+   boundary, where the next queued request joins.
 
 Admission control: a full queue raises :class:`QueueFullError` (HTTP
 503) at submit; a request still queued past its deadline fails with
 :class:`DeadlineExceededError` (HTTP 408).  Greedy requests keep
-exact determinism (each slot's attention sees only its own cache
-row); sampled requests are reproducible per seed — though the stream
+exact determinism (each request's attention sees only its own cache
+rows/blocks, and sampling is row-wise, so token streams are
+independent of slot placement, packing order and co-tenants);
+sampled requests are reproducible per seed — though the stream
 differs from the single-user ``generate()`` path's (one fold per
 generated token here vs one split per lockstep buffer position
 there).
+
+Config knobs (``root.common.serving.*``, overridable per scheduler):
+``kv`` ("paged"/"dense"), ``block_size`` (tokens per KV block,
+default 16), ``kv_blocks`` (pool capacity in blocks; default the
+dense-equivalent ``max_slots · ceil(window / block_size)``) and
+``prefill_chunk`` (chunk width in tokens, rounded up to a power of
+two; 0 disables chunking, default 64).
 """
 
 import collections
@@ -37,11 +61,14 @@ import time
 import numpy
 
 from veles_tpu.logger import Logger
-from veles_tpu.serving.engine import first_tokens, slot_decode_step
-from veles_tpu.serving.kv_slots import SlotKVCache
+from veles_tpu.serving.engine import (
+    first_tokens, paged_decode_step, slot_decode_step)
+from veles_tpu.serving.kv_slots import (
+    PagedKVCache, SlotKVCache, paged_supported)
 from veles_tpu.serving.metrics import ServingMetrics
 from veles_tpu.serving.prefill import (
-    prefill, serving_supported, serving_window)
+    chunked_supported, prefill, prefill_chunk, serving_supported,
+    serving_window)
 
 
 class SchedulerError(Exception):
@@ -60,18 +87,24 @@ class DeadlineExceededError(SchedulerError):
 
 
 def _bucket(n, floor, cap):
-    """Pad prompt widths to power-of-two buckets so the compiled
-    prefill count stays O(log window) across arbitrary clients."""
+    """Pad widths/counts to power-of-two buckets so the compiled
+    executable count stays O(log) across arbitrary clients."""
     b = max(int(floor), 1)
     while b < n:
         b *= 2
     return min(b, cap)
 
 
+def _serving_conf(name, default):
+    from veles_tpu.config import root
+    return root.common.serving.get(name, default)
+
+
 class _Request(object):
     __slots__ = ("prompt", "steps", "temperature", "top_k",
                  "stop_token", "seed", "deadline", "future", "slot",
-                 "generated", "t_submit", "t_admit", "t_first")
+                 "generated", "t_submit", "t_admit", "t_first",
+                 "pf_caches", "pf_off", "pf_width", "pf_chunk")
 
     def __init__(self, prompt, steps, temperature, top_k, stop_token,
                  seed, deadline):
@@ -88,22 +121,31 @@ class _Request(object):
         self.t_submit = time.monotonic()
         self.t_admit = None
         self.t_first = None
+        # chunked-prefill progress (None while queued / one-shot)
+        self.pf_caches = None
+        self.pf_off = 0
+        self.pf_width = 0
+        self.pf_chunk = 0
 
 
 class InferenceScheduler(Logger):
     """Continuous-batching decode service over a forward chain.
 
     ``max_slots`` — concurrent requests decoding per step;
-    ``window`` — slot cache width (default: the chain's positional
-    table; a request needs ``prompt_len + steps <= window``);
+    ``window`` — per-request length bound, ``prompt_len + steps <=
+    window`` (default: the chain's positional table);
     ``max_queue`` — waiting-request cap beyond the slots (503 above);
     ``queue_timeout`` — default admission deadline in seconds (408
     for requests still queued past it);
-    ``prefill_bucket`` — smallest compiled prefill width.
-    """
+    ``prefill_bucket`` — smallest compiled prefill width;
+    ``kv`` / ``block_size`` / ``kv_blocks`` / ``prefill_chunk`` —
+    paged-cache and chunked-prefill knobs (None defers to
+    ``root.common.serving.*``; see the module docstring)."""
 
     def __init__(self, forwards, max_slots=4, window=None,
-                 max_queue=32, queue_timeout=30.0, prefill_bucket=8):
+                 max_queue=32, queue_timeout=30.0, prefill_bucket=8,
+                 kv=None, block_size=None, kv_blocks=None,
+                 prefill_chunk=None, warm_buckets=None):
         super(InferenceScheduler, self).__init__()
         if not serving_supported(forwards):
             raise ValueError(
@@ -121,19 +163,53 @@ class InferenceScheduler(Logger):
         self.max_queue = int(max_queue)
         self.queue_timeout = float(queue_timeout)
         self.prefill_bucket = int(prefill_bucket)
+        kv = kv or _serving_conf("kv", "paged")
+        if kv not in ("paged", "dense"):
+            raise ValueError("kv must be 'paged' or 'dense'")
+        if kv == "paged" and not paged_supported(forwards):
+            self.info("chain has no paged decode step; falling back "
+                      "to the dense slot cache")
+            kv = "dense"
+        self.kv = kv
+        self.block_size = int(
+            block_size or _serving_conf("block_size", 16))
+        if self.block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.blocks_per_slot = -(-self.window // self.block_size)
+        self.kv_blocks = int(
+            kv_blocks or self.max_slots * self.blocks_per_slot) \
+            if self.kv == "paged" else 0
+        chunk = prefill_chunk if prefill_chunk is not None \
+            else _serving_conf("prefill_chunk", 64)
+        chunk = int(chunk or 0)
+        if chunk and not chunked_supported(forwards):
+            self.info("chain cannot prefill in chunks; long prompts "
+                      "will prefill one-shot")
+            chunk = 0
+        #: chunk widths ride compiled executables — power-of-two
+        self.prefill_chunk = _bucket(chunk, 1, 1 << 30) if chunk else 0
+        self.warm_buckets = bool(
+            _serving_conf("warm_buckets", True)
+            if warm_buckets is None else warm_buckets)
         self.stats = ServingMetrics()
         self._queue = collections.deque()
-        self._active = {}            # slot -> _Request
+        self._active = {}            # slot -> _Request (decoding)
+        self._prefilling = []        # admitted, mid-chunked-prefill
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._closed = False
         self._thread = None
+        self._ready = threading.Event()
+        self.cache_ = None           # set by the loop thread
 
     # -- client side ----------------------------------------------------
 
     def start(self):
         """Warm the device params (single-threaded — Array.devmem's
-        lazy upload is not re-entrant) and start the decode loop."""
+        lazy upload is not re-entrant), start the decode loop and
+        block until it is READY — cache built and the paged-step
+        bucket ladder compiled — so traffic never eats warmup
+        compiles as decode stalls."""
         if self._thread is not None:
             return self
         for u in self.forwards:
@@ -142,6 +218,7 @@ class InferenceScheduler(Logger):
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="serving-scheduler")
         self._thread.start()
+        self._ready.wait(600)
         return self
 
     def submit(self, prompt, steps, temperature=0.0, top_k=0,
@@ -162,6 +239,12 @@ class InferenceScheduler(Logger):
             raise ValueError(
                 "prompt_len + steps = %d exceeds the serving window "
                 "(%d)" % (len(prompt) + steps, self.window))
+        if self.kv == "paged":
+            need = -(-(len(prompt) + steps) // self.block_size)
+            if need > self.kv_blocks:
+                raise ValueError(
+                    "request needs %d KV blocks > pool capacity %d "
+                    "(kv_blocks)" % (need, self.kv_blocks))
         temperature = float(temperature or 0.0)
         top_k = int(top_k or 0)
         if top_k and not temperature:
@@ -188,12 +271,30 @@ class InferenceScheduler(Logger):
             self._wake.notify()
         return req.future
 
+    def _kv_snapshot(self):
+        out = {"kv_mode": self.kv,
+               "prefill_chunk": self.prefill_chunk,
+               "prefilling": len(self._prefilling)}
+        cache = self.cache_
+        if self.kv == "paged":
+            out["kv_block_size"] = self.block_size
+            out["kv_blocks_total"] = self.kv_blocks
+            # the loop thread owns the free lists; these reads are
+            # monitoring-grade (len() is atomic enough for a gauge)
+            out["kv_blocks_used"] = \
+                cache.used_blocks if cache is not None else 0
+            out["kv_blocks_free"] = \
+                cache.free_blocks if cache is not None \
+                else self.kv_blocks
+        return out
+
     def metrics(self):
         with self._lock:
             depth, active = len(self._queue), len(self._active)
         snap = self.stats.snapshot(queue_depth=depth,
                                    active_slots=active,
-                                   max_slots=self.max_slots)
+                                   max_slots=self.max_slots,
+                                   kv=self._kv_snapshot())
         snap["window"] = self.window
         return snap
 
@@ -208,8 +309,10 @@ class InferenceScheduler(Logger):
             self._thread.join(30)
         err = SchedulerError("scheduler closed")
         with self._lock:
-            pending = list(self._queue) + list(self._active.values())
+            pending = list(self._queue) + list(self._prefilling) \
+                + list(self._active.values())
             self._queue.clear()
+            self._prefilling = []
             self._active.clear()
         for req in pending:
             if not req.future.done():
@@ -217,38 +320,88 @@ class InferenceScheduler(Logger):
 
     # -- decode loop ----------------------------------------------------
 
+    def _make_cache(self):
+        if self.kv == "paged":
+            return PagedKVCache(self.forwards, self.max_slots,
+                                self.window,
+                                block_size=self.block_size,
+                                kv_blocks=self.kv_blocks)
+        return SlotKVCache(self.forwards, self.max_slots, self.window)
+
+    def _warm_paged(self, cache):
+        """Compile the paged step's (occupancy, depth) bucket ladder
+        BEFORE traffic: a bucket's first compile would otherwise land
+        inside live serving as a multi-second decode stall (exactly
+        the tail latency the buckets exist to remove).  The dummy
+        batches are all padding rows — token 0 at position 0 through
+        an all-zero block table, i.e. reads and writes confined to
+        the reserved trash block."""
+        buckets = sorted({_bucket(n, 1, self.max_slots)
+                          for n in range(1, self.max_slots + 1)})
+        depths = sorted({_bucket(n, 1, cache.blocks_per_slot)
+                         for n in range(1, cache.blocks_per_slot + 1)})
+        t0 = time.monotonic()
+        for b in buckets:
+            for t in depths:
+                paged_decode_step(
+                    self.forwards, cache,
+                    numpy.zeros((b, 1), numpy.int32),
+                    numpy.zeros((b,), numpy.int32),
+                    numpy.zeros((b, t), numpy.int32),
+                    numpy.zeros((b,), numpy.float32),
+                    numpy.zeros((b,), numpy.int32),
+                    numpy.zeros((b,), numpy.uint32),
+                    numpy.zeros((b,), numpy.int32))
+        self.info("paged-step warmup: %d occupancy x %d depth "
+                  "buckets in %.2fs", len(buckets), len(depths),
+                  time.monotonic() - t0)
+
     def _loop(self):
         try:
-            cache = SlotKVCache(self.forwards, self.max_slots,
-                                self.window)
+            cache = self._make_cache()
+            if self.kv == "paged" and self.warm_buckets:
+                self._warm_paged(cache)
+            self.cache_ = cache
         except Exception as e:  # surface init failures to clients
             with self._wake:
                 self._closed = True
                 pending = list(self._queue)
                 self._queue.clear()
+            self._ready.set()
             for req in pending:
                 req.future.set_exception(SchedulerError(repr(e)))
             raise
+        self._ready.set()
         while True:
             with self._wake:
                 while not self._closed and not self._queue \
-                        and not self._active:
+                        and not self._active and not self._prefilling:
                     self._wake.wait()
                 if self._closed:
                     return
                 self._expire_locked()
                 admits = []
-                while self._queue and cache.free_slots:
+                while self._queue and cache.can_admit(
+                        len(self._queue[0].prompt)
+                        + self._queue[0].steps):
                     req = self._queue.popleft()
-                    req.slot = cache.alloc()
-                    self._active[req.slot] = req
+                    req.slot = cache.alloc(len(req.prompt)
+                                           + req.steps)
                     admits.append(req)
             # jax work OUTSIDE the lock: submit() must never block on
             # a device step
+            self._sync_kv_gauges(cache)
             for req in admits:
-                self._admit(req, cache)
+                self._begin_admit(req, cache)
+            if self._prefilling:
+                self._prefill_tick(cache)
             if self._active:
                 self._step(cache)
+
+    def _sync_kv_gauges(self, cache):
+        if self.kv == "paged":
+            self.stats.set_kv_blocks(cache.used_blocks,
+                                     cache.free_blocks)
 
     def _expire_locked(self):
         now = time.monotonic()
@@ -264,22 +417,95 @@ class InferenceScheduler(Logger):
                 kept.append(req)
         self._queue = kept
 
-    def _admit(self, req, cache):
-        """Prefill one joining request into its slot and emit its
-        first token (the TTFT edge)."""
+    def _staging_width(self, p_len, chunk):
+        """Width of the batch-1 staging K/V row a prompt prefills
+        into: the power-of-two bucket of the prompt, floored so it
+        tiles both the chunk width and (paged) the block size."""
+        bs = self.block_size if self.kv == "paged" else 1
+        floor = max(self.prefill_bucket, bs, chunk or 1)
+        return _bucket(p_len, floor, 1 << 30)
+
+    def _begin_admit(self, req, cache):
+        """Route one joining request: short prompts prefill one-shot;
+        long prompts start the chunked-prefill ride-along."""
         req.t_admit = time.monotonic()
         p_len = len(req.prompt)
-        width = _bucket(p_len, self.prefill_bucket, self.window)
-        padded = numpy.zeros((1, width), numpy.int32)
+        chunk = self.prefill_chunk
+        if not chunk or p_len <= chunk:
+            self._admit_oneshot(req, cache)
+            return
+        from veles_tpu import dtypes
+        req.pf_chunk = chunk
+        req.pf_width = self._staging_width(p_len, chunk)
+        req.pf_off = 0
+        try:
+            req.pf_caches = {
+                i: u.init_cache(1, req.pf_width,
+                                dtypes.compute_dtype())
+                for i, u in enumerate(self.forwards)
+                if hasattr(u, "init_cache")}
+        except Exception as e:
+            self._retire(req, cache, error=e)
+            return
+        self._prefilling.append(req)
+
+    def _admit_oneshot(self, req, cache):
+        """Prefill one joining request in a single compiled pass and
+        emit its first token (the TTFT edge)."""
+        p_len = len(req.prompt)
+        width = self._staging_width(p_len, 0)
+        # the PROMPT array stays inside the positional table; the
+        # staging cache may be wider (insert trims it back)
+        p_w = min(width, max(self.window, p_len))
+        padded = numpy.zeros((1, p_w), numpy.int32)
         padded[0, :p_len] = req.prompt
         try:
             row_caches, last = prefill(
                 self.forwards, padded, prompt_lens=[p_len],
-                window=self.window)
+                window=width)
         except Exception as e:
             self._retire(req, cache, error=e)
             return
-        cache.insert(req.slot, row_caches)
+        self._finish_admit(req, cache, row_caches, last)
+
+    def _prefill_tick(self, cache):
+        """Advance the oldest mid-prefill request by ONE chunk — the
+        per-iteration decode-stall bound; the decode step for every
+        in-flight stream runs right after, in the same iteration."""
+        req = self._prefilling[0]
+        p_len = len(req.prompt)
+        c = req.pf_chunk
+        off = req.pf_off
+        end = min(off + c, p_len)
+        clen = end - off
+        padded = numpy.zeros((1, c), numpy.int32)
+        padded[0, :clen] = req.prompt[off:end]
+        kw = _bucket(off + c, c, req.pf_width)
+        t0 = time.perf_counter()
+        try:
+            req.pf_caches, last = prefill_chunk(
+                self.forwards, padded, off, [clen], req.pf_caches,
+                key_width=kw)
+        except Exception as e:
+            self._prefilling.pop(0)
+            self._retire(req, cache, error=e)
+            return
+        self.stats.record_prefill_chunk(
+            clen, (time.perf_counter() - t0) * 1e3)
+        req.pf_off = end
+        if end >= p_len:
+            self._prefilling.pop(0)
+            self._finish_admit(req, cache, req.pf_caches, last)
+
+    def _finish_admit(self, req, cache, row_caches, last):
+        """Insert the prefilled staging row and emit the first
+        token."""
+        try:
+            cache.insert(req.slot, row_caches, len(req.prompt))
+        except Exception as e:
+            self._retire(req, cache, error=e)
+            return
+        req.pf_caches = None
         tok = int(numpy.asarray(first_tokens(
             last, [req.temperature], [req.top_k], [req.seed]))[0])
         req.generated.append(tok)
@@ -287,11 +513,64 @@ class InferenceScheduler(Logger):
         self.stats.record_first_token(
             (req.t_first - req.t_submit) * 1e3,
             (req.t_admit - req.t_submit) * 1e3)
+        with self._lock:
+            self._active[req.slot] = req
         self._maybe_finish(req, cache)
 
     def _step(self, cache):
-        """Advance every active slot one token through the shared
-        compiled step, then retire finished slots at the boundary."""
+        """Advance every active request one token through the shared
+        compiled step, then retire finished ones at the boundary."""
+        with self._lock:
+            active = dict(self._active)
+        if not active:
+            return
+        if self.kv == "paged":
+            self._step_paged(cache, active)
+        else:
+            self._step_dense(cache, active)
+
+    def _fill_row(self, arrays, j, req):
+        toks, pos, temps, topks, seeds, counts = arrays
+        toks[j, 0] = req.generated[-1]
+        pos[j] = len(req.prompt) + len(req.generated) - 1
+        temps[j] = req.temperature
+        topks[j] = req.top_k
+        seeds[j] = req.seed
+        counts[j] = len(req.generated)
+
+    def _step_paged(self, cache, active):
+        """Packed step: ONLY the active slots ride the batch, padded
+        to a power-of-two occupancy bucket; the attended range is the
+        power-of-two block bucket of the deepest request."""
+        slots = sorted(active)
+        n = len(slots)
+        b = _bucket(n, 1, self.max_slots)
+        bs = cache.block_size
+        deepest = max(len(active[s].prompt) + len(active[s].generated)
+                      for s in slots)
+        t = _bucket(-(-deepest // bs), 1, cache.blocks_per_slot)
+        toks = numpy.zeros((b, 1), numpy.int32)
+        pos = numpy.zeros((b,), numpy.int32)
+        temps = numpy.zeros((b,), numpy.float32)
+        topks = numpy.zeros((b,), numpy.int32)
+        seeds = numpy.zeros((b,), numpy.uint32)
+        counts = numpy.zeros((b,), numpy.int32)
+        tables = numpy.zeros((b, t), numpy.int32)
+        arrays = (toks, pos, temps, topks, seeds, counts)
+        for j, slot in enumerate(slots):
+            self._fill_row(arrays, j, active[slot])
+        tables[:n] = cache.table_rows(slots, t)
+        nxt = numpy.asarray(paged_decode_step(
+            self.forwards, cache, toks, pos, tables, temps, topks,
+            seeds, counts))
+        self.stats.record_step(n, b)
+        for j, slot in enumerate(slots):
+            req = active[slot]
+            req.generated.append(int(nxt[j]))
+            self._maybe_finish(req, cache)
+
+    def _step_dense(self, cache, active):
+        """Legacy full-batch step: free slots decode garbage rows."""
         s = self.max_slots
         toks = numpy.zeros((s, 1), numpy.int32)
         pos = numpy.zeros((s,), numpy.int32)
@@ -299,17 +578,9 @@ class InferenceScheduler(Logger):
         topks = numpy.zeros((s,), numpy.int32)
         seeds = numpy.zeros((s,), numpy.uint32)
         counts = numpy.zeros((s,), numpy.int32)
-        with self._lock:
-            active = dict(self._active)
-        if not active:
-            return
+        arrays = (toks, pos, temps, topks, seeds, counts)
         for slot, req in active.items():
-            toks[slot, 0] = req.generated[-1]
-            pos[slot] = len(req.prompt) + len(req.generated) - 1
-            temps[slot] = req.temperature
-            topks[slot] = req.top_k
-            seeds[slot] = req.seed
-            counts[slot] = len(req.generated)
+            self._fill_row(arrays, slot, req)
         nxt = numpy.asarray(slot_decode_step(
             self.forwards, cache, toks, pos, temps, topks, seeds,
             counts))
@@ -330,6 +601,7 @@ class InferenceScheduler(Logger):
         with self._lock:
             self._active.pop(req.slot, None)
         cache.release(req.slot)
+        self._sync_kv_gauges(cache)
         if error is not None:
             req.future.set_exception(
                 error if isinstance(error, SchedulerError)
