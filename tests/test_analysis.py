@@ -1,0 +1,451 @@
+"""veles-lint (veles_tpu/analysis) — every D/T/L/C code must fire on
+a seeded fixture violation AND stay quiet on the clean twin; the real
+tree must scan clean under ``--strict`` (tier-1, pure AST, <10 s, no
+jax import); ``--format json`` must stay machine-consumable."""
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from veles_tpu.analysis import (
+    ALL_CODES, ALL_PASSES, analyze, collect_modules, run_passes)
+from veles_tpu.analysis.baseline import (
+    apply_baseline, format_entry, load_baseline)
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "veles_tpu"
+
+pytestmark = pytest.mark.analysis
+
+
+def scan(tmp_path, files):
+    """Write a fixture tree and run every pass over it."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    modules, errors = collect_modules([str(tmp_path)], root=tmp_path)
+    assert not errors, errors
+    findings, _ = run_passes(ALL_PASSES, modules)
+    return findings
+
+
+def codes_of(findings):
+    return sorted({f.code for f in findings})
+
+
+# -- D-series ----------------------------------------------------------------
+
+def test_d101_read_after_donate_fires_and_clean_is_quiet(tmp_path):
+    bad = """\
+import jax
+
+def build():
+    def step(w, x):
+        return w + x
+    return jax.jit(step, donate_argnums=(0,))
+
+class T:
+    def setup(self):
+        self._step_ = build()
+
+    def run(self, w, x):
+        out = self._step_(w, x)
+        return w.sum(), out
+"""
+    f = [x for x in scan(tmp_path, {"m.py": bad}) if x.code == "D101"]
+    assert f and f[0].detail == "self._step_->w"
+    good = bad.replace("return w.sum(), out", "return out")
+    assert "D101" not in codes_of(scan(tmp_path, {"m.py": good}))
+
+
+def test_d101_builder_method_resolution(tmp_path):
+    """The gd.py idiom: self._step_ = self._build() where _build
+    returns track_jit(jax.jit(..., donate_argnums))."""
+    src = """\
+import jax
+from veles_tpu.telemetry import track_jit
+
+class T:
+    def _build(self):
+        def step(params, x):
+            return params
+        return track_jit("t.step", jax.jit(step, donate_argnums=(0,)))
+
+    def run(self, x):
+        if self._step_ is None:
+            self._step_ = self._build()
+        params = self.gather()
+        new = self._step_(params, x)
+        self.scatter(params)   # read after donation!
+        return new
+"""
+    f = [x for x in scan(tmp_path, {"m.py": src}) if x.code == "D101"]
+    assert f and "params" in f[0].detail
+
+
+def test_d102_retained_host_view(tmp_path):
+    bad = """\
+import numpy
+
+class A:
+    def keep(self, devmem):
+        self.view = numpy.asarray(devmem)
+
+    def fetch(self, devmem):
+        return numpy.asarray(devmem)
+"""
+    f = [x for x in scan(tmp_path, {"m.py": bad}) if x.code == "D102"]
+    assert len(f) == 2
+    # transient consumption is the safe idiom — quiet
+    good = """\
+import numpy
+
+class A:
+    def read_scalar(self, devmem):
+        v = int(numpy.asarray(devmem)[0])
+        return v
+"""
+    assert "D102" not in codes_of(scan(tmp_path, {"m.py": good}))
+
+
+def test_d103_module_level_jit_ref(tmp_path):
+    bad = "import jax\n_step = jax.jit(lambda x: x + 1)\n"
+    f = [x for x in scan(tmp_path, {"m.py": bad}) if x.code == "D103"]
+    assert f and f[0].detail == "_step"
+    good = """\
+import jax
+
+def build():
+    return jax.jit(lambda x: x + 1)
+"""
+    assert "D103" not in codes_of(scan(tmp_path, {"m.py": good}))
+
+
+# -- T-series ----------------------------------------------------------------
+
+def test_t201_side_effects_inside_jit(tmp_path):
+    bad = """\
+import jax, time, random
+
+@jax.jit
+def step(x):
+    print("tracing")
+    t = time.time()
+    r = random.random()
+    return x + t + r
+"""
+    f = [x for x in scan(tmp_path, {"m.py": bad}) if x.code == "T201"]
+    assert {x.detail for x in f} == {"print", "time.time",
+                                     "random.random"}
+    good = """\
+import jax
+
+@jax.jit
+def step(x, key):
+    return x + jax.random.uniform(key)
+"""
+    fg = scan(tmp_path, {"m.py": good})
+    assert "T201" not in codes_of(fg)
+
+
+def test_t202_concretization_inside_jit(tmp_path):
+    bad = """\
+import jax
+
+def make(f):
+    def step(x):
+        if bool(x[0] > 0):
+            return float(x.sum())
+        return x.item()
+    return jax.jit(step)
+"""
+    f = [x for x in scan(tmp_path, {"m.py": bad}) if x.code == "T202"]
+    assert {x.detail for x in f} == {"bool", "float", ".item"}
+    # static-shape reads are fine
+    good = """\
+import jax
+
+def make():
+    def step(x):
+        n = int(x.shape[0])
+        return x.reshape(n, -1)
+    return jax.jit(step)
+"""
+    assert "T202" not in codes_of(scan(tmp_path, {"m.py": good}))
+
+
+def test_t203_untracked_jit_and_the_escapes(tmp_path):
+    bad = """\
+import jax
+
+def build(f):
+    return jax.jit(f)
+
+@jax.jit
+def decorated(x):
+    return x
+"""
+    f = [x for x in scan(tmp_path, {"m.py": bad}) if x.code == "T203"]
+    assert len(f) == 2  # the call site AND the bare decorator
+    good = """\
+import functools, jax
+from veles_tpu.telemetry import track_jit
+
+def build(f):
+    return track_jit("m.f", jax.jit(f))
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def rebound(x, n):
+    return x * n
+
+rebound = track_jit("m.rebound", rebound)
+"""
+    assert "T203" not in codes_of(scan(tmp_path, {"m.py": good}))
+
+
+def test_t204_missing_stable_registration(tmp_path):
+    src = "def apply_step_slots():\n    pass\n"
+    f = [x for x in scan(tmp_path, {"serving/engine.py": src})
+         if x.code == "T204"]
+    assert f and any(x.detail == "serving.slot_step" for x in f)
+
+
+# -- L-series ----------------------------------------------------------------
+
+_L301_BAD = """\
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._t = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        self._items.append(1)        # thread side, no lock
+
+    def push(self, x):
+        with self._lock:
+            self._items = [x]        # main side, locked
+"""
+
+
+def test_l301_unlocked_shared_write(tmp_path):
+    f = [x for x in scan(tmp_path, {"m.py": _L301_BAD})
+         if x.code == "L301"]
+    assert f and f[0].detail == "_items"
+    good = _L301_BAD.replace(
+        "        self._items.append(1)        # thread side, no lock",
+        "        with self._lock:\n"
+        "            self._items.append(1)")
+    assert "L301" not in codes_of(scan(tmp_path, {"m.py": good}))
+
+
+def test_l302_check_then_act(tmp_path):
+    bad = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cache = {}
+        self._thread = None
+
+    def put(self, k, v):
+        if k in self._cache:
+            return
+        self._cache[k] = v           # membership race
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self.put)  # early-return race
+"""
+    f = [x for x in scan(tmp_path, {"m.py": bad}) if x.code == "L302"]
+    assert {x.detail for x in f} == {"_cache", "_thread"}
+    good = bad.replace("        if k in self._cache:\n"
+                       "            return\n"
+                       "        self._cache[k] = v           "
+                       "# membership race",
+                       "        with self._lock:\n"
+                       "            if k not in self._cache:\n"
+                       "                self._cache[k] = v") \
+              .replace("        if self._thread is not None:\n"
+                       "            return\n"
+                       "        self._thread = threading.Thread("
+                       "target=self.put)  # early-return race",
+                       "        with self._lock:\n"
+                       "            if self._thread is None:\n"
+                       "                self._thread = "
+                       "threading.Thread(target=self.put)")
+    assert "L302" not in codes_of(scan(tmp_path, {"m.py": good}))
+
+
+def test_l_series_ignores_unthreaded_modules(tmp_path):
+    src = """\
+class C:
+    def get(self, k, v):
+        if k in self._cache:
+            return self._cache[k]
+        self._cache[k] = v
+"""
+    assert not [x for x in scan(tmp_path, {"m.py": src})
+                if x.code.startswith("L")]
+
+
+# -- C-series ----------------------------------------------------------------
+
+_CONFIG = """\
+root.common.update({
+    "engine": {"backend": "auto"},
+    "timings": False,
+    "open": {},
+    "dead": {"never_read": 1},
+})
+"""
+
+
+def test_c401_unknown_key(tmp_path):
+    files = {
+        "config.py": _CONFIG,
+        "use.py": """\
+from veles_tpu.config import root
+
+def f():
+    backend = root.common.engine.get("backend", "auto")
+    typo = root.common.engine.get("backnd")
+    missing = root.common.timing
+    ok_open = root.common.open.get("anything")
+    return backend, typo, missing, ok_open
+""",
+    }
+    f = [x for x in scan(tmp_path, files) if x.code == "C401"]
+    assert {x.detail for x in f} == {"engine.backnd", "timing"}
+
+
+def test_c401_alias_and_forwarder(tmp_path):
+    files = {
+        "config.py": _CONFIG,
+        "use.py": """\
+from veles_tpu.config import root
+
+def conf(name, default):
+    return root.common.engine.get(name, default)
+
+def g():
+    cfg = root.common.engine
+    a = cfg.get("backend")
+    b = cfg.get("oops")
+    c = conf("also_oops", 1)
+    return a, b, c
+""",
+    }
+    f = [x for x in scan(tmp_path, files) if x.code == "C401"]
+    assert {x.detail for x in f} == {"engine.oops", "engine.also_oops"}
+
+
+def test_c402_dead_default(tmp_path):
+    files = {
+        "config.py": _CONFIG,
+        "use.py": """\
+from veles_tpu.config import root
+
+def f():
+    return (root.common.engine.get("backend"),
+            root.common.get("timings"))
+""",
+    }
+    f = [x for x in scan(tmp_path, files) if x.code == "C402"]
+    assert {x.detail for x in f} == {"dead.never_read"}
+    # a dynamic read of the subtree suppresses the dead-key claim
+    files["use.py"] += """\
+
+def g(name):
+    return root.common.dead.get(name)
+"""
+    assert "C402" not in codes_of(scan(tmp_path, files))
+
+
+# -- baseline ----------------------------------------------------------------
+
+def test_baseline_suppresses_and_goes_stale(tmp_path):
+    src = ("import jax\nfrom veles_tpu.telemetry import track_jit\n"
+           "_step = track_jit('m.step', jax.jit(lambda x: x))\n")
+    (tmp_path / "m.py").write_text(src)
+    findings, fresh, stale, _ = analyze([str(tmp_path)],
+                                        root=tmp_path, baseline=False)
+    assert [f.code for f in fresh] == ["D103"]
+    bl = tmp_path / "bl.txt"
+    bl.write_text(format_entry(fresh[0], "fixture: deliberate") + "\n")
+    _, fresh2, stale2, _ = analyze([str(tmp_path)], root=tmp_path,
+                                   baseline=bl)
+    assert not fresh2 and not stale2
+    # fix the finding -> the entry is stale and --strict must say so
+    (tmp_path / "m.py").write_text("import jax\n")
+    _, fresh3, stale3, _ = analyze([str(tmp_path)], root=tmp_path,
+                                   baseline=bl)
+    assert not fresh3 and len(stale3) == 1
+
+
+def test_baseline_entries_require_reasons(tmp_path):
+    bl = tmp_path / "bl.txt"
+    bl.write_text("D103 m.py::<module>::_step\n")
+    with pytest.raises(ValueError):
+        load_baseline(bl)
+
+
+# -- the real tree (the tier-1 gate) ----------------------------------------
+
+def test_package_scans_clean_under_strict_and_fast():
+    """`python -m veles_tpu.analysis --strict veles_tpu/` == exit 0:
+    zero unbaselined findings, zero stale baseline entries, pure-AST
+    fast (<10 s)."""
+    t0 = time.perf_counter()
+    findings, fresh, stale, errors = analyze([str(PKG)],
+                                             root=REPO)
+    dt = time.perf_counter() - t0
+    assert not errors, errors
+    assert not fresh, "unbaselined findings:\n" + "\n".join(
+        str(f) for f in fresh)
+    assert not stale, "stale baseline entries:\n" + "\n".join(stale)
+    assert dt < 10.0, "analysis took %.1fs (budget 10s)" % dt
+    # the baseline is exercised, not decorative
+    assert sum(1 for f in findings if f.baselined) >= 10
+
+
+def test_every_code_has_a_registered_pass():
+    assert {"D101", "D102", "D103", "T201", "T202", "T203", "T204",
+            "L301", "L302", "C401", "C402"} == set(ALL_CODES)
+
+
+def test_cli_json_smoke_and_no_jax_import():
+    """The module CLI emits machine-consumable JSON and never imports
+    jax (CI can annotate from it without an accelerator runtime)."""
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import json, sys, io\n"
+         "from contextlib import redirect_stdout\n"
+         "import veles_tpu.analysis.__main__ as m\n"
+         "buf = io.StringIO()\n"
+         "with redirect_stdout(buf):\n"
+         "    rc = m.main(['--strict', '--format', 'json',\n"
+         "                 %r])\n"
+         "assert 'jax' not in sys.modules, 'analysis imported jax'\n"
+         "payload = json.loads(buf.getvalue())\n"
+         "print(json.dumps({'rc': rc,\n"
+         "                  'unbaselined': payload['unbaselined'],\n"
+         "                  'baselined': payload['baselined'],\n"
+         "                  'stale': payload['stale_baseline']}))\n"
+         % str(PKG)],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    digest = json.loads(out.stdout.strip().splitlines()[-1])
+    assert digest["rc"] == 0
+    assert digest["unbaselined"] == 0
+    assert digest["stale"] == []
+    assert digest["baselined"] >= 10

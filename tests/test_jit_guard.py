@@ -1,82 +1,68 @@
-"""Tier-1 static guard: every ``jax.jit`` call site inside
-``veles_tpu/`` must route through ``telemetry.track_jit`` so XLA
-compiles (and their cost accounting) can't silently escape the
-registry.  New entry points either wrap with
-``track_jit("name", jax.jit(...))`` or get an explicit allowlist
-entry here with a reason."""
+"""Tier-1 static guard over jit sites — now a thin shell around the
+veles-lint T-series pass (``veles_tpu/analysis/passes/purity.py``),
+so there is ONE jit-site scanner: every ``jax.jit`` inside
+``veles_tpu/`` must route through ``telemetry.track_jit`` (T203), the
+serving entry points must register their stable names (T204), and
+deliberate exceptions live in ``analysis/baseline.txt`` WITH reasons
+(the old in-test allowlist).  The AST pass is strictly stronger than
+the old regex: bare ``@jax.jit`` decorators (which ``jax\\.jit\\(``
+never matched) are now caught too."""
 
-import re
 from pathlib import Path
+
+import pytest
+
+from veles_tpu.analysis import analyze
+from veles_tpu.analysis.baseline import load_baseline
+from veles_tpu.analysis.passes.purity import (
+    REQUIRED_REGISTRATIONS, PurityPass)
 
 PKG = Path(__file__).resolve().parent.parent / "veles_tpu"
 
-#: (relative path, line fragment) pairs intentionally NOT tracked
-ALLOWLIST = (
-    # AOT export path: jax_export drives the jit exactly once to
-    # serialize StableHLO — there is no runtime entry point to count
-    ("package_export.py", "jax_export.export(jax.jit(forward))"),
-    # decorator form; the module wraps the decorated function with
-    # track_jit("ops.pallas_uniform", ...) right below the def
-    ("ops/random.py", "@functools.partial(jax.jit,"),
-)
+pytestmark = pytest.mark.analysis
 
-_SITE = re.compile(r"jax\.jit\(|functools\.partial\(\s*jax\.jit")
-#: lines of surrounding context in which the track_jit wrap must
-#: appear (multi-line wrap calls put it a couple of lines above)
-_CONTEXT = 3
+
+def _t_findings():
+    findings, fresh, stale, errors = analyze(
+        [str(PKG)], root=PKG.parent, passes=(PurityPass(),))
+    assert not errors, errors
+    return findings, fresh, stale
 
 
 def test_all_jax_jit_sites_are_tracked():
-    untracked = []
-    for path in sorted(PKG.rglob("*.py")):
-        rel = path.relative_to(PKG).as_posix()
-        lines = path.read_text().splitlines()
-        for i, line in enumerate(lines):
-            if not _SITE.search(line):
-                continue
-            if line.lstrip().startswith("#"):
-                continue
-            if any(rel == p and frag in line for p, frag in ALLOWLIST):
-                continue
-            ctx = "\n".join(lines[max(0, i - _CONTEXT):i + _CONTEXT])
-            if "track_jit" not in ctx:
-                untracked.append("%s:%d: %s" % (rel, i + 1,
-                                                line.strip()))
+    _, fresh, _ = _t_findings()
+    untracked = [str(f) for f in fresh if f.code == "T203"]
     assert not untracked, (
         "jax.jit call sites not routed through telemetry.track_jit "
         "(compiles would escape veles_jit_* metrics and cost "
         "accounting).  Wrap with track_jit(name, jax.jit(...)) or "
-        "allowlist with a reason:\n" + "\n".join(untracked))
-
-
-#: stable track_jit names the serving subsystem must register its
-#: compiled entry points under — bench and the compile dashboards key
-#: on them, and an unregistered paged-attention jit would silently
-#: escape veles_jit_* cost accounting
-SERVING_ENTRY_POINTS = (
-    ("serving/engine.py", "serving.slot_step"),
-    ("serving/engine.py", "serving.paged_step"),
-    ("serving/engine.py", "serving.sample_first"),
-    ("serving/prefill.py", "serving.prefill"),
-    ("serving/prefill.py", "serving.prefill_chunk"),
-    ("serving/kv_slots.py", "serving.kv_insert_row"),
-    ("serving/kv_slots.py", "serving.kv_insert_blocks"),
-)
+        "baseline with a reason in veles_tpu/analysis/baseline.txt:\n"
+        + "\n".join(untracked))
 
 
 def test_serving_jit_entry_points_registered():
-    for rel, name in SERVING_ENTRY_POINTS:
-        text = (PKG / rel).read_text()
-        assert 'track_jit("%s"' % name in text, (
-            "%s must register its compiled entry point with "
-            'track_jit("%s", jax.jit(...))' % (rel, name))
+    """T204: the stable entry-point names bench and the compile
+    dashboards key on must exist — and must never be baselined
+    away."""
+    findings, _, _ = _t_findings()
+    missing = [str(f) for f in findings if f.code == "T204"]
+    assert not missing, "\n".join(missing)
+    # the registry itself must still cover the serving surface
+    covered = {name for _, name in REQUIRED_REGISTRATIONS}
+    assert {"serving.slot_step", "serving.paged_step",
+            "serving.prefill", "serving.prefill_chunk",
+            "serving.kv_insert_row",
+            "serving.kv_insert_blocks"} <= covered
 
 
-def test_guard_allowlist_entries_still_exist():
-    """A stale allowlist entry means the exception it documented is
-    gone — prune it so it can't mask a future regression."""
-    for rel, frag in ALLOWLIST:
-        text = (PKG / rel).read_text()
-        assert frag in text, (
-            "allowlist entry (%s, %r) matches nothing — remove it"
-            % (rel, frag))
+def test_guard_baseline_entries_still_exist():
+    """A stale baseline entry means the exception it documented is
+    gone — prune it so it can't mask a future regression (the old
+    allowlist-pruning rule, now over every pass's entries)."""
+    findings, _, stale, _ = analyze([str(PKG)], root=PKG.parent)
+    assert not stale, (
+        "baseline entries matching no finding — remove them from "
+        "veles_tpu/analysis/baseline.txt:\n" + "\n".join(stale))
+    entries = load_baseline()
+    for key, reason in entries.items():
+        assert reason.strip(), "baseline entry %r has no reason" % key

@@ -147,7 +147,15 @@ class AcceleratedUnit(Unit):
                 jax.jit(stepper, donate_argnums=(0,)))
         tensors = self._gather()
         wset = set(self.writes)
-        donated = {a: t for a, t in tensors.items() if a in wset}
+        # state buffers are DONATED — hand over donation-safe ones
+        # (host-aliased CPU buffers get detached, memory.py)
+        donated = {}
+        for a, t in tensors.items():
+            if a not in wset:
+                continue
+            arr = getattr(self, a)
+            donated[a] = arr.donatable_devmem() \
+                if isinstance(arr, Array) else t
         held = {a: t for a, t in tensors.items() if a not in wset}
         self._scatter(self._jit_step_(donated, held))
 
@@ -230,11 +238,16 @@ class FusedSegment:
         if self._plan is None:
             self.plan()
         _, donated, held, outputs = self._plan
-        donated_vals = tuple(self._arrays[k].devmem for k in donated)
         held_vals = tuple(self._arrays[k].devmem for k in held)
         if root.common.engine.get("eager"):
+            donated_vals = tuple(self._arrays[k].devmem
+                                 for k in donated)
             results = self._fused(donated_vals, held_vals)
         else:
+            # the fused program donates the state slots — detach any
+            # host-aliased buffer first (memory.py, ROUND6_NOTES.md)
+            donated_vals = tuple(self._arrays[k].donatable_devmem()
+                                 for k in donated)
             if self._jit is None:
                 from veles_tpu.telemetry import track_jit
                 self._jit = track_jit(

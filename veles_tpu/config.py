@@ -147,8 +147,21 @@ root.common.update({
     },
     "engine": {
         "backend": os.environ.get("VELES_TPU_BACKEND", "auto"),
+        # eager: skip jit entirely (debugging, like the reference's
+        # numpy fallback); fuse: compile accelerated-unit chains into
+        # one XLA program per segment (accelerated_units.py)
+        "eager": False,
+        "fuse": True,
     },
     "timings": False,
+    # device mesh for StandardWorkflow sharding, e.g. {'dp': -1}
+    # (models/standard.py); None = single device
+    "mesh": None,
+    # appended to snapshot file names (ensemble members set 'ens<N>')
+    "snapshot_suffix": "",
+    # fraction of the train set an ensemble member sees (None = all;
+    # set per member by veles_tpu.ensemble)
+    "ensemble_train_ratio": None,
     # compilation_cache_dir: persistent XLA compilation cache
     # (jax_compilation_cache_dir) — kills multi-second recompiles
     # across CLI runs; also settable with --compilation-cache
@@ -185,7 +198,25 @@ root.common.update({
     # crash flight recorder (telemetry/flight_recorder.py): bundle
     # lands in `dir` (default: the snapshot dir) on crash/SIGUSR1
     "flightrec": {"enabled": True, "dir": None, "dump_on_exit": False},
-    "web": {"host": "localhost", "port": 8090},
+    # continuous-batching serving knobs (serving/scheduler.py):
+    # kv "paged"|"dense"; kv_blocks None derives the dense-equivalent
+    # pool (max_slots * ceil(window / block_size)); prefill_chunk 0
+    # disables chunked prefill
+    "serving": {
+        "kv": "paged",
+        "block_size": 16,
+        "kv_blocks": None,
+        "prefill_chunk": 64,
+        "warm_buckets": True,
+    },
+    # status dashboard bind address (web_status.py) and the
+    # status_url a Launcher pushes run updates to (None = don't)
+    "web": {"host": "localhost", "port": 8090, "status_url": None},
+    # live matplotlib graphics service (launcher --graphics)
+    "graphics": {"enabled": False, "port": 0},
+    # report publishing backends; keys under `confluence` are
+    # site-supplied (server/space/token/...) — an OPEN config subtree
+    "publishing": {"confluence": {}},
 })
 root.common.protect("dirs")
 

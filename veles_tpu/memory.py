@@ -134,6 +134,49 @@ class Array(Pickleable):
             self._upload()
         return self._devmem_
 
+    def _aliases_host(self, devmem):
+        """True when the host mirror and ``devmem`` share one
+        allocation.  XLA:CPU makes this common in BOTH directions:
+        ``jax.device_put`` borrows small (≲16 KB) numpy buffers
+        zero-copy, and ``numpy.asarray(devmem)`` (map_read) returns a
+        view of the device buffer.  Unknown layouts (sharded arrays
+        without a host pointer) report True — the safe answer."""
+        if self._mem is None or devmem is None:
+            return False
+        try:
+            return devmem.unsafe_buffer_pointer() \
+                == self._mem.ctypes.data
+        except Exception:
+            # no single host pointer (sharded array): only the CPU
+            # backend can alias host memory at all, so assume the
+            # worst there and nothing elsewhere
+            try:
+                plat = next(iter(devmem.devices())).platform
+            except Exception:
+                return True
+            return plat == "cpu"
+
+    def donatable_devmem(self):
+        """The device buffer, guaranteed safe to DONATE
+        (``donate_argnums``).  When host mirror and device buffer
+        share an allocation, donation lets XLA reuse — and write its
+        own (differently padded) output layout over — memory the host
+        side still references or OWNS: glibc's "corrupted size vs.
+        prev_size" family, the documented span-step heap corruption
+        (ROUND6_NOTES.md).  Detaches with ONE device-side copy, paid
+        only on the first step after a host write (init, snapshot
+        resume, DCN master/slave apply) — steady-state steps adopt
+        pure device outputs (DEV_DIRTY) and return the buffer as-is."""
+        dm = self.devmem
+        if self._state != COHERENT or not self._aliases_host(dm):
+            return dm
+        import jax.numpy as jnp
+        fresh = jnp.copy(dm)   # device-owned, never host-aliased
+        self._release_devmem()
+        self._devmem_ = fresh
+        Watcher.alloc(self._watch_key(), fresh.nbytes)
+        return fresh
+
     @devmem.setter
     def devmem(self, value):
         """Adopt a jitted-program output as the new device buffer."""
@@ -242,9 +285,16 @@ class Array(Pickleable):
     def map_write(self):
         """Host mirror current *and* about to be written."""
         self.map_read()
-        if self._mem is not None and not self._mem.flags.writeable:
+        if self._mem is not None and (
+                not self._mem.flags.writeable
+                or (self._state == COHERENT
+                    and self._aliases_host(self._devmem_))):
             # map_read may have adopted a read-only view of the device
-            # buffer; writers need their own copy
+            # buffer — writers need their own copy; a WRITEABLE mirror
+            # can still share the device buffer's allocation (zero-copy
+            # device_put of a small host array), and writing through it
+            # would mutate a buffer an asynchronously-dispatched XLA
+            # program may still be reading
             self._mem = numpy.array(self._mem)
         self._state = HOST_DIRTY
         return self
@@ -253,7 +303,10 @@ class Array(Pickleable):
         """Host will be fully overwritten — skip the device→host copy."""
         if self._mem is None and self._devmem_ is not None:
             self._mem = numpy.zeros(self._devmem_.shape, self._devmem_.dtype)
-        elif self._mem is not None and not self._mem.flags.writeable:
+        elif self._mem is not None and (
+                not self._mem.flags.writeable
+                or (self._state == COHERENT
+                    and self._aliases_host(self._devmem_))):
             self._mem = numpy.array(self._mem)
         self._state = HOST_DIRTY
         return self

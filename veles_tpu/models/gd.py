@@ -639,10 +639,14 @@ class GradientDescent(AcceleratedUnit):
     # -- execution -------------------------------------------------------------
 
     def _gather_state(self):
-        params = {i: {name: arr.devmem
+        # the step DONATES params/opt_state (donate_argnums=(0, 1)) —
+        # donatable_devmem detaches buffers whose host mirror shares
+        # the allocation (XLA:CPU zero-copy device_put / map_read
+        # views), the span-step heap-corruption fix (ROUND6_NOTES.md)
+        params = {i: {name: arr.donatable_devmem()
                       for name, arr in u.param_arrays().items()}
                   for i, u in enumerate(self.forwards)}
-        opt_state = {i: {name: {s: arr.devmem
+        opt_state = {i: {name: {s: arr.donatable_devmem()
                                 for s, arr in slots.items()}
                          for name, slots in layer.items()}
                      for i, layer in self.opt_state.items()}
@@ -731,7 +735,8 @@ class GradientDescent(AcceleratedUnit):
         key = self.prng.peek_key(self.global_step)
         new_params, new_opt, acc, loss, n_err, health = \
             self._train_step_(
-                params, opt_state, self.epoch_acc.devmem, x, target,
+                params, opt_state, self.epoch_acc.donatable_devmem(),
+                x, target,
                 jnp.int32(l.minibatch_size),
                 jnp.int32(l.minibatch_class),
                 jnp.float32(self.global_step),
@@ -775,7 +780,8 @@ class GradientDescent(AcceleratedUnit):
         key = self.prng.peek_key(self.global_step)
         new_params, new_opt, acc, loss, n_err, health = \
             self._span_step_(
-                params, opt_state, self.epoch_acc.devmem, ds, tgt,
+                params, opt_state, self.epoch_acc.donatable_devmem(),
+                ds, tgt,
                 idx, l.span_sizes_,
                 jnp.int32(l.span_class_), jnp.float32(self.global_step),
                 jnp.float32(self.lr_multiplier), key)

@@ -143,7 +143,7 @@ class KohonenTrainer(AcceleratedUnit):
             self._step_ = self._build_step()
         l = self.loader
         new_w, qerr = self._step_(
-            self.weights.devmem, l.minibatch_data.devmem,
+            self.weights.donatable_devmem(), l.minibatch_data.devmem,
             jnp.int32(l.minibatch_size), jnp.float32(self.time))
         self.weights.devmem = new_w
         self.qerror.devmem = qerr

@@ -113,7 +113,9 @@ class BernoulliRBM(AcceleratedUnit):
         l = self.loader
         key = self.prng.peek_key(self.global_step)
         w, vb, hb, err = self._step_(
-            self.weights.devmem, self.vbias.devmem, self.hbias.devmem,
+            self.weights.donatable_devmem(),
+            self.vbias.donatable_devmem(),
+            self.hbias.donatable_devmem(),
             l.minibatch_data.devmem, jnp.int32(l.minibatch_size), key)
         self.weights.devmem = w
         self.vbias.devmem = vb

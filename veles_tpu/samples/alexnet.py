@@ -143,6 +143,8 @@ class ImagenetLoader(FullBatchLoader):
                 data = data.reshape(data.shape[0], -1)
             return data
 
+        from veles_tpu.telemetry import track_jit
+        synth = track_jit("alexnet.synth_dataset", synth)
         with jax.default_device(dev):
             self.original_data = synth(
                 jax.random.key(42), jnp.asarray(labels))

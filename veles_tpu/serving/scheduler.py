@@ -176,6 +176,8 @@ class InferenceScheduler(Logger):
         if self.block_size < 1:
             raise ValueError("block_size must be >= 1")
         self.blocks_per_slot = -(-self.window // self.block_size)
+        if kv_blocks is None:
+            kv_blocks = _serving_conf("kv_blocks", None)
         self.kv_blocks = int(
             kv_blocks or self.max_slots * self.blocks_per_slot) \
             if self.kv == "paged" else 0
@@ -210,14 +212,26 @@ class InferenceScheduler(Logger):
         block until it is READY — cache built and the paged-step
         bucket ladder compiled — so traffic never eats warmup
         compiles as decode stalls."""
-        if self._thread is not None:
+        with self._lock:  # two racing start()s must not spawn two loops
+            if self._thread is not None:
+                started = True
+            else:
+                started = False
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name="serving-scheduler")
+        if started:
+            self._ready.wait(600)
             return self
-        for u in self.forwards:
-            for arr in u.param_arrays().values():
-                arr.devmem
-        self._thread = threading.Thread(
-            target=self._loop, daemon=True, name="serving-scheduler")
-        self._thread.start()
+        try:
+            for u in self.forwards:
+                for arr in u.param_arrays().values():
+                    arr.devmem
+            self._thread.start()
+        except BaseException:
+            with self._lock:  # release the claim so start() can retry
+                self._thread = None
+            raise
         self._ready.wait(600)
         return self
 
@@ -447,7 +461,8 @@ class InferenceScheduler(Logger):
         except Exception as e:
             self._retire(req, cache, error=e)
             return
-        self._prefilling.append(req)
+        with self._lock:  # close() swaps the list under the same lock
+            self._prefilling.append(req)
 
     def _admit_oneshot(self, req, cache):
         """Prefill one joining request in a single compiled pass and
@@ -472,7 +487,8 @@ class InferenceScheduler(Logger):
         """Advance the oldest mid-prefill request by ONE chunk — the
         per-iteration decode-stall bound; the decode step for every
         in-flight stream runs right after, in the same iteration."""
-        req = self._prefilling[0]
+        with self._lock:
+            req = self._prefilling[0]
         p_len = len(req.prompt)
         c = req.pf_chunk
         off = req.pf_off
@@ -487,14 +503,18 @@ class InferenceScheduler(Logger):
                 self.forwards, padded, off, [clen], req.pf_caches,
                 key_width=kw)
         except Exception as e:
-            self._prefilling.pop(0)
+            with self._lock:
+                if req in self._prefilling:
+                    self._prefilling.remove(req)
             self._retire(req, cache, error=e)
             return
         self.stats.record_prefill_chunk(
             clen, (time.perf_counter() - t0) * 1e3)
         req.pf_off = end
         if end >= p_len:
-            self._prefilling.pop(0)
+            with self._lock:
+                if req in self._prefilling:
+                    self._prefilling.remove(req)
             self._finish_admit(req, cache, req.pf_caches, last)
 
     def _finish_admit(self, req, cache, row_caches, last):

@@ -244,8 +244,10 @@ class _TrackedJit:
                 self._compiles_family.labels(self.name, kind).inc(
                     after - before)
                 self._hist.observe(dt)
-                if not self._seen_compile:
+                with _cost_lock:  # first-compile latch: one winner
+                    first_compile = not self._seen_compile
                     self._seen_compile = True
+                if first_compile:
                     self._first.set(dt)
                 events.record("jit.compile", "single", fn=self.name,
                               duration=dt)

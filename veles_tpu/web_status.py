@@ -213,11 +213,13 @@ def _render_events(run_id, events, unit=None, name=None, kind=None,
 class WebStatusServer(Logger):
     """The dashboard service (ref: web_status.py:113)."""
 
-    def __init__(self, port=8090):
+    def __init__(self, port=None, host=None):
         super(WebStatusServer, self).__init__()
         if not HAS_TORNADO:  # pragma: no cover
             raise RuntimeError("tornado is unavailable")
-        self.port = port
+        from veles_tpu.config import root
+        self.host = host or root.common.web.get("host", "localhost")
+        self.port = int(port or root.common.web.get("port", 8090))
         self.runs = {}
         server = self
 
@@ -321,7 +323,7 @@ class WebStatusServer(Logger):
 
     def start(self, background=True):
         if not background:
-            self.app.listen(self.port)
+            self.app.listen(self.port, self.host)
             tornado.ioloop.IOLoop.current().start()
             return
 
@@ -330,7 +332,7 @@ class WebStatusServer(Logger):
         def run():
             import asyncio
             asyncio.set_event_loop(asyncio.new_event_loop())
-            self.app.listen(self.port)
+            self.app.listen(self.port, self.host)
             self._loop = tornado.ioloop.IOLoop.current()
             started.set()
             self._loop.start()
@@ -414,7 +416,8 @@ class StatusNotifier(Logger):
 
 def main(argv=None):
     p = argparse.ArgumentParser(prog="veles_tpu.web_status")
-    p.add_argument("--port", type=int, default=8090)
+    p.add_argument("--port", type=int, default=None,
+                   help="default: root.common.web.port (8090)")
     args = p.parse_args(argv)
     WebStatusServer(port=args.port).start(background=False)
     return 0
