@@ -201,14 +201,26 @@ root.common.update({
     # continuous-batching serving knobs (serving/scheduler.py):
     # kv "paged"|"dense"; kv_blocks None derives the dense-equivalent
     # pool (max_slots * ceil(window / block_size)); prefill_chunk 0
-    # disables chunked prefill
+    # disables chunked prefill; request_timeout is the whole-request
+    # deadline in seconds (queued + decoding; 0 disables); watchdog is
+    # the stuck-decode-loop detector threshold in seconds (0 disables
+    # — keep it far above the worst first-compile stall);
+    # shed_block_factor sheds new submits (503) once the queue's
+    # committed block budget exceeds factor x kv_blocks (0 disables)
     "serving": {
         "kv": "paged",
         "block_size": 16,
         "kv_blocks": None,
         "prefill_chunk": 64,
         "warm_buckets": True,
+        "request_timeout": 120.0,
+        "watchdog": 300.0,
+        "shed_block_factor": 4.0,
     },
+    # fault injection (veles_tpu/faults/): spec string parsed on first
+    # fire(), same grammar as the VELES_FAULTS env var —
+    # "point=action[:arg][@after][xtimes][~key];..." (empty = unarmed)
+    "faults": {"spec": ""},
     # status dashboard bind address (web_status.py) and the
     # status_url a Launcher pushes run updates to (None = don't)
     "web": {"host": "localhost", "port": 8090, "status_url": None},

@@ -1,0 +1,230 @@
+"""Deterministic fault injection — the registry tier-1 drives the
+fault-tolerance machinery with.
+
+Veles's DCN contract (PAPER.md: the master re-distributes work on
+worker loss) only stays honored if every failure path is *exercised*;
+waiting for real failures exercises none of them.  This package plants
+named **injection points** through the serving scheduler, the REST
+endpoint and the coordinator/worker pair; each point is a no-op until
+a matching :class:`FaultSpec` is armed, at which moment the point
+deterministically misbehaves:
+
+=============  =========================================================
+action         behavior at the injection point
+=============  =========================================================
+``delay``      sleep ``arg`` seconds (default 0.05) — a slow step/frame
+``exception``  raise :class:`InjectedFault` — a crashing step/handler
+``hang``       sleep ``arg`` seconds (default 3600) — a stuck step the
+               watchdogs must detect; tests arm finite hangs so the
+               victim eventually *recovers* and cleanup can be asserted
+``drop``       :func:`fire` returns True — the caller discards its unit
+               of work (a frame, a heartbeat, a reply)
+``kill``       ``os._exit(17)`` — sudden process death (real multi-
+               process failover drills only; in-process tests prefer
+               ``hang`` + heartbeat ``drop``)
+=============  =========================================================
+
+Specs carry three modifiers: ``after=N`` skips the first N hits (arm
+the 3rd decode step, not the 1st), ``times=M`` disarms after M firings
+(a transient fault), and ``key=PATTERN`` scopes the spec to one
+caller (e.g. one worker id) when several share a point.  Points and
+keys match with :mod:`fnmatch` wildcards, so ``serving.*`` arms a
+whole subsystem.
+
+Arming happens through :func:`inject` (tests), :func:`load` (a spec
+string), the ``VELES_FAULTS`` environment variable, or
+``root.common.faults.spec`` — the latter two parsed once on first
+:func:`fire`.  Spec-string grammar, clauses separated by ``;``::
+
+    point=action[:arg][@after][xtimes][~key]
+    VELES_FAULTS="serving.scheduler.step=hang:1.5@3x1;restful.generate=delay:0.01"
+
+Every firing increments ``veles_faults_injected_total{point,action}``
+and lands in the JSONL event ring, so a soak run's injected faults are
+auditable next to the failures they provoked.
+
+:func:`fire` is safe from any thread; an unarmed registry costs one
+uncontended lock acquisition per call.
+"""
+
+import fnmatch
+import os
+import threading
+import time
+
+__all__ = ("InjectedFault", "FaultSpec", "inject", "load", "clear",
+           "active", "fire")
+
+ACTIONS = ("delay", "exception", "hang", "drop", "kill")
+
+
+class InjectedFault(Exception):
+    """Raised at an ``exception``-armed injection point."""
+
+
+class FaultSpec:
+    """One armed fault: where (``point``/``key`` patterns), what
+    (``action`` + ``arg``), and when (``after``/``times``)."""
+
+    __slots__ = ("point", "action", "arg", "after", "times", "key",
+                 "hits", "fired")
+
+    def __init__(self, point, action, arg=None, after=0, times=None,
+                 key=None):
+        if action not in ACTIONS:
+            raise ValueError("unknown fault action %r (one of %s)"
+                             % (action, ", ".join(ACTIONS)))
+        self.point = str(point)
+        self.action = action
+        self.arg = arg
+        self.after = int(after)
+        self.times = None if times is None else int(times)
+        self.key = key
+        self.hits = 0
+        self.fired = 0
+
+    def matches(self, point, key):
+        if not fnmatch.fnmatchcase(point, self.point):
+            return False
+        if self.key is None:
+            return True
+        return key is not None and fnmatch.fnmatchcase(str(key),
+                                                       self.key)
+
+    def __repr__(self):
+        return "<fault %s=%s arg=%r after=%d times=%r key=%r " \
+            "fired=%d>" % (self.point, self.action, self.arg,
+                           self.after, self.times, self.key,
+                           self.fired)
+
+
+_lock = threading.Lock()
+_specs = []
+_env_loaded = False
+
+
+def _metric():
+    from veles_tpu.telemetry import metrics
+    return metrics.counter(
+        "veles_faults_injected_total",
+        "fault injections fired, by point and action",
+        labelnames=("point", "action"))
+
+
+def _parse_clause(clause):
+    """``point=action[:arg][@after][xtimes][~key]`` → FaultSpec."""
+    point, sep, rest = clause.partition("=")
+    if not sep or not point.strip():
+        raise ValueError("fault clause %r is not point=action[...]"
+                         % clause)
+    rest, _, key = rest.partition("~")
+    key = key.strip() or None
+    times = None
+    if "x" in rest:
+        rest, _, t = rest.rpartition("x")
+        times = int(t)
+    after = 0
+    if "@" in rest:
+        rest, _, a = rest.rpartition("@")
+        after = int(a)
+    action, _, arg = rest.partition(":")
+    return FaultSpec(point.strip(), action.strip(),
+                     arg=float(arg) if arg else None,
+                     after=after, times=times, key=key)
+
+
+def load(spec):
+    """Arm every ``;``-separated clause of a spec string (the
+    ``VELES_FAULTS`` / ``root.common.faults.spec`` grammar)."""
+    armed = []
+    for clause in (spec or "").split(";"):
+        clause = clause.strip()
+        if clause:
+            armed.append(_parse_clause(clause))
+    with _lock:
+        _specs.extend(armed)
+    return armed
+
+
+def _load_env_locked():
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True  # latch FIRST: a bad spec must not re-raise per fire
+    spec = os.environ.get("VELES_FAULTS", "")
+    if not spec:
+        try:
+            from veles_tpu.config import root
+            spec = root.common.faults.get("spec", "") or ""
+        except Exception:
+            spec = ""
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if clause:
+            _specs.append(_parse_clause(clause))
+
+
+def inject(point, action, arg=None, after=0, times=None, key=None):
+    """Arm one fault programmatically; returns the spec handle."""
+    spec = FaultSpec(point, action, arg=arg, after=after, times=times,
+                     key=key)
+    with _lock:
+        _specs.append(spec)
+    return spec
+
+
+def clear(point=None):
+    """Disarm everything (or only specs whose point pattern equals
+    ``point``).  Tests call this in teardown."""
+    with _lock:
+        if point is None:
+            del _specs[:]
+        else:
+            _specs[:] = [s for s in _specs if s.point != point]
+
+
+def active():
+    """Snapshot of armed specs (operator/debug introspection)."""
+    with _lock:
+        _load_env_locked()
+        return list(_specs)
+
+
+def fire(point, key=None):
+    """The injection point: call at a hazard site; returns True when
+    an armed ``drop`` spec says to discard this unit of work.  May
+    sleep (``delay``/``hang``), raise :class:`InjectedFault`
+    (``exception``) or end the process (``kill``)."""
+    with _lock:
+        _load_env_locked()
+        if not _specs:
+            return False
+        due = []
+        for s in _specs:
+            if not s.matches(point, key):
+                continue
+            s.hits += 1
+            if s.hits <= s.after:
+                continue
+            if s.times is not None and s.fired >= s.times:
+                continue
+            s.fired += 1
+            due.append(s)
+    drop = False
+    for s in due:  # sleeps/raises happen OUTSIDE the registry lock
+        _metric().labels(point=point, action=s.action).inc()
+        from veles_tpu.logger import events
+        events.record("fault.injected", "single", cls="faults",
+                      point=point, action=s.action, key=key,
+                      arg=s.arg)
+        if s.action == "delay":
+            time.sleep(float(s.arg if s.arg is not None else 0.05))
+        elif s.action == "hang":
+            time.sleep(float(s.arg if s.arg is not None else 3600.0))
+        elif s.action == "exception":
+            raise InjectedFault("injected fault at %s" % point)
+        elif s.action == "drop":
+            drop = True
+        elif s.action == "kill":
+            os._exit(17)
+    return drop

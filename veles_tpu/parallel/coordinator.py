@@ -24,17 +24,39 @@ worker is banned only after ``blacklist_strikes`` timeouts, a completed
 job clears its strikes, and bans expire after ``blacklist_forgive``
 seconds (plus an explicit :meth:`Coordinator.forgive`) so a once-slow
 worker on a loaded host can rejoin the fleet.
+
+Death detection is two-tier.  A modern :class:`WorkerClient` runs its
+job in a thread-pool executor and keeps a **heartbeat** task pinging
+the coordinator every ``heartbeat_interval`` seconds even mid-job; a
+worker whose pings stop for ``heartbeat_timeout`` seconds while it
+holds a job is declared dead LONG before the mean+3σ job watchdog
+would fire, its connection is torn down and its in-flight job frame
+is requeued to the live fleet (``veles_coordinator_reassigned_total``)
+— epoch sample accounting stays exact because ``drop_slave`` refiles
+the dead worker's minibatches, honoring the Veles DCN contract
+(PAPER.md: the master re-distributes work on worker loss).  Workers
+that never ping (legacy/raw peers) keep the job-timeout tier only.
+Worker reconnects use capped exponential backoff with jitter
+(``veles_coordinator_reconnects_total``) so a restarting coordinator
+is not met by a synchronized thundering herd.
+
+Injection points (``coordinator.*`` — :mod:`veles_tpu.faults`, keyed
+by worker id) let tier-1 arm dropped heartbeats, hung jobs, slow
+dispatches and crashing handlers deterministically.
 """
 
 import asyncio
 import collections
 import contextlib
+import functools
 import gzip
 import pickle
+import random
 import struct
 import time
 import uuid
 
+from veles_tpu import faults
 from veles_tpu.logger import Logger
 
 _HDR = struct.Struct("!IB")  # length, flags
@@ -58,6 +80,14 @@ def _coord_metrics():
         "dropped": metrics.counter(
             "veles_coordinator_workers_dropped_total",
             "worker sessions dropped (timeouts, disconnects, evictions)"),
+        "reassigned": metrics.counter(
+            "veles_coordinator_reassigned_total",
+            "in-flight job frames requeued to the live fleet after "
+            "their worker died (heartbeat/job-timeout/disconnect)"),
+        "heartbeat_deaths": metrics.counter(
+            "veles_coordinator_heartbeat_deaths_total",
+            "workers declared dead because their heartbeats stopped "
+            "mid-job"),
         "job_seconds": metrics.histogram(
             "veles_coordinator_job_seconds",
             "job round-trip time (dispatch to update)"),
@@ -98,6 +128,14 @@ class WorkerDescription:
         #: worker's event stream stitches to the master's in merged
         #: Chrome-trace exports)
         self.trace = None
+        #: wall stamp of the last frame received on this session; a
+        #: pinging worker that goes silent mid-job is declared dead
+        #: at heartbeat_timeout (far before the job watchdog)
+        self.last_seen = time.time()
+        #: the session has sent at least one ping — only then does
+        #: silence mean death (legacy peers never ping; their only
+        #: death tier is the job timeout)
+        self.heartbeats = False
 
     def __repr__(self):
         return "<worker %s power=%.1f jobs=%d state=%s>" % (
@@ -115,12 +153,16 @@ class Coordinator(Logger):
 
     def __init__(self, workflow, host="127.0.0.1", port=5050,
                  job_timeout=60.0, blacklist_strikes=3,
-                 blacklist_forgive=300.0, watchdog_interval=1.0):
+                 blacklist_forgive=300.0, watchdog_interval=1.0,
+                 heartbeat_timeout=10.0):
         super(Coordinator, self).__init__()
         self.workflow = workflow
         self.host, self.port = host, port
         self.job_timeout = job_timeout
         self.watchdog_interval = float(watchdog_interval)
+        #: a pinging worker silent this long while holding a job is
+        #: dead — its frame requeues to the live fleet (0 disables)
+        self.heartbeat_timeout = float(heartbeat_timeout)
         self.blacklist_strikes = int(blacklist_strikes)
         self.blacklist_forgive = float(blacklist_forgive)
         self.workers = {}
@@ -278,7 +320,13 @@ class Coordinator(Logger):
     async def _serve_worker(self, worker, reader):
         while True:
             msg = await recv_frame(reader)
+            worker.last_seen = time.time()
             cmd = msg.get("cmd")
+            if cmd == "ping":
+                # liveness only — no reply (the worker's read loop is
+                # elsewhere); the stamp above is the whole point
+                worker.heartbeats = True
+                continue
             if cmd == "job":
                 if self.workers.get(worker.id) is not worker:
                     # dropped/evicted session — don't hand a ghost a job
@@ -289,6 +337,11 @@ class Coordinator(Logger):
                     await self._finish_session(worker, reader)
                     return
                 if self._has_more_jobs():
+                    # injected dispatch faults: a delayed/dropped job
+                    # frame exercises the worker-side timeout paths
+                    if faults.fire("coordinator.dispatch",
+                                   key=worker.id):
+                        continue
                     job = self.workflow.generate_data_for_slave(worker.id)
                 else:
                     # out of fresh jobs but updates still in flight —
@@ -395,6 +448,15 @@ class Coordinator(Logger):
             # the workflow refiles the worker's in-flight minibatches
             # (ref: loader/base.py:679-687 failed_minibatches); the
             # requeued work may unpark idle workers
+            if worker.state == "WORK":
+                # the dead session held a job frame — its work is now
+                # the live fleet's (the Veles DCN reassignment)
+                self._metrics["reassigned"].inc()
+                if worker.trace is not None:
+                    self.event("job", "end", span=worker.trace,
+                               trace=worker.trace, worker=worker.id,
+                               error="WorkerLost")
+                    worker.trace = None
             self.workflow.drop_slave(worker.id)
             self.info("worker %s dropped — work requeued", worker.id)
             asyncio.ensure_future(self._wake_idle())
@@ -434,21 +496,33 @@ class Coordinator(Logger):
             thr = self._timeout_threshold()
             now = time.time()
             for w in list(self.workers.values()):
+                if self.heartbeat_timeout > 0 and w.heartbeats \
+                        and w.state == "WORK" \
+                        and now - w.last_seen > self.heartbeat_timeout:
+                    # the heartbeat tier: a pinging worker went silent
+                    # mid-job — dead or wedged either way; reassign
+                    # its frame NOW instead of waiting out mean+3σ
+                    self.warning(
+                        "worker %s silent %.1fs mid-job (heartbeat "
+                        "timeout %.1fs) — declaring dead, requeueing",
+                        w.id, now - w.last_seen,
+                        self.heartbeat_timeout)
+                    self._metrics["heartbeat_deaths"].inc()
+                    self._strike(w.id, now)
+                    try:
+                        w.writer.close()
+                    except Exception:
+                        pass
+                    self._drop(w, requeue=True)
+                    continue
                 if w.state == "WORK" and w.job_started \
                         and now - w.job_started > thr:
-                    rec = self._offenders.setdefault(
-                        w.id, {"count": 0, "last_strike": now,
-                               "banned_at": None})
-                    rec["count"] += 1
-                    rec["last_strike"] = now
-                    n = rec["count"]
+                    n = self._strike(w.id, now)
                     if n >= self.blacklist_strikes:
                         self.warning(
                             "worker %s exceeded job timeout %.1fs "
                             "(strike %d/%d) — dropping + blacklisting",
                             w.id, thr, n, self.blacklist_strikes)
-                        self.blacklist.add(w.id)
-                        rec["banned_at"] = now
                     else:
                         self.warning(
                             "worker %s exceeded job timeout %.1fs "
@@ -460,6 +534,18 @@ class Coordinator(Logger):
                         pass
                     self._drop(w, requeue=True)
 
+    def _strike(self, wid, now):
+        """Record one timeout strike against ``wid`` (repeat-offender
+        semantics); the Nth strike bans.  Returns the new count."""
+        rec = self._offenders.setdefault(
+            wid, {"count": 0, "last_strike": now, "banned_at": None})
+        rec["count"] += 1
+        rec["last_strike"] = now
+        if rec["count"] >= self.blacklist_strikes:
+            self.blacklist.add(wid)
+            rec["banned_at"] = now
+        return rec["count"]
+
 
 class RejectedError(ConnectionError):
     """The coordinator actively refused this worker (blacklisted,
@@ -468,22 +554,48 @@ class RejectedError(ConnectionError):
 
 
 class WorkerClient(Logger):
-    """Reconnecting worker (ref: veles/client.py Client)."""
+    """Reconnecting worker (ref: veles/client.py Client).
+
+    Jobs execute in a thread-pool executor so the event loop stays
+    live mid-job: a heartbeat task pings the coordinator every
+    ``heartbeat_interval`` seconds (0 disables), which is what lets
+    the master tell "working on a long job" from "dead" without
+    waiting out the mean+3σ job watchdog.  Transport losses reconnect
+    with capped exponential backoff plus jitter (base
+    ``reconnect_delay``, cap ``reconnect_cap``, budget
+    ``max_reconnects``) — a coordinator restart must not be greeted by
+    every worker at once."""
 
     def __init__(self, workflow, address, power=None, worker_id=None,
-                 reconnect_delay=1.0, max_reconnects=10):
+                 reconnect_delay=1.0, max_reconnects=10,
+                 reconnect_cap=30.0, heartbeat_interval=1.0):
         super(WorkerClient, self).__init__()
         self.workflow = workflow
         host, _, port = address.rpartition(":")
         self.host, self.port = host or "127.0.0.1", int(port)
         self.power = power
         self.worker_id = worker_id
-        self.reconnect_delay = reconnect_delay
+        self.reconnect_delay = float(reconnect_delay)
+        self.reconnect_cap = float(reconnect_cap)
         self.max_reconnects = max_reconnects
+        self.heartbeat_interval = float(heartbeat_interval)
+
+    def _backoff(self, attempt):
+        """Delay before reconnect ``attempt`` (1-based): exponential
+        from ``reconnect_delay``, capped at ``reconnect_cap``, with
+        half-window jitter so a fleet's retries decorrelate."""
+        base = min(self.reconnect_cap,
+                   self.reconnect_delay * (2 ** (attempt - 1)))
+        return base * (0.5 + 0.5 * random.random())
 
     async def run(self):
+        from veles_tpu.telemetry import metrics
+        reconnects = metrics.counter(
+            "veles_coordinator_reconnects_total",
+            "worker reconnect attempts after a lost coordinator "
+            "connection (exponential backoff with jitter)")
         attempts = 0
-        while attempts <= self.max_reconnects:
+        while True:
             try:
                 await self._session()
                 return
@@ -493,13 +605,49 @@ class WorkerClient(Logger):
                 raise
             except (ConnectionError, asyncio.IncompleteReadError, OSError):
                 attempts += 1
-                self.warning("connection lost — reconnect %d/%d",
-                             attempts, self.max_reconnects)
-                await asyncio.sleep(self.reconnect_delay)
-        raise ConnectionError("coordinator unreachable")
+                if attempts > self.max_reconnects:
+                    raise ConnectionError(
+                        "coordinator unreachable after %d reconnect "
+                        "attempts" % self.max_reconnects)
+                delay = self._backoff(attempts)
+                reconnects.inc()
+                self.warning("connection lost — reconnect %d/%d in "
+                             "%.2fs", attempts, self.max_reconnects,
+                             delay)
+                await asyncio.sleep(delay)
+
+    async def _heartbeat(self, writer):
+        """Ping until cancelled: the coordinator reads liveness off
+        these even while the executor grinds a long job.  A ``drop``
+        fault here simulates the half-dead worker (socket open, a job
+        in hand, nothing flowing) heartbeat death detection exists
+        for."""
+        try:
+            while True:
+                await asyncio.sleep(self.heartbeat_interval)
+                if faults.fire("coordinator.worker.heartbeat",
+                               key=self.worker_id):
+                    continue
+                await send_frame(writer, {"cmd": "ping"})
+        except (ConnectionError, OSError):
+            return  # session teardown races us; the main loop reports
+
+    def _run_job(self, data, on_done):
+        """Executor-side job body: the injected-fault hook first (a
+        ``hang`` here is a wedged worker whose heartbeats — or their
+        injected absence — decide its fate), then the real work."""
+        faults.fire("coordinator.worker.job", key=self.worker_id)
+        self.workflow.do_job(data, None, on_done)
 
     async def _session(self):
+        import concurrent.futures
         reader, writer = await asyncio.open_connection(self.host, self.port)
+        heartbeat = None
+        # one dedicated job thread per worker: jobs of THIS worker
+        # stay serialized (the pre-executor contract) while the event
+        # loop — heartbeats, other in-process workers — keeps running
+        executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="worker-job")
         try:
             await send_frame(writer, {
                 "checksum": self.workflow.checksum(),
@@ -511,6 +659,9 @@ class WorkerClient(Logger):
                 raise RejectedError(reply["error"])
             self.worker_id = reply["id"]
             self.info("joined as worker %s", self.worker_id)
+            if self.heartbeat_interval > 0:
+                heartbeat = asyncio.ensure_future(
+                    self._heartbeat(writer))
             while True:
                 await send_frame(writer, {"cmd": "job"})
                 msg = await recv_frame(reader)
@@ -537,7 +688,12 @@ class WorkerClient(Logger):
                            trace=trace, worker=self.worker_id)
                 t0 = time.time()
                 try:
-                    self.workflow.do_job(msg["data"], None, on_done)
+                    # the executor keeps the EVENT LOOP free while the
+                    # job grinds: heartbeats (and other workers in the
+                    # same process) keep flowing
+                    await asyncio.get_running_loop().run_in_executor(
+                        executor, functools.partial(
+                            self._run_job, msg["data"], on_done))
                 finally:
                     self.event("job.work", "end", span=trace,
                                trace=trace, worker=self.worker_id,
@@ -545,6 +701,14 @@ class WorkerClient(Logger):
                 await send_frame(writer, {"cmd": "update",
                                           "data": update.get("data")})
         finally:
+            if heartbeat is not None:
+                heartbeat.cancel()
+                with contextlib.suppress(
+                        asyncio.CancelledError, Exception):
+                    await heartbeat
+            # wait=False: a hung job must not wedge session teardown
+            # (its thread ends with the hang; the session is gone)
+            executor.shutdown(wait=False)
             writer.close()
             with contextlib.suppress(Exception):
                 await writer.wait_closed()

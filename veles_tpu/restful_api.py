@@ -17,6 +17,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy
 
+from veles_tpu import faults
 from veles_tpu.loader.interactive import InteractiveLoader
 from veles_tpu.memory import Array
 from veles_tpu.units import Unit
@@ -186,13 +187,30 @@ class RESTfulAPI(Unit):
         interleave in the slots like independent clients).  Returns
         per-row token lists, each ending at its first generated stop
         token.  A pinned seed stays reproducible per row (row i draws
-        from seed + i)."""
-        futures = [self.scheduler_.submit(
-            row, steps, temperature=temperature, top_k=top_k,
-            seed=None if seed is None else int(seed) + i,
-            stop_token=stop, timeout=self.request_timeout)
-            for i, row in enumerate(rows)]
-        return [f.result(self.request_timeout) for f in futures]
+        from seed + i).
+
+        Any failure (a row's scheduler error, a timeout, the handler
+        thread dying with its client) CANCELS the batch's unfinished
+        futures — an abandoned request must hand its slot and KV
+        blocks back at the next decode boundary instead of decoding
+        for a client that is gone."""
+        futures = []
+        try:
+            for i, row in enumerate(rows):
+                futures.append(self.scheduler_.submit(
+                    row, steps, temperature=temperature, top_k=top_k,
+                    seed=None if seed is None else int(seed) + i,
+                    stop_token=stop, timeout=self.request_timeout))
+            # the scheduler enforces the deadline itself (408 with
+            # partial-token count); the result wait is only a backstop
+            # against a wedged loop with the watchdog disabled
+            return [f.result(self.request_timeout + 30.0)
+                    for f in futures]
+        except BaseException:
+            for f in futures:
+                if not f.done():
+                    self.scheduler_.cancel(f)
+            raise
 
     def init_unpickled(self):
         super(RESTfulAPI, self).init_unpickled()
@@ -200,6 +218,9 @@ class RESTfulAPI(Unit):
         self._thread_ = None
         self._legacy_lock_ = threading.Lock()
         self.scheduler_ = None
+        #: POST /drain latched: /healthz answers 503 "draining" and
+        #: the scheduler (if any) stops admitting
+        self._draining_ = False
 
     def initialize(self, **kwargs):
         super(RESTfulAPI, self).initialize(**kwargs)
@@ -258,13 +279,25 @@ class RESTfulAPI(Unit):
                     # model is trainable/servable, 503 once the halt
                     # policy latched (the process stays up for
                     # forensics — load balancers just stop routing)
+                    # or once a drain began (rolling restarts: the
+                    # router stops sending traffic, in-flight work
+                    # finishes)
                     import os
                     from veles_tpu.telemetry.health import monitor
                     state = monitor.state()
+                    status = state["status"]
+                    reply = {"status": status, "pid": os.getpid(),
+                             "health": state}
+                    if api._draining_:
+                        status = reply["status"] = "draining"
+                        sch = api.scheduler_
+                        reply["in_flight"] = \
+                            sch.in_flight if sch is not None else 0
+                        reply["drained"] = \
+                            sch.drained if sch is not None else True
                     self._reply_json(
-                        {"status": state["status"], "pid": os.getpid(),
-                         "health": state},
-                        code=503 if state["status"] == "halted"
+                        reply,
+                        code=503 if status in ("halted", "draining")
                         else 200)
                     return
                 if route == "/debug/state":
@@ -305,6 +338,34 @@ class RESTfulAPI(Unit):
                 self.end_headers()
                 self.wfile.write(blob)
 
+            def _reply_error(self, code, message, retry_after=None,
+                             **extra):
+                """Structured error reply: ``{"error": {"code",
+                "message", ...}}``; a 503's Retry-After header tells
+                retrying clients (and the future router) when this
+                replica is worth another attempt."""
+                err = {"code": int(code),
+                       "message": str(message or "")}
+                err.update({k: v for k, v in extra.items()
+                            if v is not None})
+                blob = json.dumps({"error": err},
+                                  default=str).encode()
+                self.send_response(int(code))
+                self.send_header("Content-Type", "application/json")
+                if retry_after is not None:
+                    self.send_header("Retry-After",
+                                     str(max(1, int(retry_after))))
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                if getattr(self, "command", None) != "HEAD":
+                    self.wfile.write(blob)
+
+            def send_error(self, code, message=None, explain=None):
+                # every error path (including the base class's own
+                # calls) answers the structured JSON body — ad-hoc
+                # HTML error pages are not machine-parseable
+                self._reply_error(code, message or explain or "")
+
             def do_POST(self):
                 if self.path.rstrip("/") == "/shutdown":
                     # control-plane guard: when serving beyond loopback,
@@ -318,12 +379,31 @@ class RESTfulAPI(Unit):
                     if api.shutdown_callback is not None:
                         api.shutdown_callback()
                     return
+                if self.path.rstrip("/") == "/drain":
+                    # rolling-restart hook: stop admitting (new
+                    # submits 503 + Retry-After), finish in-flight,
+                    # flip /healthz to 503 so the router drains this
+                    # replica.  Loopback-only like /shutdown — an
+                    # open drain is a one-request traffic blackhole.
+                    peer = self.client_address[0]
+                    if peer not in ("127.0.0.1", "::1", "localhost"):
+                        self.send_error(403, "drain is loopback-only")
+                        return
+                    api._draining_ = True
+                    reply = {"draining": True}
+                    if api.scheduler_ is not None:
+                        api.scheduler_.drain()
+                        reply["in_flight"] = api.scheduler_.in_flight
+                        reply["drained"] = api.scheduler_.drained
+                    self._reply_json(reply, code=202)
+                    return
                 if self.path.rstrip("/") == "/generate":
                     if api.forwards is None:
                         self.send_error(
                             404, "this endpoint serves no LM chain")
                         return
                     try:
+                        faults.fire("restful.generate")
                         length = int(
                             self.headers.get("Content-Length", 0))
                         body = json.loads(self.rfile.read(length))
@@ -474,12 +554,22 @@ class RESTfulAPI(Unit):
                                 self.send_error(400, _status_text(e))
                                 return
                             except SchedulerError as e:
-                                self.send_error(e.http_status,
-                                                _status_text(e))
+                                # 503s carry Retry-After; a deadline
+                                # 408 reports the partial decode the
+                                # client paid for before expiry
+                                self._reply_error(
+                                    e.http_status, _status_text(e),
+                                    retry_after=getattr(
+                                        e, "retry_after", None),
+                                    tokens_generated=getattr(
+                                        e, "tokens_generated", None),
+                                    draining=True
+                                    if api._draining_ else None)
                                 return
                             except concurrent.futures.TimeoutError:
-                                self.send_error(
-                                    408, "decode timed out")
+                                self._reply_error(
+                                    408, "decode timed out",
+                                    tokens_generated=0)
                                 return
                             self._reply_json(
                                 {"tokens": outs[0] if squeeze

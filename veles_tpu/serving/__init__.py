@@ -41,5 +41,5 @@ from veles_tpu.serving.metrics import ServingMetrics  # noqa: F401
 from veles_tpu.serving.prefill import (  # noqa: F401
     chunked_supported, prefill, prefill_chunk, serving_supported)
 from veles_tpu.serving.scheduler import (  # noqa: F401
-    DeadlineExceededError, InferenceScheduler, QueueFullError,
-    SchedulerError)
+    DeadlineExceededError, DrainingError, InferenceScheduler,
+    QueueFullError, RequestCancelledError, SchedulerError)

@@ -59,10 +59,14 @@ def _fold_keys(seeds, counts):
             seeds, counts)
 
 
-def sample_first(logits, temps, topks, seeds):
-    """First-token sampler over prefill's last-position logits
-    (draw counter 0 of each request's stream)."""
-    keys = _fold_keys(seeds, jnp.zeros(seeds.shape, jnp.int32))
+def sample_first(logits, temps, topks, seeds, counts):
+    """Post-prefill token sampler over the last-position logits:
+    draw ``counts[n]`` of each request's stream — 0 for a fresh
+    admission, ``len(generated)`` for a preempted request resuming
+    after a re-prefill of prompt + prefix (the SAME key fold the
+    decode step would have used, so the resumed stream is
+    bit-identical to the uninterrupted one)."""
+    keys = _fold_keys(seeds, counts)
     return sample_slots(logits, temps, topks, keys)
 
 
@@ -190,11 +194,16 @@ def paged_decode_step(forwards, cache, toks, pos, tables, temps,
     return nxt
 
 
-def first_tokens(last_logits, temps, topks, seeds):
-    """Sample each admitted request's FIRST token from its prefill
-    logits ([k, vocab] f32) — draw 0 of its stream."""
+def first_tokens(last_logits, temps, topks, seeds, counts=None):
+    """Sample each admitted request's next token from its prefill
+    logits ([k, vocab] f32) — draw ``counts`` of its stream (default
+    0, the fresh-admission case; a preempt-resume passes its
+    generated-prefix length)."""
+    if counts is None:
+        counts = [0] * len(seeds)
     return _sample_first_jit(
         jnp.asarray(last_logits, jnp.float32),
         jnp.asarray(temps, jnp.float32),
         jnp.asarray(topks, jnp.int32),
-        jnp.asarray(seeds, jnp.uint32))
+        jnp.asarray(seeds, jnp.uint32),
+        jnp.asarray(counts, jnp.int32))

@@ -74,6 +74,33 @@ def _registry_series():
             "wall time of one prefill chunk — the decode-stall bound "
             "each loop iteration pays for a joining long prompt",
             buckets=MS_BUCKETS),
+        "cancelled": metrics.counter(
+            "veles_serving_requests_cancelled_total",
+            "requests cancelled mid-flight (client gone/disconnected)"
+        ),
+        "shed": metrics.counter(
+            "veles_serving_requests_shed_total",
+            "requests shed at admission under block-pressure overload"
+            " (HTTP 503)"),
+        "preempts": metrics.counter(
+            "veles_serving_preempts_total",
+            "requests evicted mid-decode (blocks released, generated "
+            "prefix kept, requeued for resume)"),
+        "preempt_resumes": metrics.counter(
+            "veles_serving_preempt_resumes_total",
+            "preempted requests re-admitted (prompt + prefix "
+            "re-prefilled, stream continues bit-identically)"),
+        "preempt_reprefill_tokens": metrics.counter(
+            "veles_serving_preempt_reprefill_tokens_total",
+            "tokens re-prefilled on resume — the compute cost "
+            "preemption traded for the freed KV blocks"),
+        "watchdog_trips": metrics.counter(
+            "veles_serving_watchdog_trips_total",
+            "decode-loop stalls detected (pending requests failed "
+            "instead of hanging their clients)"),
+        "drains": metrics.counter(
+            "veles_serving_drains_total",
+            "graceful-drain requests accepted (admission closed)"),
     }
 
 
@@ -91,6 +118,11 @@ class ServingMetrics:
         self.slot_total_steps = 0
         self.prefill_chunks = 0
         self.prefill_chunk_tokens = 0
+        self.cancelled = 0      # client-gone cancellations
+        self.shed = 0           # block-pressure 503s
+        self.preempts = 0
+        self.preempt_resumes = 0
+        self.watchdog_trips = 0
         # instance-lifetime latency histograms (the shared telemetry
         # type: bounded reservoir + bucket counts), window = `recent`
         self._ttft = Histogram("ttft_ms", buckets=MS_BUCKETS,
@@ -115,13 +147,60 @@ class ServingMetrics:
         events.record("serving.reject", "single",
                       cls="InferenceScheduler", queue_depth=depth)
 
-    def record_expire(self, queued_ms):
+    def record_expire(self, queued_ms, tokens=0):
+        """A request crossed its deadline — queued (tokens=0, the 408
+        admission case) or mid-decode (tokens = generated so far)."""
         with self._lock:
             self.expired += 1
         self._global["expired"].inc()
         events.record("serving.expire", "single",
                       cls="InferenceScheduler",
-                      queued_ms=round(queued_ms, 3))
+                      queued_ms=round(queued_ms, 3),
+                      tokens=int(tokens))
+
+    def record_cancel(self, tokens):
+        with self._lock:
+            self.cancelled += 1
+        self._global["cancelled"].inc()
+        events.record("serving.cancel", "single",
+                      cls="InferenceScheduler", tokens=int(tokens))
+
+    def record_shed(self, queued_blocks):
+        with self._lock:
+            self.shed += 1
+            self.rejected += 1
+        self._global["shed"].inc()
+        self._global["rejected"].inc()
+        events.record("serving.shed", "single",
+                      cls="InferenceScheduler",
+                      queued_blocks=int(queued_blocks))
+
+    def record_preempt(self, tokens):
+        with self._lock:
+            self.preempts += 1
+        self._global["preempts"].inc()
+        events.record("serving.preempt", "single",
+                      cls="InferenceScheduler", tokens=int(tokens))
+
+    def record_resume(self, reprefill_tokens):
+        with self._lock:
+            self.preempt_resumes += 1
+        self._global["preempt_resumes"].inc()
+        self._global["preempt_reprefill_tokens"].inc(
+            int(reprefill_tokens))
+
+    def record_watchdog_trip(self, failed, stalled_s):
+        with self._lock:
+            self.watchdog_trips += 1
+        self._global["watchdog_trips"].inc()
+        events.record("serving.watchdog_trip", "single",
+                      cls="InferenceScheduler", failed=int(failed),
+                      stalled_s=round(stalled_s, 3))
+
+    def record_drain(self):
+        self._global["drains"].inc()
+        events.record("serving.drain", "single",
+                      cls="InferenceScheduler")
 
     def record_first_token(self, ttft_ms, queued_ms):
         self._ttft.observe(ttft_ms)
@@ -195,8 +274,14 @@ class ServingMetrics:
                 "active_slots": int(active_slots),
                 "max_slots": int(max_slots),
                 "slot_occupancy": round(occ, 4),
+                "slot_busy_steps": self.slot_busy_steps,
                 "prefill_chunks": self.prefill_chunks,
                 "prefill_chunk_tokens": self.prefill_chunk_tokens,
+                "requests_cancelled": self.cancelled,
+                "requests_shed": self.shed,
+                "preempts": self.preempts,
+                "preempt_resumes": self.preempt_resumes,
+                "watchdog_trips": self.watchdog_trips,
                 "uptime_s": round(time.monotonic() - self._t0, 3),
             }
         if kv:  # paged-cache occupancy (operator admission headroom)

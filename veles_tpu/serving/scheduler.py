@@ -44,12 +44,38 @@ differs from the single-user ``generate()`` path's (one fold per
 generated token here vs one split per lockstep buffer position
 there).
 
+Request lifecycle (fault tolerance): every request carries a
+whole-request **deadline** (``root.common.serving.request_timeout``,
+overridable per submit) enforced at chunk/decode boundaries — an
+expired request frees its slot and blocks and fails with
+:class:`DeadlineExceededError` carrying the tokens generated so far
+(HTTP 408 material).  A client that went away can :meth:`cancel` its
+future; the loop releases the resources at the next boundary.  The
+scheduler can **preempt** an active request
+(:meth:`request_preempt`): its blocks return to the pool, its
+generated-token prefix is kept, and on re-admission prompt + prefix
+re-prefill through the chunked-prefill path and decoding continues —
+the token stream is bit-identical to the uninterrupted run because
+token ``t`` is always drawn with ``fold_in(key(seed), t)`` regardless
+of slot or cache placement.  A **watchdog** thread detects a stuck
+decode step (``root.common.serving.watchdog`` seconds) and fails
+pending requests instead of hanging their clients; block-pressure
+**load shedding** (``shed_block_factor``) turns hopeless submits into
+deterministic 503s before they queue; and :meth:`drain` closes
+admission (503 + Retry-After), finishes everything in flight and
+signals ``drained`` — the rolling-restart hook behind ``POST
+/drain``.  Injection points (``serving.scheduler.*`` — see
+:mod:`veles_tpu.faults`) let tier-1 exercise every one of these paths
+deterministically.
+
 Config knobs (``root.common.serving.*``, overridable per scheduler):
 ``kv`` ("paged"/"dense"), ``block_size`` (tokens per KV block,
 default 16), ``kv_blocks`` (pool capacity in blocks; default the
-dense-equivalent ``max_slots · ceil(window / block_size)``) and
+dense-equivalent ``max_slots · ceil(window / block_size)``),
 ``prefill_chunk`` (chunk width in tokens, rounded up to a power of
-two; 0 disables chunking, default 64).
+two; 0 disables chunking, default 64), ``request_timeout`` /
+``watchdog`` / ``shed_block_factor`` (lifecycle knobs above; 0
+disables each).
 """
 
 import collections
@@ -60,6 +86,7 @@ import time
 
 import numpy
 
+from veles_tpu import faults
 from veles_tpu.logger import Logger
 from veles_tpu.serving.engine import (
     first_tokens, paged_decode_step, slot_decode_step)
@@ -77,13 +104,32 @@ class SchedulerError(Exception):
 
 
 class QueueFullError(SchedulerError):
-    """Admission control: queue-depth cap hit (HTTP 503)."""
+    """Admission control: queue-depth cap hit or block-pressure shed
+    (HTTP 503; ``retry_after`` seeds the Retry-After header)."""
     http_status = 503
+    retry_after = 1
+
+
+class DrainingError(QueueFullError):
+    """Admission closed for a graceful drain (HTTP 503) — the caller
+    should retry against another replica."""
+    retry_after = 5
 
 
 class DeadlineExceededError(SchedulerError):
-    """Admission control: queued past the deadline (HTTP 408)."""
+    """The request crossed its deadline — still queued
+    (``tokens_generated == 0``) or mid-decode (HTTP 408; the partial
+    count rides the error so clients know what they paid for)."""
     http_status = 408
+
+    def __init__(self, message, tokens_generated=0):
+        super(DeadlineExceededError, self).__init__(message)
+        self.tokens_generated = int(tokens_generated)
+
+
+class RequestCancelledError(SchedulerError):
+    """The request was cancelled (client disconnect/abandon); its
+    slot and KV blocks were released at the next boundary."""
 
 
 def _bucket(n, floor, cap):
@@ -103,8 +149,9 @@ def _serving_conf(name, default):
 class _Request(object):
     __slots__ = ("prompt", "steps", "temperature", "top_k",
                  "stop_token", "seed", "deadline", "future", "slot",
-                 "generated", "t_submit", "t_admit", "t_first",
-                 "pf_caches", "pf_off", "pf_width", "pf_chunk")
+                 "generated", "cancelled", "preempts", "t_submit",
+                 "t_admit", "t_first", "pf_seq", "pf_caches",
+                 "pf_off", "pf_width", "pf_chunk")
 
     def __init__(self, prompt, steps, temperature, top_k, stop_token,
                  seed, deadline):
@@ -118,14 +165,28 @@ class _Request(object):
         self.future = concurrent.futures.Future()
         self.slot = None
         self.generated = []
+        self.cancelled = False   # client gone — reap at next boundary
+        self.preempts = 0        # times evicted (resume re-prefills)
         self.t_submit = time.monotonic()
         self.t_admit = None
         self.t_first = None
-        # chunked-prefill progress (None while queued / one-shot)
+        # chunked-prefill progress (None while queued / one-shot);
+        # pf_seq is the token sequence being prefilled — the prompt,
+        # plus the generated prefix when resuming after a preemption
+        self.pf_seq = None
         self.pf_caches = None
         self.pf_off = 0
         self.pf_width = 0
         self.pf_chunk = 0
+
+    def fail(self, error):
+        """Set the future's exception unless a racing path (watchdog,
+        cancel) beat us to it."""
+        if not self.future.done():
+            try:
+                self.future.set_exception(error)
+            except concurrent.futures.InvalidStateError:
+                pass
 
 
 class InferenceScheduler(Logger):
@@ -145,7 +206,9 @@ class InferenceScheduler(Logger):
     def __init__(self, forwards, max_slots=4, window=None,
                  max_queue=32, queue_timeout=30.0, prefill_bucket=8,
                  kv=None, block_size=None, kv_blocks=None,
-                 prefill_chunk=None, warm_buckets=None):
+                 prefill_chunk=None, warm_buckets=None,
+                 request_timeout=None, watchdog=None,
+                 shed_block_factor=None):
         super(InferenceScheduler, self).__init__()
         if not serving_supported(forwards):
             raise ValueError(
@@ -193,14 +256,38 @@ class InferenceScheduler(Logger):
         self.warm_buckets = bool(
             _serving_conf("warm_buckets", True)
             if warm_buckets is None else warm_buckets)
+        #: whole-request deadline default in seconds (0/None = none
+        #: beyond the legacy queue_timeout) — per-submit overridable
+        self.request_timeout = float(
+            _serving_conf("request_timeout", 120.0)
+            if request_timeout is None else request_timeout)
+        #: stuck-decode-loop threshold (0 disables the watchdog)
+        self.watchdog = float(_serving_conf("watchdog", 300.0)
+                              if watchdog is None else watchdog)
+        #: shed new submits once the queue's committed block budget
+        #: exceeds factor x kv_blocks (0 disables; paged only)
+        self.shed_block_factor = float(
+            _serving_conf("shed_block_factor", 4.0)
+            if shed_block_factor is None else shed_block_factor)
         self.stats = ServingMetrics()
         self._queue = collections.deque()
         self._active = {}            # slot -> _Request (decoding)
         self._prefilling = []        # admitted, mid-chunked-prefill
+        self._admitting = []         # popped from queue, prefill in
+        #                              progress this very iteration —
+        #                              cancel() must still see them
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._closed = False
+        self._draining = False
+        self._drained = threading.Event()
+        self._preempt_n = 0          # evictions the loop owes
+        self._queued_blocks = 0      # block budget committed in-queue
+        self._beat = None            # loop-iteration heartbeat stamp
+        self._working = False        # loop mid-iteration (not parked)
+        self._tripped_beat = None    # last beat the watchdog fired on
         self._thread = None
+        self._watchdog_thread = None
         self._ready = threading.Event()
         self.cache_ = None           # set by the loop thread
 
@@ -233,16 +320,27 @@ class InferenceScheduler(Logger):
                 self._thread = None
             raise
         self._ready.wait(600)
+        if self.watchdog > 0 and self._watchdog_thread is None:
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, daemon=True,
+                name="serving-watchdog")
+            self._watchdog_thread.start()
         return self
 
     def submit(self, prompt, steps, temperature=0.0, top_k=0,
                seed=None, stop_token=None, timeout=None):
         """Queue one sequence for decoding; returns a Future whose
         result is the full token list (prompt + generated, ending at
-        the first generated stop token if one fired).
+        the first generated stop token if one fired).  ``timeout``
+        overrides the whole-request deadline (default
+        ``request_timeout``; it covers queueing AND decoding — expiry
+        mid-decode frees the slot/blocks and fails the future with
+        :class:`DeadlineExceededError`).
 
         Raises ``ValueError`` on malformed requests (client errors),
-        :class:`QueueFullError` when admission control rejects."""
+        :class:`QueueFullError` when admission control rejects (queue
+        depth, block-pressure shed, or :class:`DrainingError` once a
+        drain began)."""
         prompt = [int(t) for t in prompt]
         steps = int(steps)
         if not prompt:
@@ -267,23 +365,128 @@ class InferenceScheduler(Logger):
         if seed is None:
             # unpinned sampling must draw fresh tokens per request
             seed = int.from_bytes(os.urandom(4), "little")
+        ttl = float(timeout or self.request_timeout
+                    or self.queue_timeout or 0)
         req = _Request(
             prompt, steps, temperature, top_k,
             int(stop_token) if stop_token is not None else None,
             int(seed) & 0xFFFFFFFF,
-            time.monotonic() + float(timeout or self.queue_timeout))
+            time.monotonic() + ttl if ttl > 0 else None)
+        need = self._blocks_for(req)
         with self._wake:
             if self._closed:
                 raise SchedulerError("scheduler is closed")
+            if self._draining:
+                # rolling restart: this replica finishes what it has
+                # and takes nothing new — callers retry elsewhere
+                self.stats.record_reject(len(self._queue))
+                raise DrainingError("scheduler is draining")
             if len(self._queue) >= self.max_queue:
                 self.stats.record_reject(len(self._queue))
                 raise QueueFullError(
                     "serving queue full (%d waiting)"
                     % len(self._queue))
+            if self.kv == "paged" and self.shed_block_factor > 0 \
+                    and self._queued_blocks + need \
+                    > self.shed_block_factor * self.kv_blocks:
+                # block-pressure shed: the queue already holds more
+                # committed KV budget than the pool can turn over
+                # soon — a deterministic 503 beats a guaranteed 408
+                self.stats.record_shed(self._queued_blocks)
+                raise QueueFullError(
+                    "overloaded: %d KV blocks committed in-queue "
+                    "(pool %d, shed factor %.1f)"
+                    % (self._queued_blocks, self.kv_blocks,
+                       self.shed_block_factor))
             self.stats.record_submit()
             self._queue.append(req)
+            self._queued_blocks += need
             self._wake.notify()
         return req.future
+
+    def _blocks_for(self, req):
+        """The paged block budget a request commits (0 when dense)."""
+        if self.kv != "paged":
+            return 0
+        return -(-(len(req.prompt) + req.steps) // self.block_size)
+
+    def cancel(self, future, reason="cancelled by client"):
+        """Cancel the request behind ``future`` (client disconnected
+        or gave up): a queued request fails immediately; an in-flight
+        one is reaped at the next chunk/decode boundary, returning its
+        slot and KV blocks to the pool.  Returns True when the future
+        belonged to this scheduler and was still unfinished."""
+        victim = None
+        with self._wake:
+            for req in self._queue:
+                if req.future is future:
+                    self._queue.remove(req)
+                    self._queued_blocks -= self._blocks_for(req)
+                    victim = req
+                    break
+            else:
+                for req in list(self._prefilling) \
+                        + list(self._active.values()) \
+                        + list(self._admitting):
+                    if req.future is future:
+                        req.cancelled = True
+                        victim = req
+                        self._wake.notify()
+                        break
+        if victim is None:
+            return False
+        if victim.slot is None and not victim.cancelled:
+            # was queued: no device state to release — fail right here
+            victim.fail(RequestCancelledError(reason))
+            self.stats.record_cancel(len(victim.generated))
+        return True
+
+    def request_preempt(self, n=1):
+        """Ask the loop to evict ``n`` active requests at the next
+        decode boundary (youngest first): each victim's blocks return
+        to the pool, its generated prefix is kept, and it requeues at
+        the FRONT to resume via re-prefill — the mechanism priority
+        scheduling builds on."""
+        with self._wake:
+            self._preempt_n += int(n)
+            self._wake.notify()
+
+    def drain(self, timeout=None):
+        """Begin a graceful drain: admission closes (submits raise
+        :class:`DrainingError` — 503 + Retry-After material), every
+        queued and in-flight request runs to completion, then the
+        ``drained`` event sets.  With ``timeout`` the call blocks for
+        the drain to finish and returns whether it did; otherwise it
+        returns immediately."""
+        with self._wake:
+            first = not self._draining
+            self._draining = True
+            if not (self._queue or self._active or self._prefilling):
+                self._drained.set()
+            self._wake.notify()
+        if first:
+            self.stats.record_drain()
+            self.info("draining: admission closed, %d in flight",
+                      self.in_flight)
+        if timeout is not None:
+            return self._drained.wait(timeout)
+        return self._drained.is_set()
+
+    @property
+    def draining(self):
+        return self._draining
+
+    @property
+    def drained(self):
+        return self._drained.is_set()
+
+    @property
+    def in_flight(self):
+        """Requests the scheduler still owes an answer (queued +
+        prefilling + decoding)."""
+        with self._lock:
+            return len(self._queue) + len(self._prefilling) \
+                + len(self._active) + len(self._admitting)
 
     def _kv_snapshot(self):
         out = {"kv_mode": self.kv,
@@ -305,32 +508,56 @@ class InferenceScheduler(Logger):
     def metrics(self):
         with self._lock:
             depth, active = len(self._queue), len(self._active)
+            draining = self._draining
+            queued_blocks = self._queued_blocks
         snap = self.stats.snapshot(queue_depth=depth,
                                    active_slots=active,
                                    max_slots=self.max_slots,
                                    kv=self._kv_snapshot())
         snap["window"] = self.window
+        snap["draining"] = draining
+        snap["drained"] = self._drained.is_set()
+        snap["queued_kv_blocks"] = queued_blocks
         return snap
 
     def close(self):
-        """Stop the loop and fail every unfinished request."""
+        """Stop the loop, fail every unfinished request, and return
+        every in-flight slot/block to the cache (a close with traffic
+        in flight must not leak KV blocks — ``cache_.check()`` holds
+        afterward)."""
         with self._wake:
             if self._closed:
                 return
             self._closed = True
             self._wake.notify()
+        loop_dead = True
         if self._thread is not None:
             self._thread.join(30)
+            loop_dead = not self._thread.is_alive()
         err = SchedulerError("scheduler closed")
         with self._lock:
             pending = list(self._queue) + list(self._prefilling) \
-                + list(self._active.values())
+                + list(self._active.values()) + list(self._admitting)
             self._queue.clear()
             self._prefilling = []
             self._active.clear()
+            self._admitting = []
+            self._queued_blocks = 0
+        cache = self.cache_ if loop_dead else None
         for req in pending:
-            if not req.future.done():
-                req.future.set_exception(err)
+            if req.slot is not None and cache is not None:
+                # the loop thread is dead (joined above): releasing
+                # its cache bookkeeping from here cannot race it
+                cache.release(req.slot)
+                req.slot = None
+            req.fail(err)
+        if cache is not None:
+            self._sync_kv_gauges(cache)
+        self._drained.set()
+        with self._lock:  # claim the watchdog before joining it
+            wd, self._watchdog_thread = self._watchdog_thread, None
+        if wd is not None:
+            wd.join(5)
 
     # -- decode loop ----------------------------------------------------
 
@@ -388,29 +615,149 @@ class InferenceScheduler(Logger):
         self._ready.set()
         while True:
             with self._wake:
+                self._working = False
                 while not self._closed and not self._queue \
-                        and not self._active and not self._prefilling:
+                        and not self._active and not self._prefilling \
+                        and not self._preempt_n:
+                    if self._draining:
+                        self._drained.set()
                     self._wake.wait()
                 if self._closed:
                     return
+                # the watchdog measures from here: one iteration =
+                # one reap + admit + chunk + decode step
+                self._working = True
+                self._beat = time.monotonic()
                 self._expire_locked()
                 admits = []
                 while self._queue and cache.can_admit(
                         len(self._queue[0].prompt)
                         + self._queue[0].steps):
                     req = self._queue.popleft()
+                    self._queued_blocks -= self._blocks_for(req)
                     req.slot = cache.alloc(len(req.prompt)
                                            + req.steps)
                     admits.append(req)
+                    self._admitting.append(req)
             # jax work OUTSIDE the lock: submit() must never block on
             # a device step
+            faults.fire("serving.scheduler.loop")
+            self._reap(cache)
+            self._do_preempts(cache)
             self._sync_kv_gauges(cache)
             for req in admits:
                 self._begin_admit(req, cache)
+                with self._lock:
+                    self._admitting.remove(req)
             if self._prefilling:
                 self._prefill_tick(cache)
             if self._active:
                 self._step(cache)
+
+    def _reap(self, cache):
+        """Boundary sweep over the in-flight set: release the slot and
+        blocks of every request that was cancelled, crossed its
+        deadline mid-decode, or whose future a watchdog trip already
+        failed — the other half of the deadline/disconnect contract
+        (the future's error alone would still leak KV blocks)."""
+        now = time.monotonic()
+        with self._lock:
+            flight = list(self._prefilling) \
+                + list(self._active.values())
+        for req in flight:
+            if req.future.done():      # watchdog/cancel raced ahead
+                self._drop_inflight(req, cache)
+            elif req.cancelled:
+                self._drop_inflight(req, cache)
+                self.stats.record_cancel(len(req.generated))
+                req.fail(RequestCancelledError(
+                    "cancelled after %d generated tokens"
+                    % len(req.generated)))
+            elif req.deadline is not None and now > req.deadline:
+                self._drop_inflight(req, cache)
+                age_ms = (now - req.t_submit) * 1e3
+                self.stats.record_expire(age_ms,
+                                         tokens=len(req.generated))
+                req.fail(DeadlineExceededError(
+                    "deadline exceeded after %.0f ms (%d tokens "
+                    "generated)" % (age_ms, len(req.generated)),
+                    tokens_generated=len(req.generated)))
+
+    def _drop_inflight(self, req, cache):
+        """Remove one admitted request from the in-flight set and
+        return its slot + blocks to the cache (loop thread only)."""
+        with self._lock:
+            if req in self._prefilling:
+                self._prefilling.remove(req)
+            self._active.pop(req.slot, None)
+        if req.slot is not None:
+            cache.release(req.slot)
+            req.slot = None
+        req.pf_seq = req.pf_caches = None
+        self._sync_kv_gauges(cache)
+
+    def _do_preempts(self, cache):
+        """Evict owed preemptions at this decode boundary: youngest
+        active request first (it loses the least re-prefill work and
+        is what a priority scheduler would sacrifice for an older or
+        higher-class request).  The victim keeps its generated prefix
+        and requeues at the FRONT, so it resumes as soon as its own
+        freed blocks (or better) are available."""
+        while True:
+            with self._lock:
+                if not self._preempt_n:
+                    return
+                if not self._active:
+                    self._preempt_n = 0  # demand dies with no targets
+                    return
+                self._preempt_n -= 1
+                req = max(self._active.values(),
+                          key=lambda r: (r.t_admit, r.slot))
+                self._active.pop(req.slot, None)
+            cache.release(req.slot)
+            req.slot = None
+            req.preempts += 1
+            self.stats.record_preempt(len(req.generated))
+            self._sync_kv_gauges(cache)
+            with self._lock:
+                self._queue.appendleft(req)
+                self._queued_blocks += self._blocks_for(req)
+
+    def _watchdog_loop(self):
+        """Detect a stuck decode iteration and fail the pending
+        futures — clients get a fast 5xx instead of a hung socket;
+        when (if) the loop unsticks, :meth:`_reap` returns the
+        zombies' slots and blocks to the pool."""
+        period = max(0.02, min(1.0, self.watchdog / 8.0))
+        while True:
+            time.sleep(period)
+            with self._lock:
+                if self._closed:
+                    return
+                beat, working = self._beat, self._working
+                tripped = self._tripped_beat
+            if not working or beat is None or beat == tripped:
+                continue
+            stalled = time.monotonic() - beat
+            if stalled <= self.watchdog:
+                continue
+            with self._lock:
+                self._tripped_beat = beat
+                victims = [r for r in list(self._queue)
+                           + list(self._prefilling)
+                           + list(self._active.values())
+                           + list(self._admitting)
+                           if not r.future.done()]
+            err = SchedulerError(
+                "decode loop stalled %.1fs (watchdog %.1fs) — "
+                "request failed instead of hanging" % (stalled,
+                                                       self.watchdog))
+            for req in victims:
+                req.fail(err)
+            self.stats.record_watchdog_trip(len(victims), stalled)
+            self.warning(
+                "decode loop stalled %.1fs — failed %d pending "
+                "requests", stalled, len(victims))
 
     def _sync_kv_gauges(self, cache):
         if self.kv == "paged":
@@ -422,11 +769,17 @@ class InferenceScheduler(Logger):
         kept = collections.deque()
         while self._queue:
             req = self._queue.popleft()
-            if req.deadline is not None and now > req.deadline:
+            if req.future.done():
+                # a watchdog trip failed it while queued — drop it
+                self._queued_blocks -= self._blocks_for(req)
+            elif req.deadline is not None and now > req.deadline:
+                self._queued_blocks -= self._blocks_for(req)
                 queued_ms = (now - req.t_submit) * 1e3
-                self.stats.record_expire(queued_ms)
-                req.future.set_exception(DeadlineExceededError(
-                    "queued %.0f ms without a free slot" % queued_ms))
+                self.stats.record_expire(queued_ms,
+                                         tokens=len(req.generated))
+                req.fail(DeadlineExceededError(
+                    "queued %.0f ms without a free slot" % queued_ms,
+                    tokens_generated=len(req.generated)))
             else:
                 kept.append(req)
         self._queue = kept
@@ -440,10 +793,17 @@ class InferenceScheduler(Logger):
         return _bucket(p_len, floor, 1 << 30)
 
     def _begin_admit(self, req, cache):
-        """Route one joining request: short prompts prefill one-shot;
-        long prompts start the chunked-prefill ride-along."""
+        """Route one joining request: short sequences prefill
+        one-shot; long ones start the chunked-prefill ride-along.  A
+        preempted request resumes here — its prefill sequence is
+        prompt + the kept generated prefix, so the re-prefill rebuilds
+        exactly the K/V its decode steps had written before eviction."""
         req.t_admit = time.monotonic()
-        p_len = len(req.prompt)
+        seq = list(req.prompt) + list(req.generated)
+        if req.preempts and req.generated:
+            self.stats.record_resume(len(seq))
+        req.pf_seq = seq
+        p_len = len(seq)
         chunk = self.prefill_chunk
         if not chunk or p_len <= chunk:
             self._admit_oneshot(req, cache)
@@ -465,16 +825,18 @@ class InferenceScheduler(Logger):
             self._prefilling.append(req)
 
     def _admit_oneshot(self, req, cache):
-        """Prefill one joining request in a single compiled pass and
-        emit its first token (the TTFT edge)."""
-        p_len = len(req.prompt)
+        """Prefill one joining request's sequence (prompt, plus the
+        generated prefix on resume) in a single compiled pass and emit
+        its next token (the TTFT edge)."""
+        p_len = len(req.pf_seq)
         width = self._staging_width(p_len, 0)
-        # the PROMPT array stays inside the positional table; the
+        # the SEQUENCE array stays inside the positional table; the
         # staging cache may be wider (insert trims it back)
         p_w = min(width, max(self.window, p_len))
         padded = numpy.zeros((1, p_w), numpy.int32)
-        padded[0, :p_len] = req.prompt
+        padded[0, :p_len] = req.pf_seq
         try:
+            faults.fire("serving.scheduler.prefill")
             row_caches, last = prefill(
                 self.forwards, padded, prompt_lens=[p_len],
                 window=width)
@@ -488,17 +850,20 @@ class InferenceScheduler(Logger):
         per-iteration decode-stall bound; the decode step for every
         in-flight stream runs right after, in the same iteration."""
         with self._lock:
+            if not self._prefilling:  # reaped between check and tick
+                return
             req = self._prefilling[0]
-        p_len = len(req.prompt)
+        p_len = len(req.pf_seq)
         c = req.pf_chunk
         off = req.pf_off
         end = min(off + c, p_len)
         clen = end - off
         padded = numpy.zeros((1, c), numpy.int32)
-        padded[0, :clen] = req.prompt[off:end]
+        padded[0, :clen] = req.pf_seq[off:end]
         kw = _bucket(off + c, c, req.pf_width)
         t0 = time.perf_counter()
         try:
+            faults.fire("serving.scheduler.prefill")
             req.pf_caches, last = prefill_chunk(
                 self.forwards, padded, off, [clen], req.pf_caches,
                 key_width=kw)
@@ -518,21 +883,26 @@ class InferenceScheduler(Logger):
             self._finish_admit(req, cache, req.pf_caches, last)
 
     def _finish_admit(self, req, cache, row_caches, last):
-        """Insert the prefilled staging row and emit the first
-        token."""
+        """Insert the prefilled staging row and emit the next token:
+        draw 0 on a fresh admission, draw ``len(generated)`` on a
+        preempt-resume — exactly the counter the decode step would
+        have folded, so the resumed stream never forks."""
         try:
-            cache.insert(req.slot, row_caches, len(req.prompt))
+            cache.insert(req.slot, row_caches, len(req.pf_seq))
         except Exception as e:
             self._retire(req, cache, error=e)
             return
         req.pf_caches = None
+        req.pf_seq = None
         tok = int(numpy.asarray(first_tokens(
-            last, [req.temperature], [req.top_k], [req.seed]))[0])
+            last, [req.temperature], [req.top_k], [req.seed],
+            counts=[len(req.generated)]))[0])
         req.generated.append(tok)
-        req.t_first = time.monotonic()
-        self.stats.record_first_token(
-            (req.t_first - req.t_submit) * 1e3,
-            (req.t_admit - req.t_submit) * 1e3)
+        if req.t_first is None:  # TTFT is the FIRST first-token only
+            req.t_first = time.monotonic()
+            self.stats.record_first_token(
+                (req.t_first - req.t_submit) * 1e3,
+                (req.t_admit - req.t_submit) * 1e3)
         with self._lock:
             self._active[req.slot] = req
         self._maybe_finish(req, cache)
@@ -544,6 +914,7 @@ class InferenceScheduler(Logger):
             active = dict(self._active)
         if not active:
             return
+        faults.fire("serving.scheduler.step")
         if self.kv == "paged":
             self._step_paged(cache, active)
         else:
@@ -620,16 +991,23 @@ class InferenceScheduler(Logger):
     def _retire(self, req, cache, error=None):
         with self._lock:
             self._active.pop(req.slot, None)
-        cache.release(req.slot)
+        if req.slot is not None:
+            cache.release(req.slot)
+            req.slot = None
         self._sync_kv_gauges(cache)
         if error is not None:
-            req.future.set_exception(
-                error if isinstance(error, SchedulerError)
-                else SchedulerError(repr(error)))
+            req.fail(error if isinstance(error, SchedulerError)
+                     else SchedulerError(repr(error)))
+            return
+        if req.future.done():
+            # watchdog/cancel failed it first — the tokens are moot
             return
         now = time.monotonic()
         self.stats.record_complete(
             len(req.generated), now - req.t_submit,
             (req.t_first - req.t_submit) * 1e3,
             (req.t_admit - req.t_submit) * 1e3)
-        req.future.set_result(list(req.prompt) + req.generated)
+        try:
+            req.future.set_result(list(req.prompt) + req.generated)
+        except concurrent.futures.InvalidStateError:
+            pass
