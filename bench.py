@@ -924,6 +924,135 @@ def bench_serving_sweep(dev):
     return out
 
 
+def bench_router(dev, replica_counts=(1, 2, 4),
+                 requests_per_client=4):
+    """Fleet scaling through the HTTP router (``serving/router.py``
+    over in-process replicas — each with its OWN scheduler thread and
+    KV cache, supervised by ``serving/fleet.py``):
+
+    - ``router_aggregate_tokens_per_sec`` — total fleet decode
+      throughput under saturating concurrent load, per replica count;
+    - ``router_ttft_p95_ms`` — p95 of steps=1 probes through the
+      router (fleet TTFT including the routing hop), per count;
+    - ``router_scaling_2x`` — the 2-replica/1-replica throughput
+      ratio.  In-process replicas only scale with real spare cores
+      (two decode loops time-slicing ONE core aggregate ~1.0x), so
+      ``router_cores`` records what the host offered — judge the
+      ratio against it.
+    """
+    import os
+    import threading
+    import urllib.request
+
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.memory import Array
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.restful_api import RESTfulAPI, RestfulLoader
+    from veles_tpu.serving import Fleet, LocalReplica, Router
+
+    cpu = dev.jax_device.platform == "cpu"
+    if cpu:
+        d_model, layers, heads, vocab, window = 64, 2, 2, 256, 128
+        steps, prompt_len, max_slots = 8, 16, 2
+    else:
+        d_model, layers, heads, vocab, window = 1024, 8, 8, 32768, \
+            1024
+        steps, prompt_len, max_slots = 64, 128, 4
+    prompt = numpy.random.default_rng(0).integers(
+        0, vocab, (prompt_len,)).tolist()
+    made = [0]
+
+    def spawn(index):
+        made[0] += 1
+        wf = AcceleratedWorkflow(
+            None, name="bench-router-%d" % made[0])
+        spec = [{"type": "embedding", "vocab": vocab,
+                 "dim": d_model}]
+        spec += [{"type": "transformer_block", "heads": heads,
+                  "causal": True} for _ in range(layers)]
+        spec += [{"type": "token_logits", "vocab": vocab}]
+        fw = make_forwards(
+            wf, Array(numpy.zeros((1, window), numpy.int32)), spec)
+        for u in fw:
+            u.initialize(device=dev)
+        loader = RestfulLoader(wf, sample_shape=(window,),
+                               minibatch_size=1, max_wait=10.0)
+        loader.initialize(device=dev)
+        api = RESTfulAPI(wf, loader=loader, forwards=fw,
+                         name="bench-router-api-%d" % made[0],
+                         max_slots=max_slots, max_queue=256,
+                         request_timeout=600.0)
+        api.output = fw[-1].output
+        api.initialize()
+        return LocalReplica(api, loader)
+
+    def post(url, payload, timeout=600):
+        req = urllib.request.Request(
+            url + "/generate", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        return json.load(urllib.request.urlopen(req,
+                                                timeout=timeout))
+
+    agg = {}
+    ttft = {}
+    errors = 0
+    for n in replica_counts:
+        router = Router(health_interval=0.5,
+                        request_timeout=600.0).start()
+        fleet = Fleet(spawn, n, router=router).start()
+        url = router.url
+        try:
+            post(url, {"prompt": prompt, "steps": steps})  # warm
+            probes = []
+            for _ in range(12):
+                t0 = time.perf_counter()
+                post(url, {"prompt": prompt, "steps": 1})
+                probes.append((time.perf_counter() - t0) * 1e3)
+            ttft[str(n)] = round(
+                sorted(probes)[int(0.95 * (len(probes) - 1))], 2)
+            clients = 2 * n * max_slots
+            done = [0]
+            fails = [0]
+
+            def client():
+                for k in range(requests_per_client):
+                    try:
+                        out = post(url, {"prompt": prompt,
+                                         "steps": steps, "seed": k})
+                        done[0] += len(out["tokens"]) - prompt_len
+                    except Exception:
+                        fails[0] += 1
+
+            threads = [threading.Thread(target=client)
+                       for _ in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(600)
+            dt = time.perf_counter() - t0
+            agg[str(n)] = round(done[0] / dt, 1)
+            errors += fails[0]
+        finally:
+            fleet.stop()
+            router.stop()
+    out = {
+        "router_aggregate_tokens_per_sec": agg,
+        "router_ttft_p95_ms": ttft,
+        "router_scaling_2x": round(agg["2"] / agg["1"], 3)
+        if "1" in agg and "2" in agg and agg["1"] else None,
+        "router_errors": errors,
+        "router_cores": os.cpu_count(),
+        "router_config": {
+            "d_model": d_model, "layers": layers, "heads": heads,
+            "vocab": vocab, "window": window, "steps": steps,
+            "prompt": prompt_len, "max_slots": max_slots,
+            "replica_counts": list(replica_counts),
+            "requests_per_client": requests_per_client},
+    }
+    return out
+
+
 def bench_input_pipeline(dev, steps=40, depth=2):
     """Asynchronous input pipeline (loader/prefetch.py): a synthetic
     SLOW streaming loader — ``fill_minibatch`` sleeps ``decode_ms``
@@ -1102,6 +1231,10 @@ def main():
         serving_sweep = bench_serving_sweep(dev)
     except Exception as e:
         serving_sweep = {"serving_sweep_error": repr(e)[:300]}
+    try:
+        router_rec = bench_router(dev)
+    except Exception as e:     # fleet bench must not sink the run
+        router_rec = {"router_error": repr(e)[:300]}
     mlp_sps, mlp_aud = bench_mlp(dev)
     try:
         input_pipe = bench_input_pipeline(dev)
@@ -1145,6 +1278,7 @@ def main():
     record.update(decode)
     record.update(serving)
     record.update(serving_sweep)
+    record.update(router_rec)
     record.update(input_pipe)
     record.update(allreduce)
     if dp:
@@ -1204,7 +1338,10 @@ def main():
         "serving_ttft_ms", "serving_concurrent_tokens_per_sec",
         "serving_slot_occupancy", "serving_ttft_p95_ms_mixed",
         "serving_ttft_p95_ms_oneshot", "serving_max_streams_dense",
-        "serving_max_streams_paged", "input_pipeline_speedup",
+        "serving_max_streams_paged",
+        "router_aggregate_tokens_per_sec", "router_ttft_p95_ms",
+        "router_scaling_2x", "router_cores", "router_error",
+        "input_pipeline_speedup",
         "input_pipeline_decode_ms", "allreduce_p50_us",
         "allreduce_substrate", "allreduce_quality",
         "dp_samples_per_sec", "compile_seconds_total",
@@ -1218,5 +1355,27 @@ def main():
     return 0
 
 
+def main_router():
+    """``python bench.py router`` — run ONLY the fleet-router bench
+    and merge its keys into the existing BENCH.json (the PR5
+    precedent: a standalone subsystem run, other entries carried)."""
+    from veles_tpu.backends import Device
+    rec = bench_router(Device())
+    record = {}
+    try:
+        with open("BENCH.json") as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        pass
+    record.update(rec)
+    record["router_bench_source"] = \
+        "PR8 standalone router bench run; non-router entries carried"
+    with open("BENCH.json", "w") as f:
+        json.dump(record, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(rec, sort_keys=True))
+    return 0
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main_router() if "router" in sys.argv[1:] else main())

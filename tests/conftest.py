@@ -31,6 +31,29 @@ def _needs_reexec():
     return bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
 
 
+def pytest_runtest_protocol(item, nextitem):
+    """Single retry for ``@pytest.mark.flaky`` tests — the quarantine
+    for the two KNOWN environment flakes (jax-0.4.37 XLA:CPU
+    nondeterminism, see ROUND6_NOTES.md), so fleet soaks get a stable
+    tier-1 signal.  The first attempt runs unlogged; only a failure
+    triggers the one rerun (full setup/teardown), whose reports are
+    what the terminal and exit code see.  Anything without the marker
+    takes the stock protocol."""
+    if item.get_closest_marker("flaky") is None:
+        return None
+    from _pytest.runner import runtestprotocol
+    item.ihook.pytest_runtest_logstart(nodeid=item.nodeid,
+                                       location=item.location)
+    reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    if any(r.failed for r in reports):
+        reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    for report in reports:
+        item.ihook.pytest_runtest_logreport(report=report)
+    item.ihook.pytest_runtest_logfinish(nodeid=item.nodeid,
+                                        location=item.location)
+    return True
+
+
 def pytest_configure(config):
     if not _needs_reexec():
         return

@@ -113,6 +113,39 @@ def test_registry_semantics():
     assert after == before + 1
 
 
+def test_http_error_action_and_point_globs():
+    """The ``http_error`` action raises :class:`InjectedHTTPError`
+    carrying its status code (spec arg; default 500), and the fnmatch
+    scoping contract holds: point globs arm subsystems, key globs
+    pick victims within one point."""
+    faults.inject("p.http", "http_error", arg=503)
+    with pytest.raises(faults.InjectedHTTPError) as e:
+        faults.fire("p.http")
+    assert e.value.status == 503
+    assert isinstance(e.value, faults.InjectedFault)
+    # spec-string grammar + the default status
+    faults.clear()
+    armed = faults.load("rest.x=http_error:418x1;rest.y=http_error")
+    assert [s.action for s in armed] == ["http_error", "http_error"]
+    with pytest.raises(faults.InjectedHTTPError) as e:
+        faults.fire("rest.x")
+    assert e.value.status == 418
+    assert faults.fire("rest.x") is False      # times=1 exhausted
+    with pytest.raises(faults.InjectedHTTPError) as e:
+        faults.fire("rest.y")
+    assert e.value.status == 500               # default
+    # point-glob: router.* arms forward AND health, nothing else;
+    # key-glob: only replicas r1/r2 trip it
+    faults.clear()
+    faults.inject("router.*", "drop", key="r[12]")
+    assert faults.fire("router.forward", key="r1") is True
+    assert faults.fire("router.forward", key="r3") is False
+    assert faults.fire("router.replica.health", key="r2") is True
+    assert faults.fire("serving.scheduler.step", key="r1") is False
+    # a keyless fire never matches a keyed spec (no silent widening)
+    assert faults.fire("router.forward") is False
+
+
 # -- request lifecycle: deadlines, cancel, close ------------------------------
 
 def test_deadline_expiry_frees_all_blocks(f32):
@@ -526,6 +559,66 @@ def test_rest_injected_fault_is_structured_500(f32):
         assert len(post("/generate",
                         {"prompt": [3, 1], "steps": 2})["tokens"]) == 4
     finally:
+        api.stop()
+        loader.close()
+
+
+def test_rest_injected_http_error_is_structured_reply(f32):
+    """The ``http_error`` action at a REST point answers a structured
+    JSON error with the INJECTED status (a replica that deliberately
+    replies 503 — router/fleet drills), Retry-After included for 503,
+    and the handler survives for the next request."""
+    api, loader, post = _serve_api("fault-rest-http503")
+    try:
+        faults.inject("restful.generate", "http_error", arg=503,
+                      times=1)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post("/generate", {"prompt": [3, 1], "steps": 2})
+        assert e.value.code == 503
+        assert int(e.value.headers["Retry-After"]) >= 1
+        body = json.loads(e.value.read().decode())
+        assert body["error"]["code"] == 503
+        assert "injected HTTP 503" in body["error"]["message"]
+        assert len(post("/generate",
+                        {"prompt": [3, 1], "steps": 2})["tokens"]) == 4
+    finally:
+        api.stop()
+        loader.close()
+
+
+def test_rest_admin_token_gates_remote_drain(f32):
+    """Loopback keeps its admin access; the Bearer check is what a
+    REMOTE router would pass — exercised here by asserting the token
+    comparison path (wrong token → 403 even from loopback would be
+    too strict, so the check is peer-first: loopback always passes,
+    non-loopback needs the exact token)."""
+    from veles_tpu.restful_api import RESTfulAPI
+    saved = root.common.api.get("admin_token", None)
+    root.common.api.admin_token = "sekret"
+    api, loader, post = _serve_api("fault-rest-admin")
+    try:
+        # loopback passes with no token at all (unchanged contract)
+        drain = post("/drain", {})
+        assert drain["draining"] is True
+        # the token comparison itself: simulate the handler check for
+        # a non-loopback peer (the HTTP server binds loopback in
+        # tier-1, so the Bearer path is unit-checked through the
+        # handler's own predicate)
+        handler = type("peer", (), {})()
+        checks = []
+        for peer, auth, want in [
+                ("10.0.0.9", "Bearer sekret", True),
+                ("10.0.0.9", "Bearer wrong", False),
+                ("10.0.0.9", "", False),
+                ("127.0.0.1", "", True)]:
+            handler.client_address = (peer, 1234)
+            handler.headers = {"Authorization": auth}
+            # borrow the bound predicate off the live handler class
+            cls = api._server_.RequestHandlerClass
+            checks.append(cls._admin_ok(handler) == want)
+        assert all(checks), checks
+    finally:
+        root.common.api.admin_token = saved
         api.stop()
         loader.close()
 

@@ -224,6 +224,10 @@ class TestExpertParallel:
                 expect[b] += w * ye
         assert numpy.allclose(y, expect, atol=1e-4)
 
+    @pytest.mark.flaky(
+        reason="historically flaky on jax-0.4.37 XLA:CPU "
+               "(nondeterministic reduction order vs the bitwise-ish "
+               "sharded-vs-unsharded compare; see ROUND6_NOTES.md)")
     def test_moe_trains_on_ep_mesh_and_matches_single_device(
             self, device):
         from veles_tpu import prng
@@ -233,30 +237,42 @@ class TestExpertParallel:
 
         prng.get("dist").seed(99)
         prng.get("default").seed(7)
-        wf1, loader1, layers1, gd1 = _make_moe_trainer(device, mesh)
-        losses = []
-        for _ in range(6):
-            loader1.run()
-            gd1.run()
-            if loader1.minibatch_class == TRAIN:
-                gd1.loss.map_read()
-                losses.append(float(gd1.loss.mem))
-        assert losses[-1] < losses[0], losses
+        loaders = []  # stopped in finally: a failed (and flaky-
+        #               retried) attempt must not orphan loader
+        #               threads for later tests to trip over
+        try:
+            wf1, loader1, layers1, gd1 = _make_moe_trainer(device,
+                                                           mesh)
+            loaders.append(loader1)
+            losses = []
+            for _ in range(6):
+                loader1.run()
+                gd1.run()
+                if loader1.minibatch_class == TRAIN:
+                    gd1.loss.map_read()
+                    losses.append(float(gd1.loss.mem))
+            assert losses[-1] < losses[0], losses
 
-        # expert weights provably sharded over ep: 4 experts / ep=4
-        w1 = layers1[0].expert_w1.devmem
-        shard_shapes = {s.data.shape for s in w1.addressable_shards}
-        assert shard_shapes == {(1,) + layers1[0].expert_w1.shape[1:]}, \
-            shard_shapes
+            # expert weights provably sharded over ep: 4 experts/ep=4
+            w1 = layers1[0].expert_w1.devmem
+            shard_shapes = {s.data.shape
+                            for s in w1.addressable_shards}
+            assert shard_shapes == \
+                {(1,) + layers1[0].expert_w1.shape[1:]}, shard_shapes
 
-        # and the ep-sharded run must equal the unsharded one bitwise-ish
-        prng.get("dist").seed(99)
-        prng.get("default").seed(7)
-        wf2, loader2, layers2, gd2 = _make_moe_trainer(device, None)
-        for _ in range(6):
-            loader2.run()
-            gd2.run()
-        for name in layers1[0].PARAMS:
-            a = numpy.array(getattr(layers1[0], name)[...])
-            b = numpy.array(getattr(layers2[0], name)[...])
-            assert numpy.allclose(a, b, atol=1e-5), name
+            # the ep-sharded run must equal the unsharded bitwise-ish
+            prng.get("dist").seed(99)
+            prng.get("default").seed(7)
+            wf2, loader2, layers2, gd2 = _make_moe_trainer(device,
+                                                           None)
+            loaders.append(loader2)
+            for _ in range(6):
+                loader2.run()
+                gd2.run()
+            for name in layers1[0].PARAMS:
+                a = numpy.array(getattr(layers1[0], name)[...])
+                b = numpy.array(getattr(layers2[0], name)[...])
+                assert numpy.allclose(a, b, atol=1e-5), name
+        finally:
+            for ld in loaders:
+                ld.stop()

@@ -1,0 +1,504 @@
+"""Multi-replica fleet tier (``serving/router.py`` + ``fleet.py``):
+chaos soak (replica kill + rolling restart under continuous load with
+zero client-visible failures and zero KV-block leaks), circuit-breaker
+open/half-open/close transitions under injected ``http_error``/hang,
+hedging gated to idempotent requests, retry budgets bounded by the
+request deadline with ``tokens_generated`` propagation, and the fleet
+spawn retry path."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy
+import pytest
+
+from veles_tpu import faults
+from veles_tpu.backends import Device
+from veles_tpu.config import root
+from veles_tpu.memory import Array
+
+pytestmark = pytest.mark.router
+
+
+@pytest.fixture
+def f32():
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    yield
+    root.common.precision.compute_dtype = saved
+
+
+@pytest.fixture(autouse=True)
+def disarm():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _make_replica(name, seed=1234, **api_kwargs):
+    """One in-process engine replica (same tiny-chain shapes as
+    tests/test_faults.py so the compiled executables are shared).
+    Seeding the default PRNG makes every replica's weights IDENTICAL
+    — the fleet serves one model, so greedy output must not depend on
+    which replica answers."""
+    from veles_tpu import prng
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.restful_api import RESTfulAPI, RestfulLoader
+    from veles_tpu.serving.fleet import LocalReplica
+    prng.get("default").seed(seed)
+    dev = Device(backend="numpy")
+    wf = AcceleratedWorkflow(None, name=name)
+    fw = make_forwards(
+        wf, Array(numpy.zeros((1, 24), numpy.int32)), [
+            {"type": "embedding", "vocab": 11, "dim": 8},
+            {"type": "transformer_block", "heads": 2, "causal": True},
+            {"type": "token_logits", "vocab": 11}])
+    for u in fw:
+        u.initialize(device=dev)
+    loader = RestfulLoader(wf, sample_shape=(24,), minibatch_size=1,
+                           max_wait=10.0)
+    loader.initialize(device=dev)
+    api = RESTfulAPI(wf, loader=loader, forwards=fw,
+                     name=name + "-api", max_slots=2, **api_kwargs)
+    api.output = fw[-1].output
+    api.initialize()
+    return LocalReplica(api, loader)
+
+
+def _post(url, payload, timeout=120, headers=None):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers=hdrs)
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    return dict(resp.headers), json.load(resp)
+
+
+def _session_for(replica_ids, target_id):
+    """A session key whose rendezvous hash (the router's affinity
+    formula) lands on ``target_id`` — lets a test aim traffic at one
+    replica through the PUBLIC X-Veles-Session contract."""
+    import zlib
+    for i in range(10000):
+        s = "sess%d" % i
+        owner = max(replica_ids,
+                    key=lambda rid: zlib.crc32(
+                        ("%s|%s" % (s, rid)).encode()))
+        if owner == target_id:
+            return s
+    raise AssertionError("no session hashed to %s" % target_id)
+
+
+def _get_json(url, path, timeout=30):
+    return json.load(urllib.request.urlopen(url + path,
+                                            timeout=timeout))
+
+
+def _breaker_transitions(replica_id):
+    """Per-replica breaker transition counts from the process-wide
+    registry (to: closed/half_open/open)."""
+    from veles_tpu.telemetry import metrics
+    counter = metrics.counter(
+        "veles_router_breaker_transitions_total",
+        labelnames=("replica", "to"))
+    return {to: counter.labels(replica=str(replica_id), to=to).value
+            for to in ("closed", "half_open", "open")}
+
+
+# -- the chaos soak (acceptance) ----------------------------------------------
+
+def test_fleet_chaos_soak_kill_and_rolling_restart(f32):
+    """Acceptance: 3 replicas under continuous mixed load survive (a)
+    a hard replica kill mid-decode — the router retries transparently,
+    the fleet respawns — (b) an injected-500 breaker episode with full
+    open → half-open → closed recovery, and (c) a complete rolling
+    restart, with ZERO failed client requests, zero leaked KV blocks
+    on every replica, and greedy replies identical regardless of
+    which replica served them."""
+    from veles_tpu.serving import Fleet, Router
+    router = Router(health_interval=0.1, health_timeout=2.0,
+                    request_timeout=90.0, retries=4,
+                    retry_delay=0.02, retry_cap=0.2,
+                    breaker_failures=2, breaker_cooldown=0.3).start()
+    counter = [0]
+
+    def spawn(index):
+        counter[0] += 1
+        return _make_replica("chaos-r%d-g%d" % (index, counter[0]))
+
+    fleet = Fleet(spawn, 3, router=router,
+                  monitor_interval=0.1).start()
+    url = router.url
+    errors = []
+    replies = []
+    stop = threading.Event()
+    prompts = [[3, 1, 4], [5], [7, 2, 9, 1], [2, 2]]
+    try:
+        # same-model contract + affinity: repeated prompts land on
+        # one replica and greedy tokens are the reference everywhere
+        h1, ref = _post(url, {"prompt": [3, 1, 4], "steps": 6})
+        h2, again = _post(url, {"prompt": [3, 1, 4], "steps": 6})
+        assert again == ref
+        assert h1["X-Veles-Replica"] == h2["X-Veles-Replica"]
+
+        def client(i):
+            k = 0
+            while not stop.is_set():
+                p = prompts[(i + k) % len(prompts)]
+                body = {"prompt": p, "steps": 6}
+                if k % 3 == 1:  # seeded sampling rides along
+                    body.update(temperature=0.8, top_k=4, seed=17)
+                try:
+                    _, out = _post(url, body, timeout=90)
+                    replies.append((list(p), body.get("temperature"),
+                                    out["tokens"]))
+                except Exception as e:  # noqa: BLE001 — asserted 0
+                    errors.append(repr(e))
+                k += 1
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        # keep every decode mid-flight long enough for chaos to land
+        faults.inject("serving.scheduler.step", "delay", arg=0.002)
+        time.sleep(0.5)
+
+        # (a) hard-kill one replica: in-flight requests on it 5xx at
+        # the router, which retries them elsewhere; the supervisor
+        # respawns the dead index
+        victim_idx = 0
+        victim = fleet.handles()[victim_idx]
+        victim_id = fleet.replica_id(victim_idx)
+        victim.stop()
+        deadline = time.monotonic() + 30
+        while fleet.replica_id(victim_idx) == victim_id \
+                or not fleet.handles()[victim_idx].alive():
+            assert time.monotonic() < deadline, "no respawn"
+            time.sleep(0.05)
+        time.sleep(0.3)  # let the newcomer take traffic
+
+        # (b) breaker episode on a live replica: two consecutive
+        # injected 500s open it; after the cooldown the next request
+        # probes (half-open) and closes it again.  Session affinity
+        # aims requests at the target so the episode is deterministic
+        # even when the ambient prompts' affinity owners are others.
+        target_idx = 1
+        target_id = fleet.replica_id(target_idx)
+        ids = [r["id"] for r in
+               router.replica_state()["replicas"]]
+        aim = {"X-Veles-Session": _session_for(ids, target_id)}
+        before = _breaker_transitions(target_id)
+        faults.inject("router.forward", "http_error", arg=500,
+                      times=2, key=target_id)
+        # both injected 500s retry transparently: clients still 200
+        _post(url, {"prompt": [9, 9], "steps": 2}, headers=aim)
+        _post(url, {"prompt": [9, 9], "steps": 2}, headers=aim)
+        assert _breaker_transitions(target_id)["open"] \
+            > before["open"], "breaker did not open"
+        deadline = time.monotonic() + 30
+        while True:
+            after = _breaker_transitions(target_id)
+            if after["half_open"] > before["half_open"] \
+                    and after["closed"] > before["closed"]:
+                break
+            assert time.monotonic() < deadline, \
+                "no breaker recovery: %s vs %s" % (after, before)
+            # any request after the cooldown probes the half-open
+            # breaker (the router prefers the probe)
+            _post(url, {"prompt": [9, 9], "steps": 2}, headers=aim)
+            time.sleep(0.1)
+
+        # (c) rolling restart of the WHOLE fleet under load
+        report = fleet.rolling_restart(drain_timeout=60)
+        assert len(report) == 3
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join(120)
+            assert not t.is_alive(), "client wedged"
+
+        assert not errors, errors[:10]
+        assert len(replies) >= 20, "soak produced too little traffic"
+        refs = {tuple(p): _post(url, {"prompt": p,
+                                      "steps": 6})[1]["tokens"]
+                for p in prompts}
+        for p, temp, toks in replies:
+            assert len(toks) == len(p) + 6
+            if not temp:  # greedy: identical across every replica
+                assert toks == refs[tuple(p)], p
+
+        # zero leaked KV blocks on every live replica
+        for idx, handle in fleet.handles().items():
+            cache = handle.api.scheduler_.cache_
+            cache.check()
+            assert cache.used_blocks == 0, idx
+        state = router.replica_state()
+        assert state["router"]["retries"] >= 1
+        assert state["router"]["replica_restarts"] >= 4  # kill + 3
+        assert state["router"]["requests_error"] >= 1
+        assert all(r["breaker"] == "closed"
+                   for r in state["replicas"])
+    finally:
+        stop.set()
+        faults.clear()
+        fleet.stop()
+        router.stop()
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+def test_breaker_hang_timeout_counts_as_failure(f32):
+    """A hung forward (injected ``hang``) times out at the request
+    deadline, fails the attempt, and — with breaker_failures=1 —
+    opens the breaker; the reply is a structured router error, not a
+    hung socket."""
+    from veles_tpu.serving import Router
+    rep = _make_replica("hang-rep")
+    router = Router(health_interval=0.2, request_timeout=0.8,
+                    retries=1, breaker_failures=1,
+                    breaker_cooldown=5.0).start()
+    try:
+        router.add_replica(rep.host, rep.port, replica_id="rH")
+        faults.inject("router.forward", "hang", arg=3.0, times=1,
+                      key="rH")
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(router.url, {"prompt": [3, 1], "steps": 2},
+                  timeout=30)
+        elapsed = time.monotonic() - t0
+        assert e.value.code == 502
+        body = json.loads(e.value.read().decode())
+        assert body["error"]["attempts"] == 1
+        assert elapsed < 2.5, "did not fail at the deadline"
+        state = router.replica_state()
+        assert state["replicas"][0]["breaker"] == "open"
+        # with its only replica open, the fleet sheds: structured
+        # 503 + Retry-After
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(router.url, {"prompt": [3, 1], "steps": 2},
+                  timeout=30)
+        assert e.value.code == 503
+        assert int(e.value.headers["Retry-After"]) >= 1
+        assert json.loads(e.value.read().decode())["error"]["shed"] \
+            is True
+    finally:
+        router.stop()
+        rep.stop()
+
+
+def test_draining_is_not_a_breaker_trip(f32):
+    """Draining a replica routes traffic away WITHOUT opening its
+    breaker (drain is planned, not a fault), and /drain through the
+    router reaches the replica."""
+    from veles_tpu.serving import Router
+    reps = [_make_replica("drain-r%d" % i) for i in range(2)]
+    router = Router(health_interval=0.1, request_timeout=30.0).start()
+    try:
+        for i, rep in enumerate(reps):
+            router.add_replica(rep.host, rep.port,
+                               replica_id="rD%d" % i)
+        reply = router.drain_replica("rD0")
+        assert reply["draining"] is True
+        # traffic only flows to the live replica; rD0 stays closed
+        for _ in range(4):
+            headers, _ = _post(router.url,
+                               {"prompt": [3, 1], "steps": 2})
+            assert headers["X-Veles-Replica"] == reps[1].replica_id
+        state = {r["id"]: r for r in
+                 router.replica_state()["replicas"]}
+        assert state["rD0"]["draining"] is True
+        assert state["rD0"]["breaker"] == "closed"
+        assert state["rD1"]["draining"] is False
+    finally:
+        router.stop()
+        for rep in reps:
+            rep.stop()
+
+
+# -- hedging ------------------------------------------------------------------
+
+def test_hedging_fires_only_on_idempotent_requests(f32):
+    """A straggling primary is hedged once for idempotent requests
+    (greedy / seeded) — the hedge wins fast — while a non-idempotent
+    request (unseeded sampling) waits out the straggler instead of
+    decoding twice."""
+    from veles_tpu.serving import Router
+    reps = [_make_replica("hedge-r%d" % i) for i in range(2)]
+    router = Router(health_interval=0.2, request_timeout=30.0,
+                    hedge_delay=0.1, affinity_tokens=0,
+                    retries=2).start()
+    try:
+        # ids sort r0 < r1 -> the outstanding/id tie-break always
+        # picks r0 primary, so the straggler is deterministic
+        for i, rep in enumerate(reps):
+            router.add_replica(rep.host, rep.port,
+                               replica_id="r%d" % i)
+        _post(router.url, {"prompt": [3, 1], "steps": 2})  # warm
+        faults.inject("router.forward", "delay", arg=1.0, key="r0")
+        t0 = time.monotonic()
+        headers, out = _post(router.url,
+                             {"prompt": [3, 1, 4], "steps": 3})
+        fast = time.monotonic() - t0
+        assert len(out["tokens"]) == 6
+        assert headers["X-Veles-Replica"] == reps[1].replica_id
+        assert fast < 0.9, "hedge did not win over the straggler"
+        snap = router.stats.snapshot()
+        assert snap["hedges"] == 1 and snap["hedge_wins"] == 1
+        # non-idempotent: same straggler, NO hedge — the reply waits
+        t0 = time.monotonic()
+        _post(router.url, {"prompt": [3, 1, 4], "steps": 3,
+                           "temperature": 0.9})
+        slow = time.monotonic() - t0
+        assert slow >= 0.9, "non-idempotent request was hedged"
+        assert router.stats.snapshot()["hedges"] == 1
+    finally:
+        router.stop()
+        for rep in reps:
+            rep.stop()
+
+
+# -- retry budget / deadline --------------------------------------------------
+
+class _FakeReplicaHandler(BaseHTTPRequestHandler):
+    """Always-failing replica: healthz OK (so it registers), every
+    /generate answers a structured 500 carrying a tokens_generated
+    count — the propagation fixture."""
+
+    tokens = (3, 7, 5, 2, 1)
+    hits = [0]
+
+    def log_message(self, *args):
+        pass
+
+    def _reply(self, code, obj):
+        blob = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def do_GET(self):
+        self._reply(200, {"status": "ok", "draining": False})
+
+    def do_POST(self):
+        n = self.tokens[self.hits[0] % len(self.tokens)]
+        self.hits[0] += 1
+        self._reply(500, {"error": {"code": 500,
+                                    "message": "scripted failure",
+                                    "tokens_generated": n}})
+
+
+def test_retry_budget_and_tokens_propagation():
+    """Retries stop at the budget, never sleep past the deadline, and
+    the final reply propagates tokens_generated from the BEST failed
+    attempt."""
+    from veles_tpu.serving import Router
+    _FakeReplicaHandler.hits[0] = 0
+    server = ThreadingHTTPServer(("127.0.0.1", 0),
+                                 _FakeReplicaHandler)
+    threading.Thread(target=server.serve_forever,
+                     daemon=True).start()
+    port = server.server_address[1]
+    router = Router(health_interval=5.0, request_timeout=5.0,
+                    retries=3, retry_delay=0.01, retry_cap=0.05,
+                    breaker_failures=100).start()
+    try:
+        router.add_replica("127.0.0.1", port, replica_id="fake")
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(router.url, {"prompt": [1, 2], "steps": 4},
+                  timeout=30)
+        elapsed = time.monotonic() - t0
+        assert e.value.code == 500
+        body = json.loads(e.value.read().decode())
+        assert body["error"]["attempts"] == 3          # the budget
+        assert body["error"]["tokens_generated"] == 7  # best of 3,7,5
+        assert _FakeReplicaHandler.hits[0] == 3
+        assert elapsed < 2.0
+        assert router.stats.snapshot()["retries"] == 2
+
+        # deadline dominates the budget: long backoff + short
+        # deadline stops retrying before the allowance is used up
+        router2 = Router(
+            health_interval=5.0, request_timeout=0.5, retries=10,
+            retry_delay=0.4, retry_cap=0.4,
+            breaker_failures=100).start()
+        try:
+            router2.add_replica("127.0.0.1", port, replica_id="fake")
+            before = _FakeReplicaHandler.hits[0]
+            t0 = time.monotonic()
+            with pytest.raises(urllib.error.HTTPError):
+                _post(router2.url, {"prompt": [1, 2], "steps": 4},
+                      timeout=30)
+            elapsed = time.monotonic() - t0
+            attempts = _FakeReplicaHandler.hits[0] - before
+            assert attempts < 4, "kept retrying past the deadline"
+            assert elapsed < 1.5
+        finally:
+            router2.stop()
+    finally:
+        router.stop()
+        server.shutdown()
+
+
+# -- fleet spawn fault point --------------------------------------------------
+
+class _DummyHandle:
+    def __init__(self, port):
+        self.host = "127.0.0.1"
+        self.port = port
+        self.replica_id = "dummy%d" % port
+        self.stopped = False
+
+    def alive(self):
+        return not self.stopped
+
+    def stop(self):
+        self.stopped = True
+
+
+def test_fleet_spawn_retries_through_fault_point():
+    """An injected spawn failure (``fleet.replica.spawn``) is retried
+    with backoff until the replica comes up; the fleet runs without a
+    router (supervision-only mode)."""
+    from veles_tpu.serving import Fleet
+    spawned = []
+
+    def spawn(index):
+        handle = _DummyHandle(9000 + len(spawned))
+        spawned.append(handle)
+        return handle
+
+    faults.inject("fleet.replica.spawn", "exception", times=1,
+                  key="0")
+    fleet = Fleet(spawn, 2, router=None, monitor_interval=0.05,
+                  spawn_retries=3, spawn_delay=0.01)
+    t0 = time.monotonic()
+    fleet.start()
+    try:
+        assert len(spawned) == 2      # the retry made up the failure
+        assert time.monotonic() - t0 >= 0.01   # it backed off
+        # a dead dummy is respawned by the monitor
+        spawned[0].stopped = True
+        deadline = time.monotonic() + 10
+        while len(spawned) < 3:
+            assert time.monotonic() < deadline, "no respawn"
+            time.sleep(0.02)
+        # spawn exhaustion: every attempt fails -> start() raises
+        faults.inject("fleet.replica.spawn", "exception", key="9")
+        from veles_tpu.serving import Fleet as F2
+        bad = F2(lambda i: _DummyHandle(9999), 1, router=None,
+                 spawn_retries=2, spawn_delay=0.01)
+        bad.n = 1
+        with pytest.raises(faults.InjectedFault):
+            bad._spawn_one(9)
+    finally:
+        fleet.stop()

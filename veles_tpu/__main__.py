@@ -270,6 +270,8 @@ class Main:
             root.common.health.policy = self.args.health_policy
         if self.args.flightrec_dir:
             root.common.flightrec.dir = self.args.flightrec_dir
+        if self.args.admin_token:
+            root.common.api.admin_token = self.args.admin_token
         if self.args.prefetch is not None:
             root.common.loader.prefetch.enabled = self.args.prefetch > 0
             root.common.loader.prefetch.depth = self.args.prefetch

@@ -126,6 +126,14 @@ def build_parser():
                         "reuse compiled executables instead of paying "
                         "multi-second recompiles; sets "
                         "root.common.trace.compilation_cache_dir")
+    p.add_argument("--admin-token", default=None, metavar="TOKEN",
+                   help="bearer token a NON-loopback caller must "
+                        "present (Authorization: Bearer TOKEN) to hit "
+                        "the REST admin endpoints /drain and "
+                        "/shutdown — the remote-router rolling-"
+                        "restart story; sets "
+                        "root.common.api.admin_token (unset: those "
+                        "endpoints stay loopback-only)")
     p.add_argument("--flightrec-dir", default=None, metavar="DIR",
                    help="write crash flight-recorder bundles "
                         "(flightrec-<pid>.json) to DIR instead of the "

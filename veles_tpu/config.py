@@ -175,8 +175,36 @@ root.common.update({
     "loader": {"prefetch": {"enabled": True, "depth": 2}},
     # REST /generate resource caps (satellite of the input-pipeline
     # PR): oversize requests get a 400 instead of a giant alloc +
-    # multi-second compile
-    "api": {"max_steps": 2048, "max_batch": 64},
+    # multi-second compile.  admin_token (also --admin-token) lets a
+    # NON-loopback caller hit the admin endpoints (/drain, /shutdown)
+    # with "Authorization: Bearer <token>" — unset, they stay
+    # loopback-only
+    "api": {"max_steps": 2048, "max_batch": 64, "admin_token": None},
+    # multi-replica fleet router (serving/router.py): health-aware
+    # load balancing over N engine replicas with per-replica circuit
+    # breakers (closed -> open after breaker_failures consecutive
+    # failures, half-open single-probe recovery after
+    # breaker_cooldown), capped-exponential retry backoff with jitter
+    # (retry_delay base, retry_cap cap, retries total attempts, never
+    # past the request deadline), straggler hedging for idempotent
+    # requests (hedge_delay seconds; 0 disables), prompt-prefix
+    # session affinity (first affinity_tokens tokens; 0 disables) and
+    # fleet-level shedding (503 + shed_retry_after once no replica is
+    # eligible).  request_timeout None defers to
+    # root.common.serving.request_timeout.
+    "router": {
+        "health_interval": 0.5,
+        "health_timeout": 1.0,
+        "breaker_failures": 3,
+        "breaker_cooldown": 2.0,
+        "retries": 3,
+        "retry_delay": 0.05,
+        "retry_cap": 2.0,
+        "hedge_delay": 0.0,
+        "affinity_tokens": 16,
+        "request_timeout": None,
+        "shed_retry_after": 2,
+    },
     # host-side instrumentation (per-unit spans + metric histograms,
     # veles_tpu/telemetry/) — on by default, overhead-gated in CI.
     # cost_analysis: capture XLA cost/memory analysis once per jitted

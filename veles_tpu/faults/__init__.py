@@ -19,6 +19,11 @@ action         behavior at the injection point
                victim eventually *recovers* and cleanup can be asserted
 ``drop``       :func:`fire` returns True — the caller discards its unit
                of work (a frame, a heartbeat, a reply)
+``http_error`` raise :class:`InjectedHTTPError` carrying status code
+               ``arg`` (default 500) — REST/router points catch it and
+               answer a STRUCTURED JSON error reply instead of
+               crashing the handler (a replica that *replies* 500/503
+               is a different failure than one that dies mid-socket)
 ``kill``       ``os._exit(17)`` — sudden process death (real multi-
                process failover drills only; in-process tests prefer
                ``hang`` + heartbeat ``drop``)
@@ -28,8 +33,18 @@ Specs carry three modifiers: ``after=N`` skips the first N hits (arm
 the 3rd decode step, not the 1st), ``times=M`` disarms after M firings
 (a transient fault), and ``key=PATTERN`` scopes the spec to one
 caller (e.g. one worker id) when several share a point.  Points and
-keys match with :mod:`fnmatch` wildcards, so ``serving.*`` arms a
-whole subsystem.
+keys match with :mod:`fnmatch` wildcards — the patterns live in the
+SPEC, the literal names at the call site.  Point globs arm whole
+subsystems, key globs pick victims within one point::
+
+    serving.scheduler.*=delay:0.01      # every scheduler hazard site
+    router.*=exception                  # router forward AND health poll
+    router.forward=http_error:503~r2    # only forwards to replica "r2"
+    coordinator.worker.heartbeat=drop~w[01]   # workers w0 and w1 only
+
+A key given to :func:`fire` never widens a spec without one: a spec
+with no ``~key`` matches every caller of its point, while a keyed
+spec matches only callers whose key fits the pattern.
 
 Arming happens through :func:`inject` (tests), :func:`load` (a spec
 string), the ``VELES_FAULTS`` environment variable, or
@@ -52,14 +67,24 @@ import os
 import threading
 import time
 
-__all__ = ("InjectedFault", "FaultSpec", "inject", "load", "clear",
-           "active", "fire")
+__all__ = ("InjectedFault", "InjectedHTTPError", "FaultSpec",
+           "inject", "load", "clear", "active", "fire")
 
-ACTIONS = ("delay", "exception", "hang", "drop", "kill")
+ACTIONS = ("delay", "exception", "hang", "drop", "http_error", "kill")
 
 
 class InjectedFault(Exception):
     """Raised at an ``exception``-armed injection point."""
+
+
+class InjectedHTTPError(InjectedFault):
+    """Raised at an ``http_error``-armed point: REST/router handlers
+    catch it and reply a structured JSON error with :attr:`status`."""
+
+    def __init__(self, status=500):
+        self.status = int(status)
+        super(InjectedHTTPError, self).__init__(
+            "injected HTTP %d" % self.status)
 
 
 class FaultSpec:
@@ -223,6 +248,8 @@ def fire(point, key=None):
             time.sleep(float(s.arg if s.arg is not None else 3600.0))
         elif s.action == "exception":
             raise InjectedFault("injected fault at %s" % point)
+        elif s.action == "http_error":
+            raise InjectedHTTPError(int(s.arg) if s.arg else 500)
         elif s.action == "drop":
             drop = True
         elif s.action == "kill":

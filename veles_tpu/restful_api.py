@@ -89,9 +89,14 @@ class RESTfulAPI(Unit):
                  max_slots=4, serving_window=None, max_queue=32,
                  max_steps=None, max_batch=None, serving_kv=None,
                  serving_block_size=None, serving_kv_blocks=None,
-                 serving_prefill_chunk=None, **kwargs):
+                 serving_prefill_chunk=None, replica_id=None,
+                 **kwargs):
         super(RESTfulAPI, self).__init__(workflow, **kwargs)
         self.loader = loader
+        #: fleet identity: every reply carries it as X-Veles-Replica
+        #: so a fronting router (serving/router.py) can attribute
+        #: responses; defaults to pid:port once the server binds
+        self.replica_id = replica_id
         self.output = None  # linked from the head forward unit
         self.port = port
         self.host = host
@@ -264,6 +269,23 @@ class RESTfulAPI(Unit):
             def log_message(self, *args):
                 pass
 
+            def _admin_ok(self):
+                """Admin endpoints (/drain, /shutdown) are loopback-
+                only UNLESS root.common.api.admin_token is set and the
+                caller presents it as ``Authorization: Bearer`` — the
+                remote-router story; constant-time compare so the
+                token is not a timing oracle."""
+                peer = self.client_address[0]
+                if peer in ("127.0.0.1", "::1", "localhost"):
+                    return True
+                import hmac
+                from veles_tpu.config import root
+                token = root.common.api.get("admin_token", None)
+                if not token:
+                    return False
+                auth = self.headers.get("Authorization", "")
+                return hmac.compare_digest(auth, "Bearer %s" % token)
+
             def do_GET(self):
                 # drop any query string BEFORE trimming the trailing
                 # slash — load-balancer probes send /healthz?probe=1
@@ -286,7 +308,13 @@ class RESTfulAPI(Unit):
                     from veles_tpu.telemetry.health import monitor
                     state = monitor.state()
                     status = state["status"]
+                    # "draining" must stay a DISTINCT top-level string
+                    # (plus the boolean): a router parses it to route
+                    # the replica as draining, which is NOT a health
+                    # failure and must not trip its circuit breaker
                     reply = {"status": status, "pid": os.getpid(),
+                             "replica": api.replica_id,
+                             "draining": bool(api._draining_),
                              "health": state}
                     if api._draining_:
                         status = reply["status"] = "draining"
@@ -334,6 +362,9 @@ class RESTfulAPI(Unit):
                 blob = json.dumps(obj, default=str).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                if api.replica_id:
+                    self.send_header("X-Veles-Replica",
+                                     str(api.replica_id))
                 self.send_header("Content-Length", str(len(blob)))
                 self.end_headers()
                 self.wfile.write(blob)
@@ -352,6 +383,9 @@ class RESTfulAPI(Unit):
                                   default=str).encode()
                 self.send_response(int(code))
                 self.send_header("Content-Type", "application/json")
+                if api.replica_id:
+                    self.send_header("X-Veles-Replica",
+                                     str(api.replica_id))
                 if retry_after is not None:
                     self.send_header("Retry-After",
                                      str(max(1, int(retry_after))))
@@ -369,11 +403,13 @@ class RESTfulAPI(Unit):
             def do_POST(self):
                 if self.path.rstrip("/") == "/shutdown":
                     # control-plane guard: when serving beyond loopback,
-                    # only loopback peers may stop the workflow — an
-                    # open /shutdown is a one-request denial of service
-                    peer = self.client_address[0]
-                    if peer not in ("127.0.0.1", "::1", "localhost"):
-                        self.send_error(403, "shutdown is loopback-only")
+                    # only loopback peers (or a bearer of the admin
+                    # token) may stop the workflow — an open /shutdown
+                    # is a one-request denial of service
+                    if not self._admin_ok():
+                        self.send_error(
+                            403, "shutdown needs loopback or the "
+                            "admin token")
                         return
                     self._reply_json({"ok": True})
                     if api.shutdown_callback is not None:
@@ -383,11 +419,14 @@ class RESTfulAPI(Unit):
                     # rolling-restart hook: stop admitting (new
                     # submits 503 + Retry-After), finish in-flight,
                     # flip /healthz to 503 so the router drains this
-                    # replica.  Loopback-only like /shutdown — an
-                    # open drain is a one-request traffic blackhole.
-                    peer = self.client_address[0]
-                    if peer not in ("127.0.0.1", "::1", "localhost"):
-                        self.send_error(403, "drain is loopback-only")
+                    # replica.  Guarded like /shutdown (an open drain
+                    # is a one-request traffic blackhole), but the
+                    # admin token lets a REMOTE router drain replicas
+                    # it cannot reach over loopback.
+                    if not self._admin_ok():
+                        self.send_error(
+                            403, "drain needs loopback or the admin "
+                            "token")
                         return
                     api._draining_ = True
                     reply = {"draining": True}
@@ -597,6 +636,14 @@ class RESTfulAPI(Unit):
                             out.append(row.tolist())
                         self._reply_json(
                             {"tokens": out[0] if squeeze else out})
+                    except faults.InjectedHTTPError as e:
+                        # the http_error fault action: REPLY the
+                        # injected status as a structured error (a
+                        # deliberately-failing replica, not a crash)
+                        self._reply_error(
+                            e.status, _status_text(e),
+                            retry_after=1 if e.status == 503
+                            else None)
                     except Exception as e:
                         self.send_error(500, _status_text(e))
                     return
@@ -616,6 +663,9 @@ class RESTfulAPI(Unit):
         self._server_ = ThreadingHTTPServer((self.host, self.port),
                                             Handler)
         self.port = self._server_.server_address[1]
+        import os
+        self.replica_id = self.replica_id \
+            or "pid%d:%d" % (os.getpid(), self.port)
         self._thread_ = threading.Thread(
             target=self._server_.serve_forever, daemon=True,
             name="restful-api")
@@ -641,4 +691,8 @@ class RESTfulAPI(Unit):
             self.scheduler_ = None
         if self._server_ is not None:
             self._server_.shutdown()
+            # close the LISTENING socket too: a stopped replica must
+            # refuse new connections (fast router failover) instead
+            # of letting them rot in the dead server's accept backlog
+            self._server_.server_close()
             self._server_ = None

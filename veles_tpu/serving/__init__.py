@@ -30,16 +30,30 @@ per-token scan.  Here:
 - :mod:`veles_tpu.serving.metrics` — per-request TTFT, tokens/sec,
   queue depth, slot occupancy, KV-block occupancy and prefill-chunk
   stalls, exposed through the JSONL event sink
-  (:mod:`veles_tpu.logger`) and a ``snapshot()`` dict.
+  (:mod:`veles_tpu.logger`) and a ``snapshot()`` dict;
+- :mod:`veles_tpu.serving.router` — the multi-replica fleet tier: a
+  health-aware asyncio HTTP router (least-outstanding routing with
+  prefix/session affinity, per-replica circuit breakers, deadline-
+  bounded retries with capped backoff, bounded hedging for
+  idempotent requests, fleet-level load shedding) over N engine
+  replicas;
+- :mod:`veles_tpu.serving.fleet` — replica supervision: spawn N
+  replicas (in-process or subprocess handles), respawn the dead, and
+  orchestrate zero-downtime rolling restarts (drain → restart →
+  re-admit) through the router.
 """
 
 from veles_tpu.serving.engine import (  # noqa: F401
     paged_decode_step, slot_decode_step)
 from veles_tpu.serving.kv_slots import (  # noqa: F401
     PagedKVCache, SlotKVCache, paged_supported)
-from veles_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from veles_tpu.serving.metrics import (  # noqa: F401
+    RouterMetrics, ServingMetrics)
 from veles_tpu.serving.prefill import (  # noqa: F401
     chunked_supported, prefill, prefill_chunk, serving_supported)
+from veles_tpu.serving.fleet import (  # noqa: F401
+    Fleet, LocalReplica, SubprocessReplica, free_port)
+from veles_tpu.serving.router import Router  # noqa: F401
 from veles_tpu.serving.scheduler import (  # noqa: F401
     DeadlineExceededError, DrainingError, InferenceScheduler,
     QueueFullError, RequestCancelledError, SchedulerError)

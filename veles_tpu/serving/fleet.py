@@ -1,0 +1,305 @@
+"""Replica supervision for the router fleet: spawn N engine replicas,
+monitor them, respawn the dead, and orchestrate zero-downtime rolling
+restarts through :class:`veles_tpu.serving.router.Router`.
+
+The Veles DCN contract (the master re-distributes a dead worker's
+work) applied to serving: a replica process is EXPECTED to die, and
+the fleet's job is to make that invisible — the router retries the
+victim's in-flight requests elsewhere while the :class:`Fleet`
+supervisor respawns it and re-registers it for traffic.
+
+A *replica handle* is anything with ``host``/``port``/``alive()``/
+``stop()`` (and optionally ``replica_id``): :class:`LocalReplica`
+wraps an in-process :class:`~veles_tpu.restful_api.RESTfulAPI` (the
+tier-1 and bench shape — every replica still gets its OWN scheduler
+thread and KV cache), :class:`SubprocessReplica` runs a serving
+process from an argv template (the deployment shape).  ``Fleet``
+only sees the protocol, so chaos tests kill in-process replicas the
+same way production loses containers.
+
+Spawn attempts pass through the ``fleet.replica.spawn`` fault point
+(keyed by replica index) — an armed ``exception`` makes respawn fail
+and exercises the capped-backoff retry; ``hang`` delays recovery.
+
+Rolling restart (:meth:`Fleet.rolling_restart`), one replica at a
+time, zero failed client requests end to end:
+
+1. ``router.drain_replica(id)`` — routing stops immediately (the
+   "draining" state, NOT a breaker trip), then ``POST /drain`` closes
+   the replica's admission while in-flight requests finish;
+2. poll the replica's ``/healthz`` until ``drained`` (in-flight 0);
+3. stop the old handle, spawn a fresh one (same index, next
+   generation);
+4. re-register with the router — the registration probe re-admits it
+   as soon as ``/healthz`` answers 200.
+"""
+
+import json
+import subprocess
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from veles_tpu import faults
+from veles_tpu.logger import Logger
+
+
+def _get_json(host, port, path, timeout=5.0):
+    """GET a replica endpoint, returning (status, body-dict) — error
+    statuses still parse their structured JSON body (a draining
+    /healthz answers 503 WITH the drain progress)."""
+    url = "http://%s:%d%s" % (host, port, path)
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except Exception:
+            return e.code, {}
+
+
+class LocalReplica(object):
+    """In-process replica handle around a started
+    :class:`~veles_tpu.restful_api.RESTfulAPI` (plus its loader, when
+    the caller wants it closed on stop)."""
+
+    def __init__(self, api, loader=None):
+        self.api = api
+        self.loader = loader
+        self.host = api.host
+        self.port = api.port
+        self.replica_id = api.replica_id
+
+    def alive(self):
+        return self.api._server_ is not None
+
+    def stop(self):
+        """Stop serving.  On a drained replica this is graceful; on a
+        busy one it is the crash shape — pending futures fail and
+        in-flight handlers answer 5xx, which is exactly what the
+        router's retries exist to absorb."""
+        self.api.stop()
+        if self.loader is not None:
+            self.loader.close()
+
+
+class SubprocessReplica(object):
+    """Replica handle over a serving subprocess: ``argv`` is launched
+    as-is (the caller bakes host/port in; ``free_port()`` helps), and
+    liveness is the process's own."""
+
+    def __init__(self, argv, host, port, env=None):
+        self.host = host
+        self.port = int(port)
+        self.replica_id = None   # defer to the replica's own pid:port
+        self.proc = subprocess.Popen(argv, env=env)
+
+    def alive(self):
+        return self.proc.poll() is None
+
+    def stop(self):
+        self.proc.terminate()
+        try:
+            self.proc.wait(10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(10)
+
+
+def free_port(host="127.0.0.1"):
+    """Ask the OS for an ephemeral port (subprocess replicas need the
+    port chosen BEFORE exec)."""
+    import socket
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+class Fleet(Logger):
+    """Spawn/supervise ``n`` replicas and keep them registered with
+    ``router``.  ``spawn(index)`` returns a replica handle; the
+    monitor thread respawns any handle whose ``alive()`` goes False
+    (capped-backoff retries through the ``fleet.replica.spawn`` fault
+    point)."""
+
+    def __init__(self, spawn, n, router=None, monitor_interval=0.25,
+                 spawn_retries=5, spawn_delay=0.2, spawn_cap=5.0):
+        super(Fleet, self).__init__()
+        self.spawn = spawn
+        self.n = int(n)
+        self.router = router
+        self.monitor_interval = float(monitor_interval)
+        self.spawn_retries = int(spawn_retries)
+        self.spawn_delay = float(spawn_delay)
+        self.spawn_cap = float(spawn_cap)
+        self._replicas = {}     # index -> handle (None: spawn owed)
+        self._ids = {}          # index -> router replica id
+        self._generation = {}   # index -> spawn count
+        self._busy = set()      # indices mid-rolling-restart
+        self._lock = threading.Lock()
+        self._stopping = threading.Event()
+        self._thread = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self):
+        for i in range(self.n):
+            self._spawn_one(i)
+        with self._lock:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._monitor, daemon=True,
+                    name="fleet-monitor")
+                self._thread.start()
+        return self
+
+    def stop(self):
+        self._stopping.set()
+        with self._lock:
+            thread, self._thread = self._thread, None
+            handles = dict(self._replicas)
+            ids = dict(self._ids)
+            self._replicas = {}
+            self._ids = {}
+        if thread is not None:
+            thread.join(10)
+        for i, handle in handles.items():
+            if self.router is not None and i in ids:
+                try:
+                    self.router.remove_replica(ids[i])
+                except Exception:
+                    pass
+            if handle is not None:
+                handle.stop()
+
+    def handles(self):
+        """Live handles snapshot (index -> handle), e.g. for per-
+        replica KV-leak checks after a soak."""
+        with self._lock:
+            return dict(self._replicas)
+
+    def replica_id(self, index):
+        with self._lock:
+            return self._ids.get(index)
+
+    # -- spawning --------------------------------------------------------
+
+    def _spawn_one(self, index):
+        """Spawn replica ``index`` (next generation) and register it
+        with the router; retries with capped exponential backoff when
+        the spawn itself fails (the ``fleet.replica.spawn`` point)."""
+        handle = None
+        for attempt in range(1, self.spawn_retries + 1):
+            try:
+                if faults.fire("fleet.replica.spawn", key=str(index)):
+                    raise RuntimeError("injected spawn drop")
+                handle = self.spawn(index)
+                break
+            except Exception as e:
+                if attempt >= self.spawn_retries:
+                    self.error("replica %d spawn failed %d times: "
+                               "%r", index, attempt, e)
+                    raise
+                delay = min(self.spawn_cap,
+                            self.spawn_delay * (2 ** (attempt - 1)))
+                self.warning("replica %d spawn attempt %d failed "
+                             "(%r); retrying in %.2fs", index,
+                             attempt, e, delay)
+                time.sleep(delay)
+        rid = getattr(handle, "replica_id", None) \
+            or "%s:%d" % (handle.host, handle.port)
+        with self._lock:
+            gen = self._generation.get(index, 0)
+            self._generation[index] = gen + 1
+            self._replicas[index] = handle
+            self._ids[index] = rid
+        if self.router is not None:
+            self.router.add_replica(handle.host, handle.port,
+                                    replica_id=rid)
+            if gen > 0:
+                self.router.stats.record_restart(rid)
+        self.info("replica %d generation %d up as %s on %s:%d",
+                  index, gen + 1, rid, handle.host, handle.port)
+        return handle
+
+    def _monitor(self):
+        """Respawn dead replicas: deregister (the router already
+        breaker-opened it after the first failed forwards), spawn the
+        next generation, re-register."""
+        while not self._stopping.wait(self.monitor_interval):
+            with self._lock:
+                dead = [i for i, h in self._replicas.items()
+                        if i not in self._busy
+                        and (h is None or not h.alive())]
+            for index in dead:
+                if self._stopping.is_set():
+                    return
+                with self._lock:
+                    old = self._ids.get(index)
+                self.warning("replica %d (%s) died — respawning",
+                             index, old)
+                if self.router is not None and old is not None:
+                    try:
+                        self.router.remove_replica(old)
+                    except Exception:
+                        pass
+                try:
+                    self._spawn_one(index)
+                except Exception:
+                    # spawn exhausted its retries; the next tick
+                    # tries again (the index stays dead in the map)
+                    with self._lock:
+                        self._replicas[index] = None
+
+    # -- rolling restart -------------------------------------------------
+
+    def rolling_restart(self, drain_timeout=60.0, poll=0.05):
+        """Drain → stop → respawn → re-admit, one replica at a time,
+        under live traffic.  Returns per-index drain/restart info;
+        raises if any replica fails to drain inside
+        ``drain_timeout``."""
+        if self.router is None:
+            raise RuntimeError("rolling restart needs a router")
+        report = {}
+        for index in sorted(self._replicas):
+            with self._lock:
+                handle = self._replicas.get(index)
+                rid = self._ids.get(index)
+                self._busy.add(index)
+            try:
+                if handle is None:
+                    continue
+                t0 = time.monotonic()
+                self.router.drain_replica(rid)
+                deadline = time.monotonic() + drain_timeout
+                while True:
+                    _, health = _get_json(handle.host, handle.port,
+                                          "/healthz")
+                    if health.get("status") == "draining" \
+                            and (health.get("drained")
+                                 or not health.get("in_flight")):
+                        break
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            "replica %s did not drain in %.0fs "
+                            "(in_flight=%s)"
+                            % (rid, drain_timeout,
+                               health.get("in_flight")))
+                    time.sleep(poll)
+                drained_s = time.monotonic() - t0
+                self.router.remove_replica(rid)
+                handle.stop()
+                self._spawn_one(index)  # records the restart metric
+                report[index] = {
+                    "old": rid, "new": self.replica_id(index),
+                    "drain_s": round(drained_s, 3)}
+                self.info("rolling restart %d/%d: %s -> %s "
+                          "(drained in %.2fs)", index + 1,
+                          len(report), rid,
+                          self.replica_id(index), drained_s)
+            finally:
+                with self._lock:
+                    self._busy.discard(index)
+        return report

@@ -104,6 +104,149 @@ def _registry_series():
     }
 
 
+_BREAKER_STATES = {"closed": 0, "half_open": 1, "open": 2}
+
+
+def _router_series():
+    return {
+        "requests": metrics.counter(
+            "veles_router_requests_total",
+            "forward attempts, by replica and outcome (ok/error)",
+            labelnames=("replica", "outcome")),
+        "retries": metrics.counter(
+            "veles_router_retries_total",
+            "forward attempts retried on another replica after a "
+            "failure/timeout/5xx"),
+        "hedges": metrics.counter(
+            "veles_router_hedges_total",
+            "hedge requests launched against a straggler replica "
+            "(idempotent requests only)"),
+        "hedge_wins": metrics.counter(
+            "veles_router_hedge_wins_total",
+            "hedge requests that answered before the primary"),
+        "shed": metrics.counter(
+            "veles_router_shed_total",
+            "requests shed at the router (503 + Retry-After: no "
+            "eligible replica)"),
+        "breaker_state": metrics.gauge(
+            "veles_router_breaker_state",
+            "per-replica circuit breaker: 0 closed, 1 half-open, "
+            "2 open", labelnames=("replica",)),
+        "breaker_transitions": metrics.counter(
+            "veles_router_breaker_transitions_total",
+            "circuit-breaker state entries, by replica and new state",
+            labelnames=("replica", "to")),
+        "request_ms": metrics.histogram(
+            "veles_router_request_ms",
+            "router-side whole-request latency (all attempts + "
+            "backoff; the fleet tail clients actually see)",
+            buckets=MS_BUCKETS),
+        "restarts": metrics.counter(
+            "veles_router_replica_restarts_total",
+            "replica respawns (supervisor recovery or rolling "
+            "restart)", labelnames=("replica",)),
+        "drains": metrics.counter(
+            "veles_router_replica_drains_total",
+            "replica drains initiated through the router",
+            labelnames=("replica",)),
+    }
+
+
+class RouterMetrics:
+    """Thread-safe router counters, mirrored into the process-wide
+    registry as the ``veles_router_*`` Prometheus families (same
+    instance-plus-global split as :class:`ServingMetrics`)."""
+
+    def __init__(self, recent=256):
+        self._lock = threading.Lock()
+        self.requests_ok = 0
+        self.requests_error = 0
+        self.retries = 0
+        self.hedges = 0
+        self.hedge_wins = 0
+        self.shed = 0
+        self.restarts = 0
+        self.drains = 0
+        self._request_ms = Histogram("router_request_ms",
+                                     buckets=MS_BUCKETS,
+                                     reservoir=recent)
+        self._global = _router_series()
+
+    def record_forward(self, replica, ok):
+        outcome = "ok" if ok else "error"
+        with self._lock:
+            if ok:
+                self.requests_ok += 1
+            else:
+                self.requests_error += 1
+        self._global["requests"].labels(
+            replica=str(replica), outcome=outcome).inc()
+
+    def record_retry(self):
+        with self._lock:
+            self.retries += 1
+        self._global["retries"].inc()
+
+    def record_hedge(self):
+        with self._lock:
+            self.hedges += 1
+        self._global["hedges"].inc()
+
+    def record_hedge_win(self):
+        with self._lock:
+            self.hedge_wins += 1
+        self._global["hedge_wins"].inc()
+
+    def record_shed(self):
+        with self._lock:
+            self.shed += 1
+        self._global["shed"].inc()
+        events.record("router.shed", "single", cls="Router")
+
+    def record_breaker(self, replica, state):
+        self._global["breaker_state"].labels(
+            replica=str(replica)).set(_BREAKER_STATES[state])
+        self._global["breaker_transitions"].labels(
+            replica=str(replica), to=state).inc()
+        events.record("router.breaker", "single", cls="Router",
+                      replica=str(replica), to=state)
+
+    def record_request(self, ms):
+        self._request_ms.observe(ms)
+        self._global["request_ms"].observe(ms)
+
+    def record_restart(self, replica):
+        with self._lock:
+            self.restarts += 1
+        self._global["restarts"].labels(replica=str(replica)).inc()
+        events.record("router.replica_restart", "single",
+                      cls="Router", replica=str(replica))
+
+    def record_drain(self, replica):
+        with self._lock:
+            self.drains += 1
+        self._global["drains"].labels(replica=str(replica)).inc()
+        events.record("router.replica_drain", "single", cls="Router",
+                      replica=str(replica))
+
+    def snapshot(self):
+        with self._lock:
+            out = {
+                "requests_ok": self.requests_ok,
+                "requests_error": self.requests_error,
+                "retries": self.retries,
+                "hedges": self.hedges,
+                "hedge_wins": self.hedge_wins,
+                "shed": self.shed,
+                "replica_restarts": self.restarts,
+                "replica_drains": self.drains,
+            }
+        out["request_ms_p50"] = self._request_ms.percentile(0.50)
+        out["request_ms_p95"] = self._request_ms.percentile(0.95)
+        out["request_ms_p99"] = self._request_ms.percentile(0.99)
+        return out
+
+
 class ServingMetrics:
     """Thread-safe serving counters + recent-window latency stats."""
 

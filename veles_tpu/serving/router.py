@@ -1,0 +1,750 @@
+"""Health-aware HTTP router over N serving replicas — the tier that
+makes the fleet fail like a fleet instead of like its weakest process.
+
+One engine process (``restful_api.py`` + ``serving/scheduler.py``) is
+both the availability and the throughput ceiling: a crash takes the
+service down and there is no way to restart under live traffic.  The
+:class:`Router` fronts N replicas and composes the primitives PRs 3–7
+shipped per process (``GET /healthz``, ``POST /drain``, structured
+JSON errors with ``Retry-After``, the :mod:`veles_tpu.faults`
+registry) into fleet behavior:
+
+- **health-aware routing** — a poll task GETs every replica's
+  ``/healthz`` (and piggybacks ``/serving/metrics``) each
+  ``health_interval``; replicas reporting ``"draining"`` or
+  ``"halted"``, or unreachable twice in a row, leave the rotation
+  without tripping a breaker.  Among eligible replicas the router
+  picks **least-outstanding-requests**, with optional prompt-prefix /
+  session **affinity** (rendezvous hash over the first
+  ``affinity_tokens`` prompt tokens, or the ``X-Veles-Session``
+  header) so repeated prompts land on the replica already holding
+  their KV blocks;
+- **circuit breakers** — per replica: ``closed`` → ``open`` after
+  ``breaker_failures`` consecutive transport failures/timeouts/5xx;
+  after ``breaker_cooldown`` the breaker goes ``half_open`` and
+  admits a SINGLE probe request — success (any HTTP reply, 503
+  included: backpressure proves liveness) closes it, failure
+  re-opens.  State rides ``veles_router_breaker_state{replica}``;
+- **retries** — a failed attempt (connection error, timeout, 5xx)
+  retries on another replica under a per-request budget
+  (``retries`` total attempts) with capped exponential backoff plus
+  jitter (the coordinator ``_backoff`` shape), never past the
+  request deadline; when every attempt fails, the reply propagates
+  ``tokens_generated`` from the best attempt so the client knows
+  what its budget bought;
+- **hedging** — for idempotent requests only (greedy, or seeded
+  sampling: the reply is the same whichever replica answers), a
+  straggling primary attempt is hedged once against a second replica
+  after ``hedge_delay`` seconds; the first deliverable reply wins
+  and the loser is cancelled (0 disables);
+- **load shedding** — once no replica is eligible (all open,
+  draining, unhealthy or saturated) the router answers a structured
+  503 with ``Retry-After`` instead of queueing unbounded;
+- **rolling restarts** — :meth:`drain_replica` marks the replica
+  draining router-side FIRST (no new traffic — explicitly NOT a
+  breaker trip), then POSTs ``/drain`` (with the
+  ``root.common.api.admin_token`` bearer when configured, so remote
+  replicas accept it); :class:`veles_tpu.serving.fleet.Fleet`
+  orchestrates drain → wait drained → restart → re-admit over the
+  whole fleet with zero failed client requests.
+
+Fault points ``router.forward`` and ``router.replica.health`` (keyed
+by replica id) wire the router into the injection registry; they run
+in the executor so a ``hang``/``delay`` stalls one attempt, not the
+event loop.  Everything is asyncio on ONE background loop thread —
+replica state is only ever mutated there, so routing decisions need
+no locks; public entry points marshal through the loop.
+
+Config: ``root.common.router.*`` (every knob also a constructor
+kwarg); see ``config.py`` for the full table.
+"""
+
+import asyncio
+import json
+import random
+import threading
+import time
+import zlib
+
+from veles_tpu import faults
+from veles_tpu.logger import Logger
+from veles_tpu.serving.metrics import RouterMetrics
+
+#: outcomes the router hands to the client as-is (2xx/3xx/4xx — the
+#: replica spoke; 5xx and transport errors are the router's to mask)
+_DELIVERABLE_BELOW = 500
+
+
+def _router_conf(name, default):
+    from veles_tpu.config import root
+    return root.common.router.get(name, default)
+
+
+class _Replica(object):
+    """Router-side view of one replica.  Mutated ONLY on the router's
+    event-loop thread (the no-locks invariant of this module)."""
+
+    __slots__ = ("id", "host", "port", "outstanding", "healthy",
+                 "status", "draining", "marked_draining",
+                 "health_failures", "breaker", "failures",
+                 "opened_at", "probing", "saturated_until",
+                 "last_health", "last_metrics", "requests")
+
+    def __init__(self, replica_id, host, port):
+        self.id = str(replica_id)
+        self.host = host
+        self.port = int(port)
+        self.outstanding = 0      # in-flight forwards (routing load)
+        self.healthy = False      # until the first probe passes
+        self.status = "unknown"
+        self.draining = False     # healthz said so (or marked below)
+        self.marked_draining = False  # router-initiated drain latch
+        self.health_failures = 0  # consecutive failed probes
+        self.breaker = "closed"   # closed | open | half_open
+        self.failures = 0         # consecutive forward failures
+        self.opened_at = 0.0
+        self.probing = False      # the half-open single probe is out
+        self.saturated_until = 0.0  # 503 Retry-After backoff window
+        self.last_health = None
+        self.last_metrics = None
+        self.requests = 0
+
+    def view(self):
+        return {
+            "id": self.id, "host": self.host, "port": self.port,
+            "healthy": self.healthy, "status": self.status,
+            "draining": self.draining, "breaker": self.breaker,
+            "outstanding": self.outstanding,
+            "requests": self.requests,
+            "consecutive_failures": self.failures,
+            "queue_depth": (self.last_metrics or {}).get(
+                "queue_depth"),
+        }
+
+
+class _Outcome(object):
+    """One normalized forward attempt: either a replica reply
+    (``status``/``headers``/``body``) or a transport ``error``."""
+
+    __slots__ = ("rep", "status", "headers", "body", "error")
+
+    def __init__(self, rep, status=None, headers=None, body=b"",
+                 error=None):
+        self.rep = rep
+        self.status = status
+        self.headers = headers or {}
+        self.body = body
+        self.error = error
+
+    @property
+    def deliverable(self):
+        return self.error is None and self.status < _DELIVERABLE_BELOW
+
+    def tokens_generated(self):
+        """The partial-decode count a failed attempt's structured
+        error body carried (408/5xx material), else None."""
+        try:
+            err = json.loads(self.body.decode()).get("error", {})
+            return int(err["tokens_generated"])
+        except Exception:
+            return None
+
+
+class Router(Logger):
+    """Asyncio HTTP router over N serving replicas (module docstring
+    has the behavior contract).  ``start()`` binds and returns self;
+    ``add_replica``/``remove_replica``/``drain_replica`` are
+    thread-safe; ``stop()`` tears the loop down."""
+
+    def __init__(self, host="127.0.0.1", port=0, replicas=(),
+                 health_interval=None, health_timeout=None,
+                 breaker_failures=None, breaker_cooldown=None,
+                 retries=None, retry_delay=None, retry_cap=None,
+                 hedge_delay=None, affinity_tokens=None,
+                 request_timeout=None, shed_retry_after=None):
+        super(Router, self).__init__()
+        self.host = host
+        self.port = int(port)
+        self.health_interval = float(
+            _router_conf("health_interval", 0.5)
+            if health_interval is None else health_interval)
+        self.health_timeout = float(
+            _router_conf("health_timeout", 1.0)
+            if health_timeout is None else health_timeout)
+        self.breaker_failures = int(
+            _router_conf("breaker_failures", 3)
+            if breaker_failures is None else breaker_failures)
+        self.breaker_cooldown = float(
+            _router_conf("breaker_cooldown", 2.0)
+            if breaker_cooldown is None else breaker_cooldown)
+        self.retries = int(_router_conf("retries", 3)
+                           if retries is None else retries)
+        self.retry_delay = float(_router_conf("retry_delay", 0.05)
+                                 if retry_delay is None
+                                 else retry_delay)
+        self.retry_cap = float(_router_conf("retry_cap", 2.0)
+                               if retry_cap is None else retry_cap)
+        self.hedge_delay = float(_router_conf("hedge_delay", 0.0)
+                                 if hedge_delay is None
+                                 else hedge_delay)
+        self.affinity_tokens = int(
+            _router_conf("affinity_tokens", 16)
+            if affinity_tokens is None else affinity_tokens)
+        if request_timeout is None:
+            request_timeout = _router_conf("request_timeout", None)
+        if request_timeout is None:
+            from veles_tpu.config import root
+            request_timeout = root.common.serving.get(
+                "request_timeout", 120.0)
+        self.request_timeout = float(request_timeout or 120.0)
+        self.shed_retry_after = int(
+            _router_conf("shed_retry_after", 2)
+            if shed_retry_after is None else shed_retry_after)
+        self.stats = RouterMetrics()
+        self._seed_replicas = [tuple(r) for r in replicas]
+        self._replicas = {}        # id -> _Replica (loop thread only)
+        self._lock = threading.Lock()
+        self._loop = None
+        self._thread = None
+        self._server = None
+        self._health_task = None
+        self._ready = threading.Event()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self):
+        with self._lock:  # two racing start()s must not spawn 2 loops
+            if self._thread is not None:
+                self._ready.wait(60)
+                return self
+            self._loop = asyncio.new_event_loop()
+            self._thread = threading.Thread(
+                target=self._loop.run_forever, daemon=True,
+                name="serving-router")
+        self._thread.start()
+        asyncio.run_coroutine_threadsafe(
+            self._bind(), self._loop).result(60)
+        for spec in self._seed_replicas:
+            self.add_replica(*spec)
+        self._ready.set()
+        self.info("router on http://%s:%d -> %d replica(s)",
+                  self.host, self.port, len(self._seed_replicas))
+        return self
+
+    async def _bind(self):
+        self._server = await asyncio.start_server(
+            self._serve_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._health_task = asyncio.ensure_future(self._health_loop())
+
+    def stop(self):
+        with self._lock:
+            loop, self._loop = self._loop, None
+            thread, self._thread = self._thread, None
+        if loop is None:
+            return
+        asyncio.run_coroutine_threadsafe(
+            self._shutdown(), loop).result(30)
+        loop.call_soon_threadsafe(loop.stop)
+        if thread is not None:
+            thread.join(30)
+        loop.close()
+
+    async def _shutdown(self):
+        if self._health_task is not None:
+            self._health_task.cancel()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    @property
+    def url(self):
+        return "http://%s:%d" % (self.host, self.port)
+
+    def _call(self, coro):
+        """Run a coroutine on the router loop from any thread."""
+        with self._lock:
+            loop = self._loop
+        if loop is None:
+            raise RuntimeError("router is not running")
+        return asyncio.run_coroutine_threadsafe(coro, loop).result(60)
+
+    # -- replica registry ------------------------------------------------
+
+    def add_replica(self, host, port, replica_id=None):
+        """Register a replica and probe it once (so a freshly started
+        healthy replica is routable without waiting out a poll
+        period).  Returns the replica id."""
+        rid = str(replica_id or "%s:%d" % (host, int(port)))
+        return self._call(self._add(rid, host, int(port)))
+
+    async def _add(self, rid, host, port):
+        rep = _Replica(rid, host, port)
+        self._replicas[rid] = rep
+        self.stats.record_breaker(rid, "closed")
+        await self._probe(rep)
+        return rid
+
+    def remove_replica(self, replica_id):
+        """Deregister (a stopped/dead replica); in-flight forwards to
+        it finish or fail on their own."""
+        return self._call(self._remove(str(replica_id)))
+
+    async def _remove(self, rid):
+        return self._replicas.pop(rid, None) is not None
+
+    def replica_state(self):
+        """Monitoring snapshot: per-replica view + router counters."""
+        return self._call(self._state())
+
+    async def _state(self):
+        return {
+            "replicas": [r.view() for r in self._replicas.values()],
+            "eligible": len(self._pickable(time.monotonic())),
+            "router": self.stats.snapshot(),
+        }
+
+    def drain_replica(self, replica_id, timeout=30.0):
+        """Begin draining one replica for a rolling restart: the
+        router stops routing to it IMMEDIATELY (a drain is not a
+        breaker trip), then POSTs ``/drain`` (bearer admin token when
+        configured).  Returns the replica's drain reply dict."""
+        return self._call(self._drain(str(replica_id), timeout))
+
+    async def _drain(self, rid, timeout):
+        rep = self._replicas.get(rid)
+        if rep is None:
+            raise KeyError("unknown replica %r" % rid)
+        rep.marked_draining = rep.draining = True
+        self.stats.record_drain(rid)
+        headers = {}
+        from veles_tpu.config import root
+        token = root.common.api.get("admin_token", None)
+        if token:
+            headers["Authorization"] = "Bearer %s" % token
+        status, _, body = await asyncio.wait_for(
+            self._http(rep, "POST", "/drain", b"{}", headers),
+            timeout)
+        if status >= 400:
+            raise RuntimeError("drain of %s failed: HTTP %d" %
+                               (rid, status))
+        return json.loads(body.decode() or "{}")
+
+    # -- routing ---------------------------------------------------------
+
+    def _eligible(self, rep, now):
+        if rep.draining or not rep.healthy:
+            return False
+        if now < rep.saturated_until:
+            return False
+        if rep.breaker == "open":
+            if now - rep.opened_at < self.breaker_cooldown:
+                return False
+            self._breaker_to(rep, "half_open")
+        if rep.breaker == "half_open" and rep.probing:
+            return False  # single probe at a time
+        return True
+
+    def _pickable(self, now, exclude=()):
+        return [r for r in self._replicas.values()
+                if r.id not in exclude and self._eligible(r, now)]
+
+    def _pick(self, affinity, now, exclude=()):
+        """Choose the attempt's replica: a half-open breaker's probe
+        first (recovery must not wait for idle), then the affinity
+        target, then least-outstanding (ties by id for
+        determinism)."""
+        candidates = self._pickable(now, exclude)
+        if not candidates:
+            return None
+        half = [r for r in candidates if r.breaker == "half_open"]
+        if half:
+            rep = min(half, key=lambda r: r.id)
+            rep.probing = True
+            return rep
+        if affinity is not None:
+            # rendezvous hash over the FULL registry (stable under
+            # breaker flaps), honored only when the owner is eligible
+            owner = max(
+                self._replicas.values(),
+                key=lambda r: zlib.crc32(
+                    ("%s|%s" % (affinity, r.id)).encode()))
+            if owner in candidates:
+                return owner
+        return min(candidates, key=lambda r: (r.outstanding, r.id))
+
+    def _breaker_to(self, rep, state):
+        if rep.breaker == state:
+            return
+        rep.breaker = state
+        rep.probing = False
+        if state == "open":
+            rep.opened_at = time.monotonic()
+        self.stats.record_breaker(rep.id, state)
+        self.info("replica %s breaker -> %s", rep.id, state)
+
+    def _breaker_failure(self, rep):
+        rep.failures += 1
+        rep.probing = False
+        if rep.breaker == "half_open" \
+                or rep.failures >= self.breaker_failures:
+            self._breaker_to(rep, "open")
+
+    def _breaker_success(self, rep):
+        rep.failures = 0
+        if rep.breaker != "closed":
+            self._breaker_to(rep, "closed")
+
+    def _backoff(self, attempt):
+        """Delay before retry ``attempt`` (1-based): exponential from
+        ``retry_delay``, capped at ``retry_cap``, half-window jitter
+        (the coordinator reconnect shape — fleet retries must
+        decorrelate)."""
+        base = min(self.retry_cap,
+                   self.retry_delay * (2 ** (attempt - 1)))
+        return base * (0.5 + 0.5 * random.random())
+
+    def _inspect(self, raw, headers):
+        """(idempotent, affinity_key) for a /generate body.  Greedy
+        and seed-pinned requests are idempotent (any replica answers
+        the same tokens); the affinity key is the session header or
+        the first ``affinity_tokens`` prompt tokens."""
+        try:
+            body = json.loads(raw.decode())
+            prompt = body.get("prompt")
+        except Exception:
+            return False, None  # the replica will 400 it
+        idempotent = not float(body.get("temperature") or 0.0) \
+            or body.get("seed") is not None
+        affinity = headers.get("x-veles-session")
+        if affinity is None and self.affinity_tokens > 0 \
+                and isinstance(prompt, list) and prompt:
+            row = prompt[0] if isinstance(prompt[0], list) else prompt
+            affinity = repr(row[:self.affinity_tokens])
+        return idempotent, affinity
+
+    async def _attempt(self, rep, raw, headers, timeout):
+        """One forward, normalized to an :class:`_Outcome`, with the
+        breaker/metrics accounting applied."""
+        async def _payload():
+            # executor: an armed hang/delay stalls this attempt (and
+            # times out below like any straggler), not the event loop
+            dropped = await asyncio.get_running_loop() \
+                .run_in_executor(None, faults.fire,
+                                 "router.forward", rep.id)
+            if dropped:
+                raise ConnectionError("injected forward drop")
+            return await self._http(
+                rep, "POST", "/generate", raw,
+                {k: v for k, v in headers.items()
+                 if k == "x-veles-session"})
+
+        rep.outstanding += 1
+        rep.requests += 1
+        try:
+            try:
+                status, rheaders, rbody = await asyncio.wait_for(
+                    _payload(), timeout)
+                out = _Outcome(rep, status, rheaders, rbody)
+            except faults.InjectedHTTPError as e:
+                # a replica that REPLIES an error (http_error action)
+                out = _Outcome(rep, e.status, {}, json.dumps(
+                    {"error": {"code": e.status, "message": str(e),
+                               "injected": True}}).encode())
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                out = _Outcome(rep, error=e)
+        finally:
+            rep.outstanding -= 1
+        now = time.monotonic()
+        if out.error is not None \
+                or (out.status >= 500 and out.status != 503):
+            self._breaker_failure(rep)
+        else:
+            # any reply proves liveness — 503 is backpressure, not a
+            # fault; park the replica for its Retry-After instead
+            self._breaker_success(rep)
+            if out.status == 503:
+                try:
+                    after = float(out.headers.get("retry-after", 1))
+                except ValueError:
+                    after = 1.0
+                rep.saturated_until = now + min(after, 5.0)
+        self.stats.record_forward(rep.id, out.deliverable)
+        return out
+
+    async def _attempt_hedged(self, rep, raw, headers, timeout,
+                              idempotent, now):
+        """The primary attempt, hedged once against a second replica
+        when the primary straggles past ``hedge_delay`` and the
+        request is idempotent.  Returns the winning outcome (a
+        deliverable one when either attempt produced it)."""
+        primary = asyncio.ensure_future(
+            self._attempt(rep, raw, headers, timeout))
+        if not idempotent or self.hedge_delay <= 0 \
+                or not self._pickable(now, exclude=(rep.id,)):
+            return await primary
+        done, _ = await asyncio.wait({primary},
+                                     timeout=self.hedge_delay)
+        if primary in done:
+            return primary.result()
+        rep2 = self._pick(None, time.monotonic(),
+                          exclude=(rep.id,))
+        if rep2 is None:
+            return await primary
+        self.stats.record_hedge()
+        hedge = asyncio.ensure_future(
+            self._attempt(rep2, raw, headers, timeout))
+        pending = {primary, hedge}
+        best = None
+        while pending:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED)
+            for task in done:
+                out = task.result()
+                if out.deliverable:
+                    for p in pending:
+                        p.cancel()
+                    if task is hedge:
+                        self.stats.record_hedge_win()
+                    return out
+                best = out
+        return best
+
+    async def _forward_generate(self, raw, headers):
+        """The data-plane path: pick → attempt (hedged) → classify →
+        retry/shed, all bounded by the request deadline."""
+        t0 = time.monotonic()
+        deadline = t0 + self.request_timeout
+        idempotent, affinity = self._inspect(raw, headers)
+        best_tokens = None
+        last = None
+        attempts = 0
+        while attempts < self.retries:
+            now = time.monotonic()
+            if now >= deadline:
+                break
+            rep = self._pick(affinity, now)
+            if rep is None:
+                break  # fleet-level shed (or nothing left to try)
+            attempts += 1
+            if attempts > 1:
+                self.stats.record_retry()
+            out = await self._attempt_hedged(
+                rep, raw, headers, deadline - now, idempotent, now)
+            if out.deliverable:
+                self.stats.record_request(
+                    (time.monotonic() - t0) * 1e3)
+                rheaders = {
+                    "Content-Type": out.headers.get(
+                        "content-type", "application/json"),
+                    "X-Veles-Router-Attempts": str(attempts)}
+                if "x-veles-replica" in out.headers:
+                    rheaders["X-Veles-Replica"] = \
+                        out.headers["x-veles-replica"]
+                else:
+                    rheaders["X-Veles-Replica"] = out.rep.id
+                if "retry-after" in out.headers:
+                    rheaders["Retry-After"] = \
+                        out.headers["retry-after"]
+                return out.status, rheaders, out.body
+            last = out
+            toks = out.tokens_generated()
+            if toks is not None:
+                best_tokens = max(best_tokens or 0, toks)
+            delay = self._backoff(attempts)
+            if time.monotonic() + delay >= deadline:
+                break
+            await asyncio.sleep(delay)
+        # every attempt failed (or none was possible) — shed/report
+        self.stats.record_request((time.monotonic() - t0) * 1e3)
+        if last is None:
+            self.stats.record_shed()
+            return self._error(
+                503, "no eligible replica (fleet saturated, "
+                "draining or open)", retry_after=self.shed_retry_after,
+                attempts=attempts, shed=True)
+        if last.error is not None:
+            return self._error(
+                502, "replica unreachable after %d attempt(s): %s"
+                % (attempts, last.error), attempts=attempts,
+                tokens_generated=best_tokens)
+        return self._error(
+            last.status, "replica error after %d attempt(s)"
+            % attempts,
+            retry_after=self.shed_retry_after
+            if last.status == 503 else None,
+            attempts=attempts, tokens_generated=best_tokens)
+
+    # -- health polling --------------------------------------------------
+
+    async def _health_loop(self):
+        while True:
+            await asyncio.sleep(self.health_interval)
+            reps = list(self._replicas.values())
+            if reps:
+                await asyncio.gather(
+                    *[self._probe(r) for r in reps],
+                    return_exceptions=True)
+
+    async def _probe(self, rep):
+        try:
+            dropped = await asyncio.get_running_loop() \
+                .run_in_executor(None, faults.fire,
+                                 "router.replica.health", rep.id)
+            if dropped:
+                raise ConnectionError("injected health drop")
+            status, _, body = await asyncio.wait_for(
+                self._http(rep, "GET", "/healthz", None),
+                self.health_timeout)
+            info = json.loads(body.decode())
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # flappy/unreachable: two strikes take it out of rotation
+            # (health exclusion, NOT a breaker trip)
+            rep.health_failures += 1
+            if rep.health_failures >= 2:
+                if rep.healthy:
+                    self.info("replica %s unreachable — out of "
+                              "rotation", rep.id)
+                rep.healthy = False
+                rep.status = "unreachable"
+            return
+        rep.health_failures = 0
+        rep.last_health = info
+        rep.status = str(info.get("status", "unknown"))
+        rep.draining = rep.marked_draining \
+            or rep.status == "draining" \
+            or bool(info.get("draining"))
+        # a draining replica is ALIVE (it finishes its in-flight
+        # work); "halted" (health policy latched) is not servable
+        rep.healthy = status == 200 or rep.draining
+        try:
+            _, _, mbody = await asyncio.wait_for(
+                self._http(rep, "GET", "/serving/metrics", None),
+                self.health_timeout)
+            rep.last_metrics = json.loads(mbody.decode())
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass
+
+    # -- plumbing: async HTTP client + server ----------------------------
+
+    async def _http(self, rep, method, path, body, headers=None):
+        reader, writer = await asyncio.open_connection(rep.host,
+                                                       rep.port)
+        try:
+            blob = body if body is not None else b""
+            lines = ["%s %s HTTP/1.1" % (method, path),
+                     "Host: %s:%d" % (rep.host, rep.port),
+                     "Connection: close",
+                     "Content-Length: %d" % len(blob)]
+            if body is not None:
+                lines.append("Content-Type: application/json")
+            for k, v in (headers or {}).items():
+                lines.append("%s: %s" % (k, v))
+            writer.write(("\r\n".join(lines) + "\r\n\r\n").encode()
+                         + blob)
+            await writer.drain()
+            line = (await reader.readline()).decode("latin-1")
+            parts = line.split(None, 2)
+            if len(parts) < 2 or not parts[1].isdigit():
+                raise ConnectionError("bad status line %r" % line)
+            status = int(parts[1])
+            rheaders = {}
+            while True:
+                hline = await reader.readline()
+                if hline in (b"\r\n", b"\n", b""):
+                    break
+                key, _, val = hline.decode("latin-1").partition(":")
+                rheaders[key.strip().lower()] = val.strip()
+            length = rheaders.get("content-length")
+            if length is not None:
+                rbody = await reader.readexactly(int(length))
+            else:
+                rbody = await reader.read()
+            return status, rheaders, rbody
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    def _error(self, code, message, retry_after=None, **extra):
+        err = {"code": int(code), "message": str(message)}
+        err.update({k: v for k, v in extra.items() if v is not None})
+        headers = {"Content-Type": "application/json"}
+        if retry_after is not None:
+            headers["Retry-After"] = str(max(1, int(retry_after)))
+        return int(code), headers, json.dumps({"error": err}).encode()
+
+    async def _route(self, method, path, headers, body):
+        if method == "POST" and path == "/generate":
+            return await self._forward_generate(body, headers)
+        if method == "GET" and path == "/healthz":
+            state = await self._state()
+            ok = state["eligible"] > 0
+            return (200 if ok else 503,
+                    {"Content-Type": "application/json"},
+                    json.dumps({
+                        "status": "ok" if ok else "unavailable",
+                        "role": "router",
+                        "replicas": len(self._replicas),
+                        "eligible": state["eligible"]}).encode())
+        if method == "GET" and path == "/router/state":
+            return (200, {"Content-Type": "application/json"},
+                    json.dumps(await self._state(),
+                               default=str).encode())
+        if method == "GET" and path == "/metrics":
+            from veles_tpu.telemetry import metrics as registry
+            return (200, {"Content-Type":
+                          "text/plain; version=0.0.4; charset=utf-8"},
+                    registry.render_prometheus().encode())
+        return self._error(404, "no route %s %s" % (method, path))
+
+    async def _serve_conn(self, reader, writer):
+        try:
+            line = (await reader.readline()).decode("latin-1")
+            parts = line.split()
+            if len(parts) < 2:
+                return
+            method, target = parts[0].upper(), parts[1]
+            headers = {}
+            while True:
+                hline = await reader.readline()
+                if hline in (b"\r\n", b"\n", b""):
+                    break
+                key, _, val = hline.decode("latin-1").partition(":")
+                headers[key.strip().lower()] = val.strip()
+            length = int(headers.get("content-length", 0))
+            body = await reader.readexactly(length) if length \
+                else b""
+            path = target.split("?")[0].rstrip("/") or "/"
+            try:
+                status, rheaders, rbody = await self._route(
+                    method, path, headers, body)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # the router must outlive any bug
+                status, rheaders, rbody = self._error(
+                    500, "router error: %r" % (e,))
+            reason = {200: "OK", 202: "Accepted"}.get(status, "X")
+            out = ["HTTP/1.1 %d %s" % (status, reason),
+                   "Connection: close",
+                   "Content-Length: %d" % len(rbody)]
+            out += ["%s: %s" % (k, v) for k, v in rheaders.items()]
+            writer.write(("\r\n".join(out) + "\r\n\r\n").encode()
+                         + rbody)
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass  # client went away mid-request
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
