@@ -924,6 +924,221 @@ def bench_serving_sweep(dev):
     return out
 
 
+def _spec_trained_chain(dev, d_model, layers, heads, vocab, seq,
+                        batch, pattern, train_steps, name):
+    """A serving chain TRAINED to continue a cyclic token pattern —
+    the honest stand-in for repetitive traffic (an untrained
+    random-weight chain emits near-noise no proposer can draft;
+    a model that has learned its text is the regime speculative
+    decoding exists for)."""
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.models.evaluator import EvaluatorNextToken
+    from veles_tpu.models.gd import GradientDescent
+    from veles_tpu.models.standard import make_forwards
+
+    pat = numpy.asarray(pattern, numpy.int32)
+
+    class CyclicLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.default_rng(0)
+            n_train = batch * 8
+            self.class_lengths[:] = [0, 0, n_train]
+            tiled = numpy.tile(pat, seq // len(pat) + 2)
+            self.original_data = numpy.stack(
+                [tiled[o:o + seq]
+                 for o in rng.integers(0, len(pat), n_train)]
+            ).astype(numpy.int32)
+            self.original_labels = [0] * n_train
+
+    wf = AcceleratedWorkflow(None, name=name)
+    loader = CyclicLoader(wf, minibatch_size=batch,
+                          normalization_type="none")
+    loader.initialize(device=dev)
+    spec = [{"type": "embedding", "vocab": vocab, "dim": d_model}]
+    spec += [{"type": "transformer_block", "heads": heads,
+              "causal": True} for _ in range(layers)]
+    spec += [{"type": "token_logits", "vocab": vocab}]
+    fw = make_forwards(wf, loader.minibatch_data, spec)
+    for u in fw:
+        u.initialize(device=dev)
+    ev = EvaluatorNextToken(wf)
+    ev.output = fw[-1].output
+    ev.tokens = loader.minibatch_data
+    ev.loader = loader
+    ev.initialize(device=dev)
+    gd = GradientDescent(wf, forwards=fw, evaluator=ev,
+                         loader=loader, solver="sgd",
+                         learning_rate=0.05, gradient_moment=0.9)
+    gd.initialize(device=dev)
+    for _ in range(train_steps):
+        loader.run()
+        gd.run()
+    gd.loss.map_read()   # drain the dispatch queue
+    loader.stop()
+    return fw
+
+
+def bench_spec(dev):
+    """Speculative decoding + radix prefix cache (the PR-9 decode
+    subsystems):
+
+    - ``spec_decode_tokens_per_sec`` — batch-1 and 50%-occupancy
+      decode throughput on a REPETITIVE-text workload (a chain
+      briefly TRAINED to continue a cyclic pattern — see
+      ``_spec_trained_chain``) with spec decoding on (n-gram drafts
+      + one batched verify pass per iteration), vs ``spec_off``
+      measured identically — repetition is the regime the proposer
+      exists for (code, templates, copied prompts) and the streams
+      are bit-identical either way (tier-1 proves it);
+    - ``spec_accept_rate`` — drafts accepted / drafted during the
+      spec runs;
+    - ``prefix_warm_ttft_ms`` vs ``prefix_cold_ttft_ms`` — p95
+      submit-to-first-token of the SAME prompt cold (full prefill;
+      prefill executables pre-warmed so compile time is not
+      miscounted as prefill) and warm (radix-cache hit: only the
+      cold tail prefills);
+    - ``prefix_max_streams_warm`` vs ``_cold`` — concurrent streams
+      decoding a shared prompt for the same pool, warm admissions
+      claiming only cold blocks.
+
+    Sized down hard on CPU so driver runs stay fast."""
+    from veles_tpu.serving import InferenceScheduler
+
+    cpu = dev.jax_device.platform == "cpu"
+    if cpu:
+        d_model, layers, heads, vocab = 64, 2, 2, 256
+        window, block, steps, spec_k = 128, 16, 56, 8
+        batch, train_steps = 16, 30
+    else:
+        d_model, layers, heads, vocab = 1024, 8, 8, 32768
+        window, block, steps, spec_k = 1024, 16, 512, 8
+        batch, train_steps = 16, 60
+    rng = numpy.random.default_rng(0)
+    pattern = (numpy.arange(12) * 17 % vocab).tolist()
+    fw = _spec_trained_chain(dev, d_model, layers, heads, vocab,
+                             window, batch, pattern, train_steps,
+                             "bench-spec")
+    prompt = (pattern * 8)[:64]      # repetitive prompt
+
+    def decode_tps(spec, slots):
+        sch = InferenceScheduler(
+            fw, max_slots=slots, window=window,
+            max_queue=4 * slots, queue_timeout=600.0, kv="paged",
+            block_size=block, prefill_chunk=0, spec=spec,
+            spec_k=spec_k).start()
+        try:
+            sch.submit(prompt, steps, seed=0).result(600)  # warmup
+            best = 0.0
+            for _ in range(2):
+                t0 = time.perf_counter()
+                futs = [sch.submit(prompt, steps, seed=i)
+                        for i in range(slots)]
+                toks = sum(len(f.result(600)) - len(prompt)
+                           for f in futs)
+                best = max(best,
+                           toks / (time.perf_counter() - t0))
+            return round(best, 1), sch.metrics()["spec_accept_rate"]
+        finally:
+            sch.close()
+
+    out = {}
+    off1, _ = decode_tps(False, 1)
+    on1, rate1 = decode_tps(True, 1)
+    off4, _ = decode_tps(False, 4)
+    on4, rate4 = decode_tps(True, 4)
+    out["spec_decode_tokens_per_sec"] = {"batch1": on1, "occ_50": on4}
+    out["spec_off_decode_tokens_per_sec"] = {"batch1": off1,
+                                             "occ_50": off4}
+    out["spec_speedup_batch1"] = round(on1 / off1, 3) if off1 else None
+    out["spec_accept_rate"] = rate1
+    out["spec_accept_rate_occ_50"] = rate4
+
+    # -- warm-prefix TTFT + admission headroom -----------------------
+    # the prefix metrics don't involve the proposer, so they ride a
+    # WIDE (untrained) chain where prompt prefill actually dominates
+    # TTFT — that is the traffic the radix cache exists for
+    pwindow = 512 if cpu else window
+    pfw = _serving_chain(dev, d_model, layers, heads, vocab,
+                         pwindow, "bench-prefix")
+    p_len = 7 * pwindow // 8
+    long_p = rng.integers(0, vocab, (p_len,)).tolist()
+    other = rng.integers(0, vocab, (p_len,)).tolist()
+    sch = InferenceScheduler(
+        pfw, max_slots=4, window=pwindow, max_queue=64,
+        queue_timeout=600.0, kv="paged", block_size=block,
+        prefill_chunk=block * 2, prefix_cache=True).start()
+    try:
+        # pre-warm BOTH paths' executables on an unrelated prompt so
+        # neither probe counts a compile as prefill: once cold (the
+        # chunk ladder), once warm (the block gather + narrow chunk)
+        sch.submit(other, 1, seed=0).result(600)
+        sch.submit(other, 1, seed=0).result(600)
+
+        def p95(warm):
+            lat = []
+            for i in range(8):
+                t0 = time.perf_counter()
+                sch.submit(long_p, 1, seed=i).result(600)
+                lat.append((time.perf_counter() - t0) * 1e3)
+                if not warm:
+                    break       # only the FIRST submit is cold
+            lat.sort()
+            return lat[max(0, int(len(lat) * 0.95) - 1)]
+
+        cold = p95(False)       # seeds the trie
+        warm = p95(True)
+        out["prefix_cold_ttft_ms"] = round(cold, 2)
+        out["prefix_warm_ttft_ms"] = round(warm, 2)
+        out["prefix_warm_ttft_ratio"] = round(warm / cold, 3) \
+            if cold else None
+    finally:
+        sch.close()
+
+    # -- concurrent streams for the same pool, shared prompt ---------
+    shared = rng.integers(0, vocab, (4 * block,)).tolist()
+    per_req = -(-(len(shared) + block) // block)     # cold budget
+    pool = 4 * per_req                               # 4 cold streams
+
+    def peak_streams(prefix):
+        cap = 4 * per_req if prefix else 4
+        sch = InferenceScheduler(
+            fw, max_slots=min(64, pool), window=window,
+            max_queue=256, queue_timeout=600.0, kv="paged",
+            block_size=block, kv_blocks=pool,
+            prefill_chunk=block * 2, prefix_cache=prefix,
+            shed_block_factor=0,    # the queue IS the experiment
+            warm_buckets=False).start()
+        try:
+            if prefix:          # seed the trie, then measure warm
+                sch.submit(shared, block, seed=0).result(600)
+            futs = [sch.submit(shared, block, seed=i)
+                    for i in range(cap)]
+            peak = 0
+            while any(not f.done() for f in futs):
+                peak = max(peak, sch.metrics()["active_slots"])
+                time.sleep(0.005)
+            for f in futs:
+                f.result(600)
+            return peak
+        finally:
+            sch.close()
+
+    out["prefix_max_streams_cold"] = peak_streams(False)
+    out["prefix_max_streams_warm"] = peak_streams(True)
+    out["spec_config"] = {
+        "d_model": d_model, "layers": layers, "heads": heads,
+        "vocab": vocab, "window": window, "block_size": block,
+        "steps": steps, "spec_k": spec_k, "prompt": len(prompt),
+        "train_steps": train_steps,
+        "prefix_window": pwindow, "prefix_prompt": len(long_p),
+        "streams_pool_blocks": pool,
+        "workload": "chain trained on a cyclic 12-token pattern "
+                    "(repetitive text) for spec; identical "
+                    "resubmits on a wide chain for prefix"}
+    return out
+
+
 def bench_router(dev, replica_counts=(1, 2, 4),
                  requests_per_client=4):
     """Fleet scaling through the HTTP router (``serving/router.py``
@@ -1232,6 +1447,10 @@ def main():
     except Exception as e:
         serving_sweep = {"serving_sweep_error": repr(e)[:300]}
     try:
+        spec_rec = bench_spec(dev)
+    except Exception as e:    # same guard as the other serving entries
+        spec_rec = {"spec_error": repr(e)[:300]}
+    try:
         router_rec = bench_router(dev)
     except Exception as e:     # fleet bench must not sink the run
         router_rec = {"router_error": repr(e)[:300]}
@@ -1278,6 +1497,7 @@ def main():
     record.update(decode)
     record.update(serving)
     record.update(serving_sweep)
+    record.update(spec_rec)
     record.update(router_rec)
     record.update(input_pipe)
     record.update(allreduce)
@@ -1339,6 +1559,12 @@ def main():
         "serving_slot_occupancy", "serving_ttft_p95_ms_mixed",
         "serving_ttft_p95_ms_oneshot", "serving_max_streams_dense",
         "serving_max_streams_paged",
+        "spec_decode_tokens_per_sec",
+        "spec_off_decode_tokens_per_sec", "spec_speedup_batch1",
+        "spec_accept_rate", "prefix_warm_ttft_ms",
+        "prefix_cold_ttft_ms", "prefix_warm_ttft_ratio",
+        "prefix_max_streams_warm", "prefix_max_streams_cold",
+        "spec_error",
         "router_aggregate_tokens_per_sec", "router_ttft_p95_ms",
         "router_scaling_2x", "router_cores", "router_error",
         "input_pipeline_speedup",
@@ -1355,12 +1581,12 @@ def main():
     return 0
 
 
-def main_router():
-    """``python bench.py router`` — run ONLY the fleet-router bench
-    and merge its keys into the existing BENCH.json (the PR5
-    precedent: a standalone subsystem run, other entries carried)."""
+def _main_standalone(bench_fn, source_key, source_note):
+    """Run ONE subsystem bench and merge its keys into the existing
+    BENCH.json (the PR5 precedent: a standalone subsystem run, other
+    entries carried)."""
     from veles_tpu.backends import Device
-    rec = bench_router(Device())
+    rec = bench_fn(Device())
     record = {}
     try:
         with open("BENCH.json") as f:
@@ -1368,8 +1594,7 @@ def main_router():
     except (OSError, ValueError):
         pass
     record.update(rec)
-    record["router_bench_source"] = \
-        "PR8 standalone router bench run; non-router entries carried"
+    record[source_key] = source_note
     with open("BENCH.json", "w") as f:
         json.dump(record, f, indent=1, sort_keys=True)
         f.write("\n")
@@ -1377,5 +1602,21 @@ def main_router():
     return 0
 
 
+def main_router():
+    """``python bench.py router`` — the fleet-router bench alone."""
+    return _main_standalone(
+        bench_router, "router_bench_source",
+        "PR8 standalone router bench run; non-router entries carried")
+
+
+def main_spec():
+    """``python bench.py spec`` — the speculative-decoding +
+    prefix-cache bench alone."""
+    return _main_standalone(
+        bench_spec, "spec_bench_source",
+        "PR9 standalone spec/prefix bench run; other entries carried")
+
+
 if __name__ == "__main__":
-    sys.exit(main_router() if "router" in sys.argv[1:] else main())
+    sys.exit(main_router() if "router" in sys.argv[1:]
+             else main_spec() if "spec" in sys.argv[1:] else main())

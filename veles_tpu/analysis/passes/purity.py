@@ -32,11 +32,13 @@ from veles_tpu.analysis.core import (
 REQUIRED_REGISTRATIONS = (
     ("serving/engine.py", "serving.slot_step"),
     ("serving/engine.py", "serving.paged_step"),
+    ("serving/engine.py", "serving.verify_step"),
     ("serving/engine.py", "serving.sample_first"),
     ("serving/prefill.py", "serving.prefill"),
     ("serving/prefill.py", "serving.prefill_chunk"),
     ("serving/kv_slots.py", "serving.kv_insert_row"),
     ("serving/kv_slots.py", "serving.kv_insert_blocks"),
+    ("serving/kv_slots.py", "serving.kv_gather_blocks"),
 )
 
 def _is_trackjit_name(name):

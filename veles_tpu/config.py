@@ -234,7 +234,14 @@ root.common.update({
     # the stuck-decode-loop detector threshold in seconds (0 disables
     # — keep it far above the worst first-compile stall);
     # shed_block_factor sheds new submits (503) once the queue's
-    # committed block budget exceeds factor x kv_blocks (0 disables)
+    # committed block budget exceeds factor x kv_blocks (0 disables);
+    # spec enables speculative decoding (n-gram prompt-lookup drafts
+    # + one batched verify pass; spec_k tokens drafted per slot,
+    # output streams bit-identical to spec-off); prefix_cache enables
+    # the cross-request radix prefix cache over the paged block pools
+    # (warm prompts skip prefill for resident leading blocks) with
+    # prefix_evict allowing LRU eviction of refcount-0 resident
+    # blocks under admission pressure
     "serving": {
         "kv": "paged",
         "block_size": 16,
@@ -244,6 +251,10 @@ root.common.update({
         "request_timeout": 120.0,
         "watchdog": 300.0,
         "shed_block_factor": 4.0,
+        "spec": False,
+        "spec_k": 4,
+        "prefix_cache": False,
+        "prefix_evict": True,
     },
     # fault injection (veles_tpu/faults/): spec string parsed on first
     # fire(), same grammar as the VELES_FAULTS env var —

@@ -118,6 +118,25 @@ class Embedding(ForwardBase):
             y = y + rows[:, None, :]
         return y
 
+    def apply_verify_slots(self, params, x, pos):
+        """Speculative-verify lookup: x [batch, K1] token ids where
+        row n's position j sits at sequence index ``pos[n] + j``
+        ([batch] ints, traced).  Positional rows are gathered per
+        index with clamping — bucket-padding positions past the
+        learned table read a (masked-off) clamped row, matching
+        :meth:`apply_chunk`'s convention."""
+        from veles_tpu import dtypes
+        cd = dtypes.compute_dtype()
+        y = jnp.take(params["weights"].astype(cd),
+                     x.astype(jnp.int32), axis=0)
+        if self.learned_positions:
+            idx = jnp.clip(
+                pos[:, None] + jnp.arange(x.shape[1])[None, :], 0,
+                params["positions"].shape[0] - 1)
+            y = y + jnp.take(params["positions"].astype(cd), idx,
+                             axis=0)
+        return y
+
     def export_config(self):
         return {"vocab": self.vocab, "dim": self.dim,
                 "learned_positions": self.learned_positions}

@@ -307,6 +307,23 @@ class TransformerBlock(ForwardBase):
             self.heads)
         return self._attn_tail(params, x, o), {"k": pk, "v": pv}
 
+    def apply_verify_paged(self, params, x, pos, lens, tables, pool):
+        """Speculative-decoding VERIFY step: score a width-K1 token
+        run per row — x [batch, K1, d], row n's position j at
+        sequence index ``pos[n] + j``, ``lens`` [batch] marking how
+        many positions are real (padding scatters to the trash
+        block) — against the paged pool in ONE pass.  Position-for-
+        position the same math as :meth:`apply_step_paged` (its
+        K1 = 1 special case), so accepting the matched prefix of the
+        scored run reproduces sequential decode exactly."""
+        from veles_tpu.ops.paged_attention import \
+            paged_verify_attention
+        q, k_new, v_new = self._qkv(params, x)
+        pk, pv, o = paged_verify_attention(
+            q, k_new, v_new, pool["k"], pool["v"], tables, pos, lens,
+            self.heads)
+        return self._attn_tail(params, x, o), {"k": pk, "v": pv}
+
     def apply_step_slots(self, params, x, pos, cache):
         """Decode ONE position PER ROW: x [batch, 1, d] where row n
         sits at ITS OWN sequence index ``pos[n]`` ([batch] ints,

@@ -26,7 +26,10 @@ The math is row-for-row the dense per-slot step
 (``TransformerBlock.apply_step_slots``) restricted to the gathered
 key range — same projection dtypes, 1/sqrt(hd) scale and softmax
 conventions — so greedy token streams are identical to the dense slot
-cache (tested in tests/test_serving.py).  This jnp formulation lowers
+cache (tested in tests/test_serving.py).  The width-K cousin
+:func:`paged_verify_attention` scores a run of K1 consecutive tokens
+per row in one pass — the speculative-decoding verify step
+(tests/test_spec.py proves spec-on/spec-off token parity).  This jnp formulation lowers
 to a gather + batched GEMM on every backend; a fused pallas kernel
 (keeping the gathered blocks in VMEM) would slot in behind the same
 signature, the way ``ops/flash.py`` fronts the training attention.
@@ -34,6 +37,59 @@ signature, the way ``ops/flash.py`` fronts the training attention.
 
 import jax
 import jax.numpy as jnp
+
+
+def paged_verify_attention(q, k_new, v_new, pool_k, pool_v, tables,
+                           pos, lens, heads):
+    """Score a WIDTH-K token run per row against a paged KV pool —
+    the speculative-decoding verify kernel (one model pass scores a
+    request's pending token plus its k drafted tokens).
+
+    ``q``/``k_new``/``v_new`` [B, K1, d] — projections of the run,
+    row n's position j sitting at sequence index ``pos[n] + j``;
+    ``lens`` [B] ints (traced) — how many of the K1 positions are
+    REAL for each row (1 = plain decode, k_eff + 1 for a row with
+    k_eff drafts).  K/V of positions past ``lens[n]`` scatter into
+    the reserved trash block (id 0) instead of the table, so bucket
+    padding never corrupts a live block; their output rows are
+    garbage the caller must not read.
+
+    Position-for-position the same math as
+    :func:`paged_decode_attention` (which is the K1 = 1, lens = 1
+    special case): scatter first, then gather the table's blocks,
+    causal mask ``key ≤ pos[n] + j`` per query.  Because the scatter
+    lands before the gather, a query at position p sees the drafts
+    at positions ≤ p written THIS pass — exactly the cache state a
+    sequential per-token decode of those tokens would have produced.
+
+    Returns ``(pool_k', pool_v', context)`` with context [B, K1, d]."""
+    from veles_tpu import dtypes
+    cd = dtypes.compute_dtype()
+    b, k1, d = q.shape
+    h = heads
+    hd = d // h
+    bs = pool_k.shape[1]
+    qpos = pos[:, None] + jnp.arange(k1)[None, :]          # [B, K1]
+    valid = jnp.arange(k1)[None, :] < lens[:, None]        # [B, K1]
+    blk = jnp.take_along_axis(tables, qpos // bs, axis=1)
+    blk = jnp.where(valid, blk, 0)                         # pad -> trash
+    off = jnp.where(valid, qpos % bs, 0)
+    pk = pool_k.at[blk, off].set(k_new.astype(pool_k.dtype))
+    pv = pool_v.at[blk, off].set(v_new.astype(pool_v.dtype))
+    kg = pk[tables]
+    vg = pv[tables]
+    length = kg.shape[1] * bs
+    qh = q.reshape(b, k1, h, hd)
+    kh = kg.astype(cd).reshape(b, length, h, hd)
+    vh = vg.astype(cd).reshape(b, length, h, hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) \
+        * (1.0 / jnp.sqrt(hd))
+    mask = (jnp.arange(length)[None, None, :]
+            <= qpos[:, :, None])[:, None]
+    logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return pk, pv, jnp.einsum("bhqk,bkhd->bqhd", probs,
+                              vh).reshape(b, k1, d)
 
 
 def paged_decode_attention(q, k_new, v_new, pool_k, pool_v, tables,

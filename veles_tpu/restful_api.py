@@ -89,8 +89,9 @@ class RESTfulAPI(Unit):
                  max_slots=4, serving_window=None, max_queue=32,
                  max_steps=None, max_batch=None, serving_kv=None,
                  serving_block_size=None, serving_kv_blocks=None,
-                 serving_prefill_chunk=None, replica_id=None,
-                 **kwargs):
+                 serving_prefill_chunk=None, serving_spec=None,
+                 serving_spec_k=None, serving_prefix_cache=None,
+                 replica_id=None, **kwargs):
         super(RESTfulAPI, self).__init__(workflow, **kwargs)
         self.loader = loader
         #: fleet identity: every reply carries it as X-Veles-Replica
@@ -120,6 +121,11 @@ class RESTfulAPI(Unit):
         self.serving_block_size = serving_block_size
         self.serving_kv_blocks = serving_kv_blocks
         self.serving_prefill_chunk = serving_prefill_chunk
+        #: speculative decoding / radix prefix cache (None defers to
+        #: ``root.common.serving.{spec,spec_k,prefix_cache}``)
+        self.serving_spec = serving_spec
+        self.serving_spec_k = serving_spec_k
+        self.serving_prefix_cache = serving_prefix_cache
         #: /generate resource caps — an unbounded request would pay a
         #: giant alloc + a multi-second compile before failing; None
         #: defers to root.common.api.{max_steps,max_batch}
@@ -250,7 +256,10 @@ class RESTfulAPI(Unit):
                     kv=self.serving_kv,
                     block_size=self.serving_block_size,
                     kv_blocks=self.serving_kv_blocks,
-                    prefill_chunk=self.serving_prefill_chunk).start()
+                    prefill_chunk=self.serving_prefill_chunk,
+                    spec=self.serving_spec,
+                    spec_k=self.serving_spec_k,
+                    prefix_cache=self.serving_prefix_cache).start()
                 self.info(
                     "serving scheduler: %d slots, window %d, "
                     "queue cap %d, kv=%s (block %d), prefill "

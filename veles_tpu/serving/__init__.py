@@ -40,13 +40,28 @@ per-token scan.  Here:
 - :mod:`veles_tpu.serving.fleet` — replica supervision: spawn N
   replicas (in-process or subprocess handles), respawn the dead, and
   orchestrate zero-downtime rolling restarts (drain → restart →
-  re-admit) through the router.
+  re-admit) through the router;
+- :mod:`veles_tpu.serving.spec` — speculative decoding: the n-gram
+  prompt-lookup draft proposer whose k drafts the batched verify
+  step (``engine.verify_step_paged``) scores in ONE model pass —
+  accepted prefixes are pure latency win, output streams stay
+  bit-identical to spec-off decoding;
+- :mod:`veles_tpu.serving.prefix_cache` — the cross-request radix
+  prefix cache (SGLang lineage) over the paged block pools: finished
+  requests donate their KV blocks, warm prompts skip prefill for
+  every resident leading block and claim only their cold tail's
+  budget.
 """
 
 from veles_tpu.serving.engine import (  # noqa: F401
-    paged_decode_step, slot_decode_step)
+    paged_decode_step, slot_decode_step, verify_step_paged,
+    verify_supported)
 from veles_tpu.serving.kv_slots import (  # noqa: F401
     PagedKVCache, SlotKVCache, paged_supported)
+from veles_tpu.serving.prefix_cache import (  # noqa: F401
+    RadixPrefixCache)
+from veles_tpu.serving.spec import (  # noqa: F401
+    NgramProposer, accept_drafts)
 from veles_tpu.serving.metrics import (  # noqa: F401
     RouterMetrics, ServingMetrics)
 from veles_tpu.serving.prefill import (  # noqa: F401

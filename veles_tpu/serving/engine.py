@@ -106,6 +106,7 @@ def clear_step_cache():
     ``generate.clear_decode_caches``)."""
     _step_cached.cache_clear()
     _paged_step_cached.cache_clear()
+    _verify_step_cached.cache_clear()
 
 
 def slot_decode_step(forwards, cache, toks, pos, temps, topks, seeds,
@@ -192,6 +193,104 @@ def paged_decode_step(forwards, cache, toks, pos, tables, temps,
         jnp.asarray(seeds, jnp.uint32),
         jnp.asarray(counts, jnp.int32), cache.pools)
     return nxt
+
+
+def _make_verify_step(forwards):
+    cacheable = frozenset(i for i, u in enumerate(forwards)
+                          if hasattr(u, "init_cache"))
+
+    def step(params, toks, pos, lens, tables, temps, topks, seeds,
+             counts, pools):
+        h = toks
+        out = dict(pools)
+        for i, u in enumerate(forwards):
+            if i in cacheable:
+                h, out[i] = u.apply_verify_paged(
+                    params[i], h, pos, lens, tables, pools[i])
+            elif hasattr(u, "apply_verify_slots"):
+                h = u.apply_verify_slots(params[i], h, pos)
+            else:
+                h = u.apply(params[i], h)
+        b, k1, v = h.shape
+        logits = h.astype(jnp.float32).reshape(b * k1, v)
+        # position j of row n draws stream token counts[n] + j — the
+        # EXACT key a sequential decode of the accepted prefix would
+        # fold, which is what makes acceptance distribution-exact
+        keys = jax.vmap(
+            lambda s, c: jax.vmap(
+                lambda j: jax.random.fold_in(jax.random.key(s),
+                                             c + j))(jnp.arange(k1)))(
+            seeds, counts)
+        nxt = sample_slots(logits, jnp.repeat(temps, k1),
+                           jnp.repeat(topks, k1),
+                           keys.reshape(b * k1))
+        return nxt.reshape(b, k1), out
+    return step
+
+
+@functools.lru_cache(maxsize=64)
+def _verify_step_cached(cache_key, closure):
+    return track_jit("serving.verify_step", jax.jit(closure.fn))
+
+
+def verify_step_paged(forwards, cache, toks, pos, lens, tables,
+                      temps, topks, seeds, counts):
+    """Score a PACKED batch of speculative token runs in ONE model
+    pass against ``cache`` (:class:`serving.kv_slots.PagedKVCache`,
+    updated in place) — the batched verify step of speculative
+    decoding.
+
+    ``toks`` [B, K1] — row n's pending token followed by its drafted
+    tokens (padded past ``lens[n]``); ``pos`` [B] — the sequence
+    index of each row's pending token; ``lens`` [B] — real positions
+    per row (1 = no drafts, i.e. a plain decode step riding the
+    verify batch); ``tables``/``temps``/``topks``/``seeds`` as in
+    :func:`paged_decode_step`; ``counts`` [B] — the draw counter of
+    the FIRST sampled token (position j draws ``counts + j``).
+
+    Returns [B, K1] next tokens: entry (n, j) is the token a
+    sequential decode would emit after row n's context extended by
+    its first j drafted tokens — the host accepts the longest prefix
+    where draft j matches sample j-1 (plus the first non-matching
+    sample, the "free" correction token), which reproduces the
+    spec-off stream bit-for-bit for greedy AND per-seed sampling."""
+    from veles_tpu import dtypes
+    params = _device_params(forwards)
+    tables = jnp.asarray(tables, jnp.int32)
+    toks = jnp.asarray(toks, jnp.int32)
+    b, t = tables.shape
+    k1 = toks.shape[1]
+    cache_key = (_arch_sig(forwards), b, k1, t, cache.block_size,
+                 cache.capacity_blocks,
+                 str(dtypes.compute_dtype()),
+                 str(dtypes.matmul_precision()))
+    fn = _verify_step_cached(cache_key,
+                             _StepClosure(_make_verify_step(forwards)))
+    nxt, cache.pools = fn(
+        params, toks, jnp.asarray(pos, jnp.int32),
+        jnp.asarray(lens, jnp.int32), tables,
+        jnp.asarray(temps, jnp.float32),
+        jnp.asarray(topks, jnp.int32),
+        jnp.asarray(seeds, jnp.uint32),
+        jnp.asarray(counts, jnp.int32), cache.pools)
+    return nxt
+
+
+def verify_supported(forwards):
+    """True when every cacheable block speaks the paged verify step
+    (``apply_verify_paged``) and every other sequence-positioned unit
+    can place a width-k run (``apply_verify_slots`` or position-
+    wise) — the gate speculative decoding checks before enabling."""
+    has = False
+    for u in forwards:
+        if hasattr(u, "init_cache"):
+            has = True
+            if not hasattr(u, "apply_verify_paged"):
+                return False
+        elif hasattr(u, "apply_step_slots") \
+                and not hasattr(u, "apply_verify_slots"):
+            return False
+    return has
 
 
 def first_tokens(last_logits, temps, topks, seeds, counts=None):

@@ -30,6 +30,8 @@ All methods must be called from ONE thread (the scheduler's decode
 loop) — the arrays are plain jax values, swapped functionally.
 """
 
+import functools
+
 import numpy
 
 import jax
@@ -54,20 +56,45 @@ _insert_row_pair = track_jit("serving.kv_insert_row",
                              jax.jit(_row_pair))
 
 
-def _block_pair(pool_k, pool_v, src_k, src_v, ids):
+def _block_pair(pool_k, pool_v, src_k, src_v, ids, start):
     # batched block copy, K and V in ONE dispatch: src [1, W, d]
-    # staging rows -> the table's physical blocks (W and the block
-    # count are static through the shapes; one executable per bucket)
+    # staging rows [start, start + n·bs) -> the table's physical
+    # blocks (W and the block count are static through the shapes;
+    # one executable per bucket; start rides traced so warm-prefix
+    # inserts — which skip the shared blocks — share it too)
     n = ids.shape[0]
     bs = pool_k.shape[1]
-    sk = src_k[0, :n * bs].reshape(n, bs, -1)
-    sv = src_v[0, :n * bs].reshape(n, bs, -1)
+    d = src_k.shape[-1]
+    sk = jax.lax.dynamic_slice(
+        src_k, (jnp.int32(0), start, jnp.int32(0)),
+        (1, n * bs, d))[0].reshape(n, bs, -1)
+    sv = jax.lax.dynamic_slice(
+        src_v, (jnp.int32(0), start, jnp.int32(0)),
+        (1, n * bs, d))[0].reshape(n, bs, -1)
     return (pool_k.at[ids].set(sk.astype(pool_k.dtype)),
             pool_v.at[ids].set(sv.astype(pool_v.dtype)))
 
 
 _insert_blocks = track_jit("serving.kv_insert_blocks",
                            jax.jit(_block_pair))
+
+
+@functools.lru_cache(maxsize=1)
+def _gather_blocks_jit():
+    # built lazily (no module-level executable ref): the prefix-cache
+    # warm path copies a matched prefix's pool blocks into a staging
+    # row so the cold-tail chunked prefill attends over them — the
+    # reverse of _block_pair, K and V in ONE dispatch
+    def pair(pool_k, pool_v, dst_k, dst_v, ids):
+        n = ids.shape[0]
+        bs = pool_k.shape[1]
+        sk = pool_k[ids].reshape(1, n * bs, -1)
+        sv = pool_v[ids].reshape(1, n * bs, -1)
+        return (jax.lax.dynamic_update_slice(
+                    dst_k, sk.astype(dst_k.dtype), (0, 0, 0)),
+                jax.lax.dynamic_update_slice(
+                    dst_v, sv.astype(dst_v.dtype), (0, 0, 0)))
+    return track_jit("serving.kv_gather_blocks", jax.jit(pair))
 
 
 def _insert_layer(layer, src, fn, *args):
@@ -195,6 +222,11 @@ class PagedKVCache:
         self.tables = numpy.zeros(
             (self.max_slots, self.blocks_per_slot), numpy.int32)
         self.n_blocks = numpy.zeros((self.max_slots,), numpy.int32)
+        #: leading SHARED blocks per slot (prefix-cache residents the
+        #: slot reads but does not own — release hands them back to
+        #: the caller instead of the free list; decode never writes
+        #: them because the cold offset starts past the shared range)
+        self.n_shared = numpy.zeros((self.max_slots,), numpy.int32)
 
     # -- occupancy reads ------------------------------------------------
 
@@ -225,45 +257,94 @@ class PagedKVCache:
         return bool(self._free_slots) \
             and self.blocks_needed(total_tokens) <= len(self._free_blocks)
 
-    def alloc(self, total_tokens):
+    def alloc(self, total_tokens, shared=()):
         """Claim a slot and its full block budget, or None when slots
-        or blocks are exhausted."""
+        or blocks are exhausted.  ``shared`` — block ids of an
+        already-resident prompt prefix (prefix-cache hit): they head
+        the table READ-ONLY and only ``need - len(shared)`` NEW
+        blocks are claimed, which is how a warm prompt raises the
+        concurrent-stream ceiling."""
         need = self.blocks_needed(total_tokens)
+        shared = [int(b) for b in shared]
         if need > self.blocks_per_slot:
             raise ValueError(
                 "request of %d tokens needs %d blocks > %d per-slot "
                 "table width" % (total_tokens, need,
                                  self.blocks_per_slot))
-        if not self._free_slots or need > len(self._free_blocks):
+        if len(shared) >= need:
+            raise ValueError(
+                "shared prefix of %d blocks must leave at least one "
+                "private block of the %d-block budget"
+                % (len(shared), need))
+        if not self._free_slots \
+                or need - len(shared) > len(self._free_blocks):
             return None
         slot = self._free_slots.pop()
-        ids = [self._free_blocks.pop() for _ in range(need)]
+        ids = shared + [self._free_blocks.pop()
+                        for _ in range(need - len(shared))]
         self.tables[slot, :need] = ids
         self.tables[slot, need:] = 0
         self.n_blocks[slot] = need
+        self.n_shared[slot] = len(shared)
         return slot
 
-    def release(self, slot):
+    def release(self, slot, donate=0):
+        """Free a slot.  The leading shared blocks are handed BACK
+        (never freed — the prefix cache still owns them); the next
+        ``donate`` private blocks transfer ownership to the caller
+        (a finishing request donating its prompt+generated prefix to
+        the cache); the rest return to the free list.  Returns
+        ``(shared_ids, donated_ids)``."""
         slot = int(slot)
         if slot in self._free_slots:
             raise ValueError("slot %d double-freed" % slot)
         n = int(self.n_blocks[slot])
-        self._free_blocks.extend(int(b) for b in
-                                 self.tables[slot, :n][::-1])
+        ns = int(self.n_shared[slot])
+        donate = int(donate)
+        if donate < 0 or ns + donate > n:
+            raise ValueError(
+                "donate=%d outside slot %d's %d private blocks"
+                % (donate, slot, n - ns))
+        row = [int(b) for b in self.tables[slot, :n]]
+        shared, donated = row[:ns], row[ns:ns + donate]
+        self._free_blocks.extend(reversed(row[ns + donate:]))
         self.tables[slot, :] = 0
         self.n_blocks[slot] = 0
+        self.n_shared[slot] = 0
         self._free_slots.append(slot)
+        return shared, donated
 
-    def check(self):
+    def reclaim(self, ids):
+        """Return blocks whose ownership left the slot machinery
+        (prefix-cache evictions, duplicate donations) to the free
+        list."""
+        for b in ids:
+            b = int(b)
+            if b < 1 or b > self.capacity_blocks:
+                raise ValueError("reclaim of invalid block %d" % b)
+            if b in self._free_blocks:
+                raise ValueError("block %d double-freed" % b)
+            self._free_blocks.append(b)
+
+    def check(self, resident=()):
         """Invariant sweep (tests): every block is exactly one of
-        {trash, free, owned-by-one-slot}."""
+        {trash, free, resident-in-the-prefix-cache,
+        privately-owned-by-one-slot}, and every slot's SHARED prefix
+        blocks appear in ``resident`` (they are counted once, as the
+        cache's)."""
+        resident = set(int(b) for b in resident)
         live = []
         for slot in range(self.max_slots):
             if slot not in self._free_slots:
-                live.extend(int(b)
-                            for b in self.tables[slot,
-                                                 :self.n_blocks[slot]])
-        owned = live + [int(b) for b in self._free_blocks]
+                ns = int(self.n_shared[slot])
+                row = [int(b) for b in
+                       self.tables[slot, :self.n_blocks[slot]]]
+                assert set(row[:ns]) <= resident, \
+                    "slot %d shares non-resident blocks %s" \
+                    % (slot, sorted(set(row[:ns]) - resident))
+                live.extend(row[ns:])
+        owned = live + [int(b) for b in self._free_blocks] \
+            + sorted(resident)
         assert 0 not in owned, "trash block leaked into circulation"
         assert len(owned) == len(set(owned)), "block double-owned"
         assert len(owned) == self.capacity_blocks, \
@@ -275,16 +356,26 @@ class PagedKVCache:
         compiled paged step gathers through."""
         return self.tables[numpy.asarray(slots, numpy.intp), :width]
 
-    def insert(self, slot, row_caches, length):
+    def insert(self, slot, row_caches, length, from_block=0):
         """Block-scatter a prefilled batch-1 staging row (width a
         multiple of block_size, rows ≥ length zeroed) into ``slot``'s
-        first ``ceil(length / block_size)`` table blocks."""
+        table blocks ``[from_block, ceil(length / block_size))``.
+        ``from_block`` skips a warm shared prefix: those staging rows
+        were GATHERED from the resident blocks (:meth:`load_staging`)
+        and must not be written back through the shared table
+        entries."""
         need = self.blocks_needed(length)
+        f = int(from_block)
         if need > int(self.n_blocks[slot]):
             raise ValueError(
                 "insert of %d tokens exceeds slot %d's %d-block "
                 "budget" % (length, slot, int(self.n_blocks[slot])))
-        ids = jnp.asarray(self.tables[slot, :need])
+        if f >= need:
+            raise ValueError(
+                "from_block %d leaves nothing of the %d-block insert"
+                % (f, need))
+        ids = jnp.asarray(self.tables[slot, f:need])
+        start = jnp.int32(f * self.block_size)
         for i, layer in self.pools.items():
             src = row_caches[i]
             wk = next(iter(src.values())).shape[1]
@@ -293,4 +384,31 @@ class PagedKVCache:
                     "staging width %d < %d blocks x %d" %
                     (wk, need, self.block_size))
             self.pools[i] = _insert_layer(layer, src, _insert_blocks,
-                                          ids)
+                                          ids, start)
+
+    def load_staging(self, row_caches, ids):
+        """Copy resident blocks ``ids`` (a matched prompt prefix)
+        into the FRONT of a batch-1 staging row — the warm half of a
+        prefix-cache admission: the cold tail's chunked prefill then
+        attends over these rows exactly as if it had prefilled them
+        itself (the resident K/V was produced by the identical
+        computation).  Returns the updated staging dict."""
+        if not len(ids):
+            return row_caches
+        ids = jnp.asarray(numpy.asarray(ids, numpy.int32))
+        fn = _gather_blocks_jit()
+        out = {}
+        for i, layer in self.pools.items():
+            src = row_caches[i]
+            if set(layer) == {"k", "v"}:
+                k, v = fn(layer["k"], layer["v"], src["k"], src["v"],
+                          ids)
+                out[i] = {"k": k, "v": v}
+            else:  # exotic cache pytrees: per-name, pairing each
+                # tensor with itself (same fallback as _insert_layer)
+                got = {}
+                for name in src:
+                    got[name], _ = fn(layer[name], layer[name],
+                                      src[name], src[name], ids)
+                out[i] = got
+        return out

@@ -101,6 +101,40 @@ def _registry_series():
         "drains": metrics.counter(
             "veles_serving_drains_total",
             "graceful-drain requests accepted (admission closed)"),
+        "spec_drafted": metrics.counter(
+            "veles_serving_spec_drafted_tokens_total",
+            "tokens drafted by the speculative proposer (n-gram "
+            "prompt lookup) and scored by the batched verify step"),
+        "spec_accepted": metrics.counter(
+            "veles_serving_spec_accepted_tokens_total",
+            "drafted tokens the verify step accepted — each one a "
+            "model pass the request did not pay"),
+        "spec_rollback": metrics.counter(
+            "veles_serving_spec_rollback_tokens_total",
+            "drafted tokens rejected at verify (their KV rows are "
+            "logically rolled back: masked until overwritten)"),
+        "prefix_hits": metrics.counter(
+            "veles_serving_prefix_hits_total",
+            "admissions whose prompt prefix was resident in the "
+            "radix cache (warm: only the cold tail prefilled)"),
+        "prefix_misses": metrics.counter(
+            "veles_serving_prefix_misses_total",
+            "admissions with no resident prefix (fully cold)"),
+        "prefix_hit_tokens": metrics.counter(
+            "veles_serving_prefix_hit_tokens_total",
+            "prompt tokens served from resident KV blocks instead "
+            "of prefill compute"),
+        "prefix_evictions": metrics.counter(
+            "veles_serving_prefix_evicted_blocks_total",
+            "resident refcount-0 blocks evicted (LRU) under "
+            "admission pressure"),
+        "prefix_resident": metrics.gauge(
+            "veles_serving_prefix_blocks_resident",
+            "KV blocks currently owned by the radix prefix cache"),
+        "prefix_shared": metrics.gauge(
+            "veles_serving_prefix_blocks_shared",
+            "resident blocks currently pinned by at least one "
+            "in-flight request"),
     }
 
 
@@ -266,6 +300,9 @@ class ServingMetrics:
         self.preempts = 0
         self.preempt_resumes = 0
         self.watchdog_trips = 0
+        self.spec_drafted_tokens = 0    # proposer output, cumulative
+        self.spec_accepted_tokens = 0   # drafts kept at verify
+        self.spec_rollback_tokens = 0   # drafts rejected at verify
         # instance-lifetime latency histograms (the shared telemetry
         # type: bounded reservoir + bucket counts), window = `recent`
         self._ttft = Histogram("ttft_ms", buckets=MS_BUCKETS,
@@ -344,6 +381,36 @@ class ServingMetrics:
         self._global["drains"].inc()
         events.record("serving.drain", "single",
                       cls="InferenceScheduler")
+
+    def record_spec(self, drafted, accepted):
+        """One slot's verify outcome: ``drafted`` tokens proposed,
+        ``accepted`` of them kept (the correction token is free and
+        not counted either way)."""
+        drafted, accepted = int(drafted), int(accepted)
+        with self._lock:
+            self.spec_drafted_tokens += drafted
+            self.spec_accepted_tokens += accepted
+            self.spec_rollback_tokens += drafted - accepted
+        self._global["spec_drafted"].inc(drafted)
+        self._global["spec_accepted"].inc(accepted)
+        self._global["spec_rollback"].inc(drafted - accepted)
+
+    def record_prefix_lookup(self, matched_blocks, block_size):
+        """One admission's radix-cache lookup: a hit when >= 1
+        leading block was resident."""
+        if matched_blocks > 0:
+            self._global["prefix_hits"].inc()
+            self._global["prefix_hit_tokens"].inc(
+                int(matched_blocks) * int(block_size))
+        else:
+            self._global["prefix_misses"].inc()
+
+    def record_prefix_evict(self, blocks):
+        self._global["prefix_evictions"].inc(int(blocks))
+
+    def set_prefix_blocks(self, resident, shared):
+        self._global["prefix_resident"].set(int(resident))
+        self._global["prefix_shared"].set(int(shared))
 
     def record_first_token(self, ttft_ms, queued_ms):
         self._ttft.observe(ttft_ms)
@@ -425,6 +492,13 @@ class ServingMetrics:
                 "preempts": self.preempts,
                 "preempt_resumes": self.preempt_resumes,
                 "watchdog_trips": self.watchdog_trips,
+                "spec_drafted_tokens": self.spec_drafted_tokens,
+                "spec_accepted_tokens": self.spec_accepted_tokens,
+                "spec_rollback_tokens": self.spec_rollback_tokens,
+                "spec_accept_rate": round(
+                    self.spec_accepted_tokens
+                    / self.spec_drafted_tokens, 4)
+                if self.spec_drafted_tokens else None,
                 "uptime_s": round(time.monotonic() - self._t0, 3),
             }
         if kv:  # paged-cache occupancy (operator admission headroom)

@@ -119,6 +119,12 @@ class _Replica(object):
             "consecutive_failures": self.failures,
             "queue_depth": (self.last_metrics or {}).get(
                 "queue_depth"),
+            # the observable payoff of prefix/session affinity: a
+            # well-aimed router keeps this high on repeat traffic
+            "prefix_hit_rate": (self.last_metrics or {}).get(
+                "prefix_cache_hit_rate"),
+            "spec_accept_rate": (self.last_metrics or {}).get(
+                "spec_accept_rate"),
         }
 
 

@@ -68,6 +68,26 @@ signals ``drained`` — the rolling-restart hook behind ``POST
 :mod:`veles_tpu.faults`) let tier-1 exercise every one of these paths
 deterministically.
 
+Decode speed (both paged-only, off by default): **speculative
+decoding** (``spec`` + ``spec_k``) drafts up to k tokens per slot by
+n-gram prompt lookup (:mod:`veles_tpu.serving.spec`) and scores the
+pending token plus all drafts in ONE batched verify pass
+(:func:`serving.engine.verify_step_paged`) — the accepted prefix
+plus the correction sample reproduces the spec-off stream
+bit-for-bit (greedy AND seeded; the verify samples fold the same
+per-request draw counters), rejected tails roll back logically
+(their K/V rows sit past the accepted length, masked until
+overwritten), and the occupancy/depth bucket ladder grows a
+power-of-two k axis pre-compiled at :meth:`start`.  The **radix
+prefix cache** (``prefix_cache`` + ``prefix_evict``;
+:mod:`veles_tpu.serving.prefix_cache`) makes KV blocks
+cross-request: finished requests donate their written blocks,
+admission longest-prefix-matches the trie so warm prompts gather
+the resident rows and chunk-prefill only the cold tail, claim only
+``ceil(cold_tokens / block_size)`` new blocks (cache hits raise max
+concurrent streams), and refcount-0 residents LRU-evict under pool
+pressure.
+
 Config knobs (``root.common.serving.*``, overridable per scheduler):
 ``kv`` ("paged"/"dense"), ``block_size`` (tokens per KV block,
 default 16), ``kv_blocks`` (pool capacity in blocks; default the
@@ -75,7 +95,8 @@ dense-equivalent ``max_slots · ceil(window / block_size)``),
 ``prefill_chunk`` (chunk width in tokens, rounded up to a power of
 two; 0 disables chunking, default 64), ``request_timeout`` /
 ``watchdog`` / ``shed_block_factor`` (lifecycle knobs above; 0
-disables each).
+disables each), ``spec`` / ``spec_k`` (speculative decoding),
+``prefix_cache`` / ``prefix_evict`` (the radix cache above).
 """
 
 import collections
@@ -89,13 +110,16 @@ import numpy
 from veles_tpu import faults
 from veles_tpu.logger import Logger
 from veles_tpu.serving.engine import (
-    first_tokens, paged_decode_step, slot_decode_step)
+    first_tokens, paged_decode_step, slot_decode_step,
+    verify_step_paged, verify_supported)
 from veles_tpu.serving.kv_slots import (
     PagedKVCache, SlotKVCache, paged_supported)
 from veles_tpu.serving.metrics import ServingMetrics
 from veles_tpu.serving.prefill import (
     chunked_supported, prefill, prefill_chunk, serving_supported,
     serving_window)
+from veles_tpu.serving.prefix_cache import RadixPrefixCache
+from veles_tpu.serving.spec import NgramProposer, accept_drafts
 
 
 class SchedulerError(Exception):
@@ -151,7 +175,8 @@ class _Request(object):
                  "stop_token", "seed", "deadline", "future", "slot",
                  "generated", "cancelled", "preempts", "t_submit",
                  "t_admit", "t_first", "pf_seq", "pf_caches",
-                 "pf_off", "pf_width", "pf_chunk")
+                 "pf_off", "pf_width", "pf_chunk", "pf_matched",
+                 "prefix_handle")
 
     def __init__(self, prompt, steps, temperature, top_k, stop_token,
                  seed, deadline):
@@ -178,6 +203,8 @@ class _Request(object):
         self.pf_off = 0
         self.pf_width = 0
         self.pf_chunk = 0
+        self.pf_matched = 0      # warm prefix blocks heading the slot
+        self.prefix_handle = None  # pinned radix-cache match
 
     def fail(self, error):
         """Set the future's exception unless a racing path (watchdog,
@@ -208,7 +235,8 @@ class InferenceScheduler(Logger):
                  kv=None, block_size=None, kv_blocks=None,
                  prefill_chunk=None, warm_buckets=None,
                  request_timeout=None, watchdog=None,
-                 shed_block_factor=None):
+                 shed_block_factor=None, spec=None, spec_k=None,
+                 prefix_cache=None, prefix_evict=None):
         super(InferenceScheduler, self).__init__()
         if not serving_supported(forwards):
             raise ValueError(
@@ -269,6 +297,41 @@ class InferenceScheduler(Logger):
         self.shed_block_factor = float(
             _serving_conf("shed_block_factor", 4.0)
             if shed_block_factor is None else shed_block_factor)
+        #: speculative decoding (serving/spec.py): draft up to spec_k
+        #: tokens per slot by n-gram prompt lookup and score them in
+        #: ONE batched verify pass — output streams stay bit-
+        #: identical (greedy and per-seed sampling), accepted drafts
+        #: are pure latency win.  Paged-KV only.
+        spec = bool(_serving_conf("spec", False)
+                    if spec is None else spec)
+        self.spec_k = int(_serving_conf("spec_k", 4)
+                          if spec_k is None else spec_k)
+        if spec and self.spec_k < 1:
+            raise ValueError("spec_k must be >= 1")
+        if spec and (self.kv != "paged"
+                     or not verify_supported(forwards)):
+            self.info("chain/kv mode cannot run the paged verify "
+                      "step; speculative decoding disabled")
+            spec = False
+        self.spec = spec
+        self._proposer = NgramProposer(k=self.spec_k) if spec \
+            else None
+        #: cross-request radix prefix cache (serving/prefix_cache.py)
+        #: — needs the paged cache, chunked prefill for the cold
+        #: tail, and a power-of-two block size (the staging/chunk
+        #: tilings assume it)
+        pfx = bool(_serving_conf("prefix_cache", False)
+                   if prefix_cache is None else prefix_cache)
+        if pfx and (self.kv != "paged" or not self.prefill_chunk
+                    or self.block_size & (self.block_size - 1)):
+            self.info("prefix cache needs kv='paged', chunked "
+                      "prefill and a power-of-two block size; "
+                      "disabled")
+            pfx = False
+        self.prefix_cache = pfx
+        self.prefix_evict = bool(
+            _serving_conf("prefix_evict", True)
+            if prefix_evict is None else prefix_evict)
         self.stats = ServingMetrics()
         self._queue = collections.deque()
         self._active = {}            # slot -> _Request (decoding)
@@ -290,6 +353,7 @@ class InferenceScheduler(Logger):
         self._watchdog_thread = None
         self._ready = threading.Event()
         self.cache_ = None           # set by the loop thread
+        self.prefix_ = None          # radix cache (loop thread too)
 
     # -- client side ----------------------------------------------------
 
@@ -503,6 +567,20 @@ class InferenceScheduler(Logger):
             out["kv_blocks_free"] = \
                 cache.free_blocks if cache is not None \
                 else self.kv_blocks
+        out["spec"] = self.spec
+        out["spec_k"] = self.spec_k if self.spec else 0
+        pfx = self.prefix_
+        out["prefix_cache"] = pfx is not None
+        if pfx is not None:  # loop-owned; monitoring-grade reads
+            total = pfx.hits + pfx.misses
+            out["prefix_cache_hits"] = pfx.hits
+            out["prefix_cache_misses"] = pfx.misses
+            out["prefix_cache_evictions"] = pfx.evictions
+            out["prefix_cache_hit_blocks"] = pfx.hit_blocks
+            out["prefix_cache_blocks_resident"] = pfx.resident
+            out["prefix_cache_blocks_shared"] = pfx.shared_blocks()
+            out["prefix_cache_hit_rate"] = \
+                round(pfx.hits / total, 4) if total else None
         return out
 
     def metrics(self):
@@ -519,6 +597,17 @@ class InferenceScheduler(Logger):
         snap["drained"] = self._drained.is_set()
         snap["queued_kv_blocks"] = queued_blocks
         return snap
+
+    def check_kv(self):
+        """Invariant sweep over the paged cache INCLUDING the prefix
+        cache's resident blocks (tests/soaks): every block is
+        exactly one of {trash, free, resident, slot-private} and
+        every slot's shared prefix is resident."""
+        cache = self.cache_
+        if cache is None or self.kv != "paged":
+            return
+        cache.check(resident=self.prefix_.resident_blocks()
+                    if self.prefix_ is not None else ())
 
     def close(self):
         """Stop the loop, fail every unfinished request, and return
@@ -548,8 +637,7 @@ class InferenceScheduler(Logger):
             if req.slot is not None and cache is not None:
                 # the loop thread is dead (joined above): releasing
                 # its cache bookkeeping from here cannot race it
-                cache.release(req.slot)
-                req.slot = None
+                self._release_slot(req, cache)
             req.fail(err)
         if cache is not None:
             self._sync_kv_gauges(cache)
@@ -581,6 +669,9 @@ class InferenceScheduler(Logger):
                           for n in range(1, self.max_slots + 1)})
         depths = sorted({_bucket(n, 1, cache.blocks_per_slot)
                          for n in range(1, cache.blocks_per_slot + 1)})
+        ks = sorted({_bucket(x, 1, self.spec_k)
+                     for x in range(1, self.spec_k + 1)}) \
+            if self.spec else []
         t0 = time.monotonic()
         for b in buckets:
             for t in depths:
@@ -593,13 +684,28 @@ class InferenceScheduler(Logger):
                     numpy.zeros((b,), numpy.int32),
                     numpy.zeros((b,), numpy.uint32),
                     numpy.zeros((b,), numpy.int32))
-        self.info("paged-step warmup: %d occupancy x %d depth "
-                  "buckets in %.2fs", len(buckets), len(depths),
-                  time.monotonic() - t0)
+                for kk in ks:
+                    # the verify ladder rides the same dummy trash-
+                    # block convention, one executable per (B, T, k)
+                    verify_step_paged(
+                        self.forwards, cache,
+                        numpy.zeros((b, kk + 1), numpy.int32),
+                        numpy.zeros((b,), numpy.int32),
+                        numpy.ones((b,), numpy.int32),
+                        numpy.zeros((b, t), numpy.int32),
+                        numpy.zeros((b,), numpy.float32),
+                        numpy.zeros((b,), numpy.int32),
+                        numpy.zeros((b,), numpy.uint32),
+                        numpy.zeros((b,), numpy.int32))
+        self.info("paged-step warmup: %d occupancy x %d depth x "
+                  "%d spec buckets in %.2fs", len(buckets),
+                  len(depths), len(ks) + 1, time.monotonic() - t0)
 
     def _loop(self):
         try:
             cache = self._make_cache()
+            if self.prefix_cache:
+                self.prefix_ = RadixPrefixCache(self.block_size)
             if self.kv == "paged" and self.warm_buckets:
                 self._warm_paged(cache)
             self.cache_ = cache
@@ -630,13 +736,17 @@ class InferenceScheduler(Logger):
                 self._beat = time.monotonic()
                 self._expire_locked()
                 admits = []
-                while self._queue and cache.can_admit(
-                        len(self._queue[0].prompt)
-                        + self._queue[0].steps):
+                while self._queue and self._can_admit(
+                        cache, self._queue[0]):
                     req = self._queue.popleft()
                     self._queued_blocks -= self._blocks_for(req)
-                    req.slot = cache.alloc(len(req.prompt)
-                                           + req.steps)
+                    if not self._admit_claim(cache, req):
+                        # a racing claim in this same batch consumed
+                        # the headroom the peek counted — requeue at
+                        # the front and retry next boundary
+                        self._queue.appendleft(req)
+                        self._queued_blocks += self._blocks_for(req)
+                        break
                     admits.append(req)
                     self._admitting.append(req)
             # jax work OUTSIDE the lock: submit() must never block on
@@ -653,6 +763,107 @@ class InferenceScheduler(Logger):
                 self._prefill_tick(cache)
             if self._active:
                 self._step(cache)
+
+    def _can_admit(self, cache, req):
+        """Admission sizing for the head-of-queue request.  A warm
+        prompt (prefix-cache hit) needs only its COLD blocks —
+        ``ceil(cold_tokens / block_size)`` plus decode headroom — so
+        cache hits raise the concurrent-stream ceiling; evictable
+        refcount-0 resident blocks count as headroom too."""
+        total = len(req.prompt) + req.steps
+        if self.kv != "paged":
+            return cache.can_admit(total)
+        if not cache.free_slots:
+            return False
+        need = cache.blocks_needed(total)
+        head = cache.free_blocks
+        if self.prefix_ is not None:
+            seq = list(req.prompt) + list(req.generated)
+            need -= self.prefix_.peek(
+                seq, max_blocks=(len(seq) - 1) // cache.block_size)
+            if self.prefix_evict:
+                head += self.prefix_.evictable_blocks()
+        return need <= head
+
+    def _admit_claim(self, cache, req):
+        """Claim a slot + blocks for one admitted request: pin the
+        longest resident prefix (capped so >= 1 token stays cold —
+        the first-token logits must come from somewhere), evict
+        cold residents if the free list is short, then alloc with
+        the matched blocks heading the table."""
+        total = len(req.prompt) + req.steps
+        if self.kv != "paged":
+            req.slot = cache.alloc(total)
+            return req.slot is not None
+        handle = None
+        if self.prefix_ is not None:
+            seq = list(req.prompt) + list(req.generated)
+            handle = self.prefix_.match(
+                seq, max_blocks=(len(seq) - 1) // cache.block_size)
+            self.stats.record_prefix_lookup(len(handle),
+                                            cache.block_size)
+            if not len(handle):
+                handle = None
+        matched = len(handle) if handle is not None else 0
+        need_new = cache.blocks_needed(total) - matched
+        if self.prefix_ is not None and self.prefix_evict \
+                and need_new > cache.free_blocks:
+            freed = self.prefix_.evict(need_new - cache.free_blocks)
+            if freed:
+                cache.reclaim(freed)
+                self.stats.record_prefix_evict(len(freed))
+        slot = cache.alloc(
+            total, shared=handle.blocks if handle is not None else ())
+        if slot is None:
+            if handle is not None:
+                self.prefix_.release(handle)
+            return False
+        req.slot = slot
+        req.prefix_handle = handle
+        req.pf_matched = matched
+        return True
+
+    def _release_slot(self, req, cache, finished=False):
+        """Return one request's slot, blocks and prefix pins.  A
+        request that FINISHED cleanly donates the full blocks of its
+        prompt + generated stream to the prefix cache (insert-on-
+        release) — the warm state future identical prefixes match."""
+        if req.slot is None:
+            if req.prefix_handle is not None:
+                self.prefix_.release(req.prefix_handle)
+                req.prefix_handle = None
+            return
+        if self.kv != "paged" or self.prefix_ is None:
+            cache.release(req.slot)
+        else:
+            donate = 0
+            seq = None
+            if finished:
+                seq = list(req.prompt) + list(req.generated)
+                # the FINAL token was sampled but never fed back, so
+                # its K/V row was never written — donate only blocks
+                # fully covered by written positions [0, len - 1)
+                # (the same bound the admission match caps at)
+                donate = (len(seq) - 1) // cache.block_size \
+                    - req.pf_matched
+            shared, donated = cache.release(req.slot,
+                                            donate=max(0, donate))
+            if req.prefix_handle is not None:
+                self.prefix_.release(req.prefix_handle)
+                req.prefix_handle = None
+            if seq is not None and (shared or donated):
+                _, rejected = self.prefix_.insert(seq,
+                                                  shared + donated)
+                if rejected:  # an identical twin donated first
+                    cache.reclaim(rejected)
+            self._sync_prefix_gauges()
+        req.slot = None
+        req.pf_matched = 0
+
+    def _sync_prefix_gauges(self):
+        if self.prefix_ is not None:
+            self.stats.set_prefix_blocks(self.prefix_.resident,
+                                         self.prefix_.shared_blocks())
 
     def _reap(self, cache):
         """Boundary sweep over the in-flight set: release the slot and
@@ -690,9 +901,7 @@ class InferenceScheduler(Logger):
             if req in self._prefilling:
                 self._prefilling.remove(req)
             self._active.pop(req.slot, None)
-        if req.slot is not None:
-            cache.release(req.slot)
-            req.slot = None
+        self._release_slot(req, cache)
         req.pf_seq = req.pf_caches = None
         self._sync_kv_gauges(cache)
 
@@ -714,8 +923,7 @@ class InferenceScheduler(Logger):
                 req = max(self._active.values(),
                           key=lambda r: (r.t_admit, r.slot))
                 self._active.pop(req.slot, None)
-            cache.release(req.slot)
-            req.slot = None
+            self._release_slot(req, cache)
             req.preempts += 1
             self.stats.record_preempt(len(req.generated))
             self._sync_kv_gauges(cache)
@@ -804,6 +1012,9 @@ class InferenceScheduler(Logger):
             self.stats.record_resume(len(seq))
         req.pf_seq = seq
         p_len = len(seq)
+        if req.pf_matched:
+            self._admit_warm(req, cache)
+            return
         chunk = self.prefill_chunk
         if not chunk or p_len <= chunk:
             self._admit_oneshot(req, cache)
@@ -822,6 +1033,33 @@ class InferenceScheduler(Logger):
             self._retire(req, cache, error=e)
             return
         with self._lock:  # close() swaps the list under the same lock
+            self._prefilling.append(req)
+
+    def _admit_warm(self, req, cache):
+        """Prefix-cache hit: the matched blocks already hold the K/V
+        of tokens [0, matched · block_size) — GATHER them into the
+        staging row and ride the chunked-prefill path for the cold
+        tail only (near-zero TTFT when the tail is short).  The
+        chunk narrows to block_size so every offset stays
+        chunk-aligned from the warm boundary."""
+        from veles_tpu import dtypes
+        bs = self.block_size
+        p_len = len(req.pf_seq)
+        req.pf_chunk = min(self.prefill_chunk, bs)
+        req.pf_width = self._staging_width(p_len, self.prefill_chunk)
+        req.pf_off = req.pf_matched * bs
+        try:
+            req.pf_caches = {
+                i: u.init_cache(1, req.pf_width,
+                                dtypes.compute_dtype())
+                for i, u in enumerate(self.forwards)
+                if hasattr(u, "init_cache")}
+            req.pf_caches = cache.load_staging(
+                req.pf_caches, req.prefix_handle.blocks)
+        except Exception as e:
+            self._retire(req, cache, error=e)
+            return
+        with self._lock:
             self._prefilling.append(req)
 
     def _admit_oneshot(self, req, cache):
@@ -888,7 +1126,14 @@ class InferenceScheduler(Logger):
         preempt-resume — exactly the counter the decode step would
         have folded, so the resumed stream never forks."""
         try:
-            cache.insert(req.slot, row_caches, len(req.pf_seq))
+            if self.kv == "paged":
+                # a warm admission skips its shared prefix blocks —
+                # they are the prefix cache's, and already hold
+                # exactly these rows
+                cache.insert(req.slot, row_caches, len(req.pf_seq),
+                             from_block=req.pf_matched)
+            else:
+                cache.insert(req.slot, row_caches, len(req.pf_seq))
         except Exception as e:
             self._retire(req, cache, error=e)
             return
@@ -929,10 +1174,32 @@ class InferenceScheduler(Logger):
         seeds[j] = req.seed
         counts[j] = len(req.generated)
 
+    def _draft(self, active):
+        """Propose up to spec_k draft tokens per slot by n-gram
+        prompt lookup over its own context — capped so accepting
+        every draft plus the correction token never exceeds the
+        request's step budget (the positions stay inside the blocks
+        claimed at admission)."""
+        drafts = {}
+        for slot, req in active.items():
+            room = req.steps - len(req.generated) - 1
+            if room < 1:
+                continue
+            d = self._proposer.propose(
+                list(req.prompt) + list(req.generated), room)
+            if d:
+                drafts[slot] = d
+        return drafts
+
     def _step_paged(self, cache, active):
         """Packed step: ONLY the active slots ride the batch, padded
         to a power-of-two occupancy bucket; the attended range is the
         power-of-two block bucket of the deepest request."""
+        if self.spec:
+            drafts = self._draft(active)
+            if drafts:
+                self._step_verify(cache, active, drafts)
+                return
         slots = sorted(active)
         n = len(slots)
         b = _bucket(n, 1, self.max_slots)
@@ -958,6 +1225,63 @@ class InferenceScheduler(Logger):
         for j, slot in enumerate(slots):
             req = active[slot]
             req.generated.append(int(nxt[j]))
+            self._maybe_finish(req, cache)
+
+    def _step_verify(self, cache, active, drafts):
+        """Speculative step: every active slot rides ONE batched
+        verify pass — its pending token plus its drafts (slots
+        without a draft run a plain width-1 decode inside the same
+        batch).  The occupancy/depth buckets grow a power-of-two
+        draft-width axis k; acceptance keeps the longest matched
+        prefix plus the correction sample, so the emitted stream is
+        bit-identical to spec-off decoding while one pass can emit
+        up to k + 1 tokens."""
+        slots = sorted(active)
+        n = len(slots)
+        b = _bucket(n, 1, self.max_slots)
+        k = _bucket(max(len(d) for d in drafts.values()), 1,
+                    self.spec_k)
+        bs = cache.block_size
+        deepest = max(len(active[s].prompt)
+                      + len(active[s].generated) for s in slots) + k
+        t = _bucket(-(-deepest // bs), 1, cache.blocks_per_slot)
+        toks = numpy.zeros((b, k + 1), numpy.int32)
+        pos = numpy.zeros((b,), numpy.int32)
+        lens = numpy.ones((b,), numpy.int32)
+        temps = numpy.zeros((b,), numpy.float32)
+        topks = numpy.zeros((b,), numpy.int32)
+        seeds = numpy.zeros((b,), numpy.uint32)
+        counts = numpy.zeros((b,), numpy.int32)
+        tables = numpy.zeros((b, t), numpy.int32)
+        for j, slot in enumerate(slots):
+            req = active[slot]
+            d = drafts.get(slot, ())[:k]
+            toks[j, 0] = req.generated[-1]
+            if d:
+                toks[j, 1:1 + len(d)] = d
+            pos[j] = len(req.prompt) + len(req.generated) - 1
+            lens[j] = 1 + len(d)
+            temps[j] = req.temperature
+            topks[j] = req.top_k
+            seeds[j] = req.seed
+            counts[j] = len(req.generated)
+        tables[:n] = cache.table_rows(slots, t)
+        nxt = numpy.asarray(verify_step_paged(
+            self.forwards, cache, toks, pos, lens, tables, temps,
+            topks, seeds, counts))
+        self.stats.record_step(n, b)
+        for j, slot in enumerate(slots):
+            req = active[slot]
+            d = list(drafts.get(slot, ()))[:k]
+            out = accept_drafts(d, nxt[j, :len(d) + 1])
+            if d:
+                self.stats.record_spec(len(d), len(out) - 1)
+            for tok in out:
+                req.generated.append(int(tok))
+                if len(req.generated) >= req.steps \
+                        or (req.stop_token is not None
+                            and int(tok) == req.stop_token):
+                    break
             self._maybe_finish(req, cache)
 
     def _step_dense(self, cache, active):
@@ -991,9 +1315,7 @@ class InferenceScheduler(Logger):
     def _retire(self, req, cache, error=None):
         with self._lock:
             self._active.pop(req.slot, None)
-        if req.slot is not None:
-            cache.release(req.slot)
-            req.slot = None
+        self._release_slot(req, cache, finished=error is None)
         self._sync_kv_gauges(cache)
         if error is not None:
             req.fail(error if isinstance(error, SchedulerError)

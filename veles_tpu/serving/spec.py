@@ -1,0 +1,88 @@
+"""Speculative decoding: the n-gram / prompt-lookup draft proposer.
+
+Decode is one full model pass per token.  Speculative decoding drafts
+``k`` candidate tokens CHEAPLY, then scores the pending token plus
+all k drafts in ONE batched verify pass
+(:func:`veles_tpu.serving.engine.verify_step_paged`) and keeps the
+longest accepted prefix — so an iteration that accepts ``a`` drafts
+emits ``a + 1`` tokens for one model pass instead of one.
+
+The proposer here is the *self-speculative* n-gram / prompt-lookup
+family (Saxena's prompt-lookup decoding; the ``[ngram]`` draft model
+of vLLM): the draft for the next tokens is whatever FOLLOWED the most
+recent previous occurrence of the context's trailing n-gram.  No
+second model, no extra weights, no quality risk — acceptance keeps
+the output distribution exactly the target model's (greedy and
+per-seed sampling; see the acceptance rule in ``verify_step_paged``),
+and a draft that never matches merely degrades to plain decoding.
+It shines on repetitive text: code, templated prose, long copies of
+the prompt — exactly the traffic a serving fleet sees most.
+
+Host-side and stateless per call: the scheduler owns one proposer and
+calls :meth:`NgramProposer.propose` per active slot per iteration.
+"""
+
+
+class NgramProposer:
+    """Draft up to ``k`` tokens by prompt lookup: find the most
+    recent earlier occurrence of the context's trailing ``n``-gram
+    (longest n first, ``max_ngram`` down to ``min_ngram``) and
+    propose the tokens that followed it.
+
+    ``propose`` is O(len(context) · max_ngram) per call on the host —
+    noise next to a model pass, and only ever invoked for slots that
+    are actively decoding."""
+
+    def __init__(self, k=4, max_ngram=3, min_ngram=1):
+        self.k = int(k)
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = max(1, int(min_ngram))
+        if self.k < 1:
+            raise ValueError("need k >= 1")
+        if self.max_ngram < self.min_ngram:
+            raise ValueError("max_ngram < min_ngram")
+
+    def propose(self, context, max_tokens=None):
+        """Draft tokens continuing ``context`` (a list of ints, the
+        request's prompt + generated stream).  Returns a list of at
+        most ``min(k, max_tokens)`` drafted ids — empty when no
+        earlier occurrence of the trailing n-gram exists (the caller
+        then runs a plain decode step for that slot)."""
+        limit = self.k if max_tokens is None \
+            else min(self.k, int(max_tokens))
+        n_ctx = len(context)
+        if limit < 1 or n_ctx < self.min_ngram + 1:
+            return []
+        for n in range(min(self.max_ngram, n_ctx - 1),
+                       self.min_ngram - 1, -1):
+            tail = context[n_ctx - n:]
+            # scan right-to-left for the most recent PRIOR occurrence
+            # (recent text predicts the continuation best)
+            for j in range(n_ctx - n - 1, -1, -1):
+                if context[j:j + n] == tail:
+                    cont = context[j + n:j + n + limit]
+                    if cont:
+                        return list(cont)
+        return []
+
+
+def accept_drafts(drafts, sampled):
+    """The host half of the verify contract: given the ``drafts``
+    [d_1..d_m] a slot proposed and the ``sampled`` [s_0..s_m] tokens
+    the verify pass emitted (s_j = the token sequential decode would
+    produce after the context extended by d_1..d_j), return the
+    accepted token run.
+
+    s_0 is always valid (it needed no drafts).  s_j is valid iff
+    every earlier draft matched its sample (d_i == s_{i-1}); the
+    first mismatching position still CONTRIBUTES its sample — the
+    model already told us the right token there (the "free"
+    correction) — and everything after it is rolled back.  Greedy or
+    per-seed sampled, the emitted run is bit-identical to the tokens
+    a sequential spec-off decode would have produced."""
+    out = [int(sampled[0])]
+    for j in range(1, len(drafts) + 1):
+        if int(drafts[j - 1]) != out[-1]:
+            break
+        out.append(int(sampled[j]))
+    return out
