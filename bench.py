@@ -1268,6 +1268,97 @@ def bench_router(dev, replica_counts=(1, 2, 4),
     return out
 
 
+def bench_streaming(dev):
+    """Streaming & QoS delivery numbers (the PR-10 layer):
+
+    - ``streaming_ttfb_p95_ms`` — p95 submit-to-FIRST-streamed-token
+      on an idle scheduler (what an SSE client waits before bytes
+      flow; the batch path makes the client wait for the whole
+      decode);
+    - ``streaming_intertoken_p95_ms`` — p95 gap between consecutive
+      streamed tokens of one request (the per-token latency the
+      subscription surfaces; spec-decode bursts compress it);
+    - ``streaming_class_ttft_p95_ms`` — per-priority-class TTFT p95
+      under MIXED load: low-class traffic saturates the slots while
+      high-class probes preempt their way in — the separation
+      between the classes is the payoff of preemptive scheduling.
+
+    Sized down hard on CPU so driver runs stay fast."""
+    from veles_tpu.serving import InferenceScheduler
+
+    cpu = dev.jax_device.platform == "cpu"
+    if cpu:
+        d_model, layers, heads, vocab = 64, 2, 2, 256
+        window, block, steps, p_len = 128, 16, 24, 16
+        probes = 6
+    else:
+        d_model, layers, heads, vocab = 1024, 8, 8, 32768
+        window, block, steps, p_len = 1024, 16, 128, 128
+        probes = 12
+    fw = _serving_chain(dev, d_model, layers, heads, vocab, window,
+                        "bench-streaming")
+    rng = numpy.random.default_rng(0)
+    prompt = rng.integers(0, vocab, (p_len,)).tolist()
+    short = rng.integers(0, vocab, (4,)).tolist()
+    out = {}
+
+    sch = InferenceScheduler(fw, max_slots=4, window=window,
+                             max_queue=64, queue_timeout=600.0,
+                             kv="paged", block_size=block,
+                             warm_buckets=False).start()
+    try:
+        sch.submit(prompt, steps).result(600)   # compile + settle
+        sch.submit(short, 2).result(600)
+        # -- TTFB: time to the FIRST streamed token -----------------
+        ttfb = []
+        for _ in range(probes):
+            t0 = time.perf_counter()
+            ts = sch.submit(prompt, 2, stream=True)
+            next(iter(ts))
+            ttfb.append((time.perf_counter() - t0) * 1e3)
+            ts.result(600)
+        ttfb.sort()
+        out["streaming_ttfb_p95_ms"] = round(
+            ttfb[max(0, int(len(ttfb) * 0.95) - 1)], 2)
+        # -- inter-token latency over one long stream ---------------
+        gaps = []
+        ts = sch.submit(prompt, steps, stream=True)
+        t_prev = None
+        for _ in ts:
+            t_now = time.perf_counter()
+            if t_prev is not None:
+                gaps.append((t_now - t_prev) * 1e3)
+            t_prev = t_now
+        ts.result(600)
+        gaps.sort()
+        out["streaming_intertoken_p95_ms"] = round(
+            gaps[max(0, int(len(gaps) * 0.95) - 1)], 2) \
+            if gaps else None
+        # -- per-class TTFT under mixed priority load ---------------
+        lows = [sch.submit(prompt, steps, seed=i, priority="low")
+                for i in range(8)]
+        time.sleep(0.05)
+        for i in range(probes):
+            sch.submit(short, 2, priority="high").result(600)
+        for f in lows:
+            f.result(600)
+        snap = sch.metrics()
+        out["streaming_class_ttft_p95_ms"] = {
+            cls: rec["ttft_ms_p95"]
+            for cls, rec in snap["classes"].items()}
+        out["streaming_class_preempts"] = {
+            cls: rec["preempts"]
+            for cls, rec in snap["classes"].items()}
+        out["streaming_config"] = {
+            "d_model": d_model, "layers": layers, "heads": heads,
+            "vocab": vocab, "window": window, "block_size": block,
+            "steps": steps, "prompt": p_len, "probes": probes,
+            "spec": sch.spec, "prefix_cache": sch.prefix_cache}
+    finally:
+        sch.close()
+    return out
+
+
 def bench_input_pipeline(dev, steps=40, depth=2):
     """Asynchronous input pipeline (loader/prefetch.py): a synthetic
     SLOW streaming loader — ``fill_minibatch`` sleeps ``decode_ms``
@@ -1454,6 +1545,10 @@ def main():
         router_rec = bench_router(dev)
     except Exception as e:     # fleet bench must not sink the run
         router_rec = {"router_error": repr(e)[:300]}
+    try:
+        streaming_rec = bench_streaming(dev)
+    except Exception as e:   # delivery-layer bench rides the guard
+        streaming_rec = {"streaming_error": repr(e)[:300]}
     mlp_sps, mlp_aud = bench_mlp(dev)
     try:
         input_pipe = bench_input_pipeline(dev)
@@ -1499,6 +1594,7 @@ def main():
     record.update(serving_sweep)
     record.update(spec_rec)
     record.update(router_rec)
+    record.update(streaming_rec)
     record.update(input_pipe)
     record.update(allreduce)
     if dp:
@@ -1567,6 +1663,8 @@ def main():
         "spec_error",
         "router_aggregate_tokens_per_sec", "router_ttft_p95_ms",
         "router_scaling_2x", "router_cores", "router_error",
+        "streaming_ttfb_p95_ms", "streaming_intertoken_p95_ms",
+        "streaming_class_ttft_p95_ms", "streaming_error",
         "input_pipeline_speedup",
         "input_pipeline_decode_ms", "allreduce_p50_us",
         "allreduce_substrate", "allreduce_quality",
@@ -1617,6 +1715,17 @@ def main_spec():
         "PR9 standalone spec/prefix bench run; other entries carried")
 
 
+def main_streaming():
+    """``python bench.py streaming`` — the streaming/QoS delivery
+    bench alone."""
+    return _main_standalone(
+        bench_streaming, "streaming_bench_source",
+        "PR10 standalone streaming/QoS bench run; other entries "
+        "carried")
+
+
 if __name__ == "__main__":
     sys.exit(main_router() if "router" in sys.argv[1:]
-             else main_spec() if "spec" in sys.argv[1:] else main())
+             else main_spec() if "spec" in sys.argv[1:]
+             else main_streaming() if "streaming" in sys.argv[1:]
+             else main())

@@ -54,11 +54,18 @@ def _tiny_fw(name, window=16, vocab=12, dim=16, heads=2, blocks=1):
     return fw
 
 
-def _clean(cache):
-    """The acceptance sweep: no block leaked, double-owned, or stuck."""
-    cache.check()
-    assert cache.used_blocks == 0
-    assert cache.free_blocks == cache.capacity_blocks
+def _clean(sch):
+    """The acceptance sweep over a drained scheduler: no block
+    leaked, double-owned, or stuck.  Blocks the radix prefix cache
+    holds (ON by default since PR 10) are RESIDENT, not leaked — the
+    sweep verifies every block is exactly one of free/resident."""
+    if sch.kv != "paged":
+        return
+    cache = sch.cache_
+    resident = sch.prefix_.resident if sch.prefix_ is not None else 0
+    sch.check_kv()
+    assert cache.used_blocks == resident
+    assert cache.free_blocks == cache.capacity_blocks - resident
     assert cache.free_slots == cache.max_slots
 
 
@@ -173,7 +180,7 @@ def test_deadline_expiry_frees_all_blocks(f32):
         assert len(sch.submit([5, 6], 3).result(60)) == 5
         snap = sch.metrics()
         assert snap["requests_expired"] == 2
-        _clean(sch.cache_)
+        _clean(sch)
     finally:
         sch.close()
 
@@ -204,7 +211,7 @@ def test_cancel_frees_blocks(f32):
         # pool fully restored, scheduler still serves
         assert len(sch.submit([7], 2).result(60)) == 3
         assert sch.metrics()["requests_cancelled"] == 2
-        _clean(sch.cache_)
+        _clean(sch)
     finally:
         sch.close()
 
@@ -216,6 +223,11 @@ def test_close_with_inflight_frees_blocks(f32):
     fw = _tiny_fw("fault-close", window=256)
     sch = InferenceScheduler(fw, max_slots=2, window=256, kv="paged",
                              block_size=4, prefill_chunk=0).start()
+    # pace the decode so both requests are still mid-flight at
+    # close(), however warm the caches are (spec decoding — ON by
+    # default since PR 10 — can finish 200 steps in well under the
+    # sleep below on an untrained cyclic stream)
+    faults.inject("serving.scheduler.step", "delay", arg=0.01)
     a = sch.submit([1, 2, 3], 200)
     b = sch.submit([4, 5], 200)
     time.sleep(0.2)  # both admitted, blocks claimed
@@ -224,7 +236,7 @@ def test_close_with_inflight_frees_blocks(f32):
     for fut in (a, b):
         with pytest.raises(SchedulerError):
             fut.result(10)
-    _clean(sch.cache_)
+    _clean(sch)
 
 
 # -- preemption + resume ------------------------------------------------------
@@ -257,7 +269,7 @@ def test_preempt_resume_token_parity(f32):
                 sch.request_preempt()
             outs = [f.result(120) for f in futs]
             snap = sch.metrics()
-            _clean(sch.cache_)
+            _clean(sch)
             return outs, snap
         finally:
             sch.close()
@@ -293,7 +305,7 @@ def test_drain_completes_inflight_rejects_new(f32):
         assert all(len(o) == 22 for o in outs)
         assert sch.drain(timeout=60) is True
         assert sch.drained
-        _clean(sch.cache_)
+        _clean(sch)
     finally:
         sch.close()
 
@@ -318,7 +330,7 @@ def test_block_pressure_shed(f32):
         assert len(busy.result(120)) == 32
         assert len(q.result(120)) == 28
         assert sch.metrics()["requests_shed"] == 1
-        _clean(sch.cache_)
+        _clean(sch)
     finally:
         sch.close()
 
@@ -366,7 +378,7 @@ def test_watchdog_recovers_from_injected_hang(f32):
             assert time.monotonic() < deadline, "zombies not reaped"
             time.sleep(0.05)
         assert len(sch.submit([5, 6], 3).result(60)) == 5
-        _clean(sch.cache_)
+        _clean(sch)
     finally:
         sch.close()
 
@@ -413,7 +425,7 @@ def test_mixed_fault_soak_no_block_leak(f32):
         while sch.in_flight:
             assert time.monotonic() < deadline
             time.sleep(0.05)
-        _clean(sch.cache_)
+        _clean(sch)
     finally:
         sch.close()
 
@@ -515,7 +527,10 @@ def test_rest_drain_and_structured_errors(f32):
         snap = json.load(urllib.request.urlopen(
             url + "/serving/metrics", timeout=30))
         assert snap["draining"] is True
-        assert snap["kv_blocks_used"] == 0
+        # drained: blocks are free or prefix-cache residents (ON by
+        # default since PR 10), none slot-private
+        assert snap["kv_blocks_used"] == snap.get(
+            "prefix_cache_blocks_resident", 0)
     finally:
         api.stop()
         loader.close()
@@ -539,7 +554,7 @@ def test_rest_deadline_408_carries_tokens(f32):
         assert body["error"]["code"] == 408
         assert body["error"]["tokens_generated"] > 0
         faults.clear()
-        _clean(api.scheduler_.cache_)
+        _clean(api.scheduler_)
     finally:
         api.stop()
         loader.close()

@@ -505,11 +505,16 @@ def test_rest_serving_concurrent_soak(f32):
         assert 0.0 < snap["slot_occupancy"] <= 1.0
         assert snap["ttft_ms_p50"] is not None
         # operators watch block headroom for admission pressure: all
-        # requests drained, so every block is back in the free pool
+        # requests drained, so every block is either back in the free
+        # pool or RESIDENT in the radix prefix cache (ON by default
+        # since PR 10) — none left slot-private
         assert snap["kv_mode"] == "paged"
-        assert snap["kv_blocks_used"] == 0
-        assert snap["kv_blocks_free"] == snap["kv_blocks_total"] > 0
+        resident = snap.get("prefix_cache_blocks_resident", 0)
+        assert snap["kv_blocks_used"] == resident
+        assert snap["kv_blocks_free"] + resident \
+            == snap["kv_blocks_total"] > 0
         assert snap["queue_depth"] == 0
+        api.scheduler_.check_kv()
     finally:
         api.stop()
         loader.close()

@@ -118,7 +118,7 @@ def test_spec_token_parity(f32):
                 for i, p in enumerate(prompts)]
 
     base, _ = _run_sched(fw, submits, kv="paged", block_size=4,
-                         prefill_chunk=0)
+                         prefill_chunk=0, spec=False)
     spec, snap = _run_sched(fw, submits, kv="paged", block_size=4,
                             prefill_chunk=0, spec=True, spec_k=4,
                             check=True)
@@ -142,7 +142,7 @@ def test_spec_accept_rate_on_repetitive_prompts(f32):
     prompts = [[4, 5, 6] * 6, [2, 9] * 9, [3] * 12]
     submits = [(p, 16, dict(seed=0)) for p in prompts]
     base, _ = _run_sched(fw, submits, kv="paged", block_size=4,
-                         prefill_chunk=0)
+                         prefill_chunk=0, spec=False)
     spec, snap = _run_sched(fw, submits, kv="paged", block_size=4,
                             prefill_chunk=0, spec=True, spec_k=4,
                             check=True)
@@ -272,7 +272,9 @@ def test_prefix_warm_resubmit_parity(f32):
     prompt = rng.integers(0, 12, (24,)).tolist()
 
     sch = InferenceScheduler(fw, max_slots=2, window=64, kv="paged",
-                             block_size=4, prefill_chunk=8).start()
+                             block_size=4, prefill_chunk=8,
+                             prefix_cache=False,
+                             warm_buckets=False).start()
     try:
         ref = sch.submit(prompt, 8, seed=0).result(240)
     finally:

@@ -36,6 +36,7 @@ REQUIRED_REGISTRATIONS = (
     ("serving/engine.py", "serving.sample_first"),
     ("serving/prefill.py", "serving.prefill"),
     ("serving/prefill.py", "serving.prefill_chunk"),
+    ("serving/openai_api.py", "serving.embed_pool"),
     ("serving/kv_slots.py", "serving.kv_insert_row"),
     ("serving/kv_slots.py", "serving.kv_insert_blocks"),
     ("serving/kv_slots.py", "serving.kv_gather_blocks"),

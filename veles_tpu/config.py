@@ -179,7 +179,10 @@ root.common.update({
     # NON-loopback caller hit the admin endpoints (/drain, /shutdown)
     # with "Authorization: Bearer <token>" — unset, they stay
     # loopback-only
-    "api": {"max_steps": 2048, "max_batch": 64, "admin_token": None},
+    # model_id is the name the OpenAI facade (/v1/models,
+    # /v1/completions) serves the chain under
+    "api": {"max_steps": 2048, "max_batch": 64, "admin_token": None,
+            "model_id": "veles-lm"},
     # multi-replica fleet router (serving/router.py): health-aware
     # load balancing over N engine replicas with per-replica circuit
     # breakers (closed -> open after breaker_failures consecutive
@@ -241,7 +244,12 @@ root.common.update({
     # the cross-request radix prefix cache over the paged block pools
     # (warm prompts skip prefill for resident leading blocks) with
     # prefix_evict allowing LRU eviction of refcount-0 resident
-    # blocks under admission pressure
+    # blocks under admission pressure.  Both DEFAULT ON since the
+    # PR 10 mixed-priority soak (the "after real-traffic soak" gate
+    # PR 9 left open): streams are bit-identical either way, so the
+    # knobs are opt-OUT (spec needs a verify-capable chain and
+    # prefix_cache needs chunked prefill + a pow2 block size — the
+    # scheduler falls back automatically when unsupported)
     "serving": {
         "kv": "paged",
         "block_size": 16,
@@ -251,9 +259,9 @@ root.common.update({
         "request_timeout": 120.0,
         "watchdog": 300.0,
         "shed_block_factor": 4.0,
-        "spec": False,
+        "spec": True,
         "spec_k": 4,
-        "prefix_cache": False,
+        "prefix_cache": True,
         "prefix_evict": True,
     },
     # fault injection (veles_tpu/faults/): spec string parsed on first

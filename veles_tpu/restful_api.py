@@ -91,7 +91,8 @@ class RESTfulAPI(Unit):
                  serving_block_size=None, serving_kv_blocks=None,
                  serving_prefill_chunk=None, serving_spec=None,
                  serving_spec_k=None, serving_prefix_cache=None,
-                 replica_id=None, **kwargs):
+                 serving_warm_buckets=None, replica_id=None,
+                 **kwargs):
         super(RESTfulAPI, self).__init__(workflow, **kwargs)
         self.loader = loader
         #: fleet identity: every reply carries it as X-Veles-Replica
@@ -126,6 +127,9 @@ class RESTfulAPI(Unit):
         self.serving_spec = serving_spec
         self.serving_spec_k = serving_spec_k
         self.serving_prefix_cache = serving_prefix_cache
+        #: None defers to root.common.serving.warm_buckets; tests pin
+        #: False (the bucket-ladder warmup is the compile hog)
+        self.serving_warm_buckets = serving_warm_buckets
         #: /generate resource caps — an unbounded request would pay a
         #: giant alloc + a multi-second compile before failing; None
         #: defers to root.common.api.{max_steps,max_batch}
@@ -154,6 +158,16 @@ class RESTfulAPI(Unit):
         if vocab is not None and \
                 (prompt.min() < 0 or prompt.max() >= int(vocab)):
             return "prompt token ids must be in [0, %d)" % vocab
+        return None
+
+    def _validate_rows(self, rows):
+        """Vocab-bounds check for parsed token rows (the /v1 paths,
+        which skip the numpy padding _validate_prompt works on)."""
+        vocab = getattr(self.forwards[0], "vocab", None)
+        if vocab is not None:
+            for r in rows:
+                if min(r) < 0 or max(r) >= int(vocab):
+                    return "token ids must be in [0, %d)" % vocab
         return None
 
     def _decode_beam(self, prompt, steps, beam):
@@ -192,7 +206,7 @@ class RESTfulAPI(Unit):
                             stop_token=stop_token)
 
     def _generate_scheduled(self, rows, steps, temperature, top_k,
-                            seed, stop):
+                            seed, stop, priority=None):
         """Decode a /generate body through the continuous-batching
         scheduler: every prompt row is its own request (ragged batches
         interleave in the slots like independent clients).  Returns
@@ -211,7 +225,8 @@ class RESTfulAPI(Unit):
                 futures.append(self.scheduler_.submit(
                     row, steps, temperature=temperature, top_k=top_k,
                     seed=None if seed is None else int(seed) + i,
-                    stop_token=stop, timeout=self.request_timeout))
+                    stop_token=stop, timeout=self.request_timeout,
+                    priority=priority))
             # the scheduler enforces the deadline itself (408 with
             # partial-token count); the result wait is only a backstop
             # against a wedged loop with the watchdog disabled
@@ -259,7 +274,8 @@ class RESTfulAPI(Unit):
                     prefill_chunk=self.serving_prefill_chunk,
                     spec=self.serving_spec,
                     spec_k=self.serving_spec_k,
-                    prefix_cache=self.serving_prefix_cache).start()
+                    prefix_cache=self.serving_prefix_cache,
+                    warm_buckets=self.serving_warm_buckets).start()
                 self.info(
                     "serving scheduler: %d slots, window %d, "
                     "queue cap %d, kv=%s (block %d), prefill "
@@ -352,6 +368,12 @@ class RESTfulAPI(Unit):
                         "logs": list(recorder.log_ring)[-50:],
                     })
                     return
+                if route == "/v1/models":
+                    # OpenAI-compatible model listing (ecosystem
+                    # clients enumerate before they complete)
+                    from veles_tpu.serving import openai_api
+                    self._reply_json(openai_api.models_reply())
+                    return
                 if route == "/metrics":
                     # Prometheus text exposition of the process-wide
                     # registry (serving, per-unit, compile series)
@@ -409,7 +431,296 @@ class RESTfulAPI(Unit):
                 # HTML error pages are not machine-parseable
                 self._reply_error(code, message or explain or "")
 
+            def _read_body(self):
+                length = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(length) or b"{}")
+
+            def _reply_scheduler_error(self, e):
+                """Map a SchedulerError to its structured HTTP reply
+                (503 + class-aware Retry-After, 408 + partial-token
+                count) — shared by /generate and the /v1 facade."""
+                self._reply_error(
+                    e.http_status, _status_text(e),
+                    retry_after=getattr(e, "retry_after", None),
+                    tokens_generated=getattr(e, "tokens_generated",
+                                             None),
+                    draining=True if api._draining_ else None)
+
+            def _sse_headers(self):
+                """Begin a Server-Sent-Events response; the
+                connection close delimits the stream (HTTP/1.0 —
+                no Content-Length)."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                if api.replica_id:
+                    self.send_header("X-Veles-Replica",
+                                     str(api.replica_id))
+                self.end_headers()
+                self.close_connection = True
+
+            def _relay_sse(self, ts, chunk_fn, final_fn):
+                """Pump one TokenStream onto the wire: one SSE frame
+                per accepted token (``chunk_fn(token) -> payload``),
+                ``final_fn(error_or_None) -> payload`` as the
+                terminal frame, then ``data: [DONE]``.  A client that
+                disconnects mid-stream CANCELS the request — its slot
+                and KV blocks return to the pool at the next decode
+                boundary instead of decoding for nobody."""
+                from veles_tpu.serving.scheduler import SchedulerError
+                from veles_tpu.serving.streams import (
+                    SSE_DONE, StreamTimeoutError, sse_event)
+                # backstop against a wedged loop with the watchdog
+                # off: stop waiting, cancel, tell the client
+                ts.token_timeout = api.request_timeout + 30.0
+                self._sse_headers()
+                err = None
+                try:
+                    for tok in ts:
+                        self.wfile.write(sse_event(chunk_fn(tok)))
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionError, OSError):
+                    ts.cancel()
+                    return
+                except StreamTimeoutError as e:
+                    ts.cancel()
+                    err = SchedulerError(_status_text(e))
+                except SchedulerError as e:
+                    err = e
+                try:
+                    self.wfile.write(sse_event(final_fn(err)))
+                    self.wfile.write(SSE_DONE)
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionError, OSError):
+                    pass
+
+            def _stream_generate(self, row, steps, temperature,
+                                 top_k, seed, stop, priority):
+                """SSE for POST /generate {"stream": true}: one
+                ``{"token": t}`` frame per accepted token (spec
+                bursts arrive back to back), a terminal frame with
+                the FULL token list (concatenation check: identical
+                to the batch reply) + usage, then [DONE]."""
+                from veles_tpu.serving.scheduler import SchedulerError
+                try:
+                    ts = api.scheduler_.submit(
+                        row, steps, temperature=temperature,
+                        top_k=top_k,
+                        seed=None if seed is None else int(seed),
+                        stop_token=stop,
+                        timeout=api.request_timeout,
+                        priority=priority, stream=True)
+                except ValueError as e:
+                    self.send_error(400, _status_text(e))
+                    return
+                except SchedulerError as e:
+                    self._reply_scheduler_error(e)
+                    return
+
+                def final(err):
+                    if err is not None:
+                        return {"error": {
+                            "code": getattr(err, "http_status", 500),
+                            "message": _status_text(err),
+                            "tokens_generated": len(ts.tokens)}}
+                    return {"done": True,
+                            "tokens": ts.prompt + ts.tokens,
+                            "usage": {
+                                "prompt_tokens": len(ts.prompt),
+                                "completion_tokens": len(ts.tokens),
+                                "total_tokens": len(ts.prompt)
+                                + len(ts.tokens)}}
+
+                self._relay_sse(ts, lambda t: {"token": t}, final)
+
+            def _v1_completions(self):
+                """POST /v1/completions — the OpenAI facade over the
+                same scheduler path /generate uses (stream and
+                batch)."""
+                from veles_tpu.serving import openai_api
+                from veles_tpu.serving.scheduler import SchedulerError
+                if api.forwards is None:
+                    self.send_error(404,
+                                    "this endpoint serves no model")
+                    return
+                try:
+                    params = openai_api.parse_completions(
+                        self._read_body())
+                except ValueError as e:
+                    self.send_error(400, _status_text(e))
+                    return
+                rows = params["rows"]
+                if len(rows) > api._cap("max_batch", 64):
+                    self.send_error(400, "batch of %d prompts "
+                                    "exceeds max_batch" % len(rows))
+                    return
+                if params["steps"] > api._cap("max_steps", 2048):
+                    self.send_error(400, "max_tokens %d exceeds "
+                                    "max_steps" % params["steps"])
+                    return
+                err = api._validate_rows(rows)
+                if err:
+                    self.send_error(400, err)
+                    return
+                if api.scheduler_ is None:
+                    self.send_error(
+                        501, "the OpenAI facade needs the serving "
+                        "scheduler (serving=False pins legacy "
+                        "/generate only)")
+                    return
+                import time as _time
+                cid = openai_api.completion_id()
+                created = int(_time.time())
+                model = params["model"]
+                if params["stream"]:
+                    if len(rows) != 1:
+                        self.send_error(400, "stream: true needs a "
+                                        "single prompt row")
+                        return
+                    try:
+                        ts = api.scheduler_.submit(
+                            rows[0], params["steps"],
+                            temperature=params["temperature"],
+                            top_k=params["top_k"],
+                            seed=params["seed"],
+                            stop_token=params["stop"],
+                            timeout=api.request_timeout,
+                            priority=params["priority"],
+                            stream=True)
+                    except ValueError as e:
+                        self.send_error(400, _status_text(e))
+                        return
+                    except SchedulerError as e:
+                        self._reply_scheduler_error(e)
+                        return
+
+                    def chunk(tok):
+                        return openai_api.completion_chunk(
+                            cid, created, model, 0, [tok])
+
+                    def final(err):
+                        if err is not None:
+                            return {"error": {
+                                "code": getattr(err, "http_status",
+                                                500),
+                                "message": _status_text(err)}}
+                        return openai_api.completion_chunk(
+                            cid, created, model, 0, [],
+                            finish=openai_api.finish_reason(
+                                ts.tokens, params["steps"],
+                                params["stop"]),
+                            usage=openai_api.usage_of(
+                                rows, [len(ts.tokens)]))
+
+                    self._relay_sse(ts, chunk, final)
+                    return
+                try:
+                    outs = api._generate_scheduled(
+                        rows, params["steps"], params["temperature"],
+                        params["top_k"], params["seed"],
+                        params["stop"], priority=params["priority"])
+                except ValueError as e:
+                    self.send_error(400, _status_text(e))
+                    return
+                except SchedulerError as e:
+                    self._reply_scheduler_error(e)
+                    return
+                except concurrent.futures.TimeoutError:
+                    self._reply_error(408, "decode timed out",
+                                      tokens_generated=0)
+                    return
+                gens = [out[len(r):] for r, out in zip(rows, outs)]
+                choices = [openai_api.completion_choice(i, r, g,
+                                                        params)
+                           for i, (r, g) in enumerate(zip(rows,
+                                                          gens))]
+                self._reply_json(openai_api.completion_reply(
+                    cid, created, model, choices,
+                    openai_api.usage_of(rows,
+                                        [len(g) for g in gens])))
+
+            def _v1_batch(self, kind):
+                """POST /v1/embeddings | /v1/classify — batched
+                non-LM scoring through the scheduler's aux lane (the
+                decode loop runs the jitted pass between decode
+                boundaries)."""
+                from veles_tpu.serving import openai_api
+                from veles_tpu.serving.scheduler import SchedulerError
+                if api.forwards is None or api.scheduler_ is None:
+                    self.send_error(404, "no servable model chain")
+                    return
+                try:
+                    body = self._read_body()
+                    rows, _ = openai_api.parse_token_rows(
+                        body.get("input"), what="input")
+                except ValueError as e:
+                    self.send_error(400, _status_text(e))
+                    return
+                if len(rows) > api._cap("max_batch", 64):
+                    self.send_error(400, "batch of %d rows exceeds "
+                                    "max_batch" % len(rows))
+                    return
+                err = api._validate_rows(rows)
+                if err:
+                    self.send_error(400, err)
+                    return
+                model = str(body.get("model")
+                            or openai_api.model_id())
+                try:
+                    if kind == "embed":
+                        fut = api.scheduler_.submit_embed(rows)
+                    else:
+                        fut = api.scheduler_.submit_score(rows)
+                    out = fut.result(api.request_timeout + 30.0)
+                except ValueError as e:
+                    self.send_error(400, _status_text(e))
+                    return
+                except SchedulerError as e:
+                    self._reply_scheduler_error(e)
+                    return
+                except concurrent.futures.TimeoutError:
+                    self._reply_error(408, "scoring timed out")
+                    return
+                if kind == "embed":
+                    self._reply_json(openai_api.embeddings_reply(
+                        model, out, rows))
+                else:
+                    try:
+                        top = int(body.get("top", 5))
+                    except (TypeError, ValueError):
+                        self.send_error(400, "top must be an int")
+                        return
+                    self._reply_json(openai_api.classify_reply(
+                        model, out, rows, top))
+
             def do_POST(self):
+                route = self.path.split("?")[0].rstrip("/")
+                if route == "/v1/completions":
+                    try:
+                        faults.fire("restful.generate")
+                        self._v1_completions()
+                    except faults.InjectedHTTPError as e:
+                        self._reply_error(
+                            e.status, _status_text(e),
+                            retry_after=1 if e.status == 503
+                            else None)
+                    except Exception as e:
+                        self.send_error(500, _status_text(e))
+                    return
+                if route in ("/v1/embeddings", "/v1/classify"):
+                    try:
+                        faults.fire("restful.generate")
+                        self._v1_batch("embed"
+                                       if route == "/v1/embeddings"
+                                       else "score")
+                    except faults.InjectedHTTPError as e:
+                        self._reply_error(
+                            e.status, _status_text(e),
+                            retry_after=1 if e.status == 503
+                            else None)
+                    except Exception as e:
+                        self.send_error(500, _status_text(e))
+                    return
                 if self.path.rstrip("/") == "/shutdown":
                     # control-plane guard: when serving beyond loopback,
                     # only loopback peers (or a bearer of the admin
@@ -554,6 +865,39 @@ class RESTfulAPI(Unit):
                         if beam < 0:
                             self.send_error(400, "beam must be >= 1")
                             return
+                        priority = body.get("priority")
+                        if priority is not None:
+                            from veles_tpu.serving.scheduler import \
+                                resolve_priority
+                            try:
+                                resolve_priority(priority)
+                            except ValueError as e:
+                                self.send_error(400, _status_text(e))
+                                return
+                        if body.get("stream"):
+                            # SSE token streaming rides the serving
+                            # scheduler only (the legacy lockstep
+                            # decode has no incremental tokens)
+                            if beam:
+                                self.send_error(
+                                    400, "stream does not combine "
+                                    "with beam search")
+                                return
+                            if api.scheduler_ is None or steps < 1:
+                                self.send_error(
+                                    400, "stream: true needs the "
+                                    "serving scheduler and steps "
+                                    ">= 1")
+                                return
+                            if len(rows) != 1:
+                                self.send_error(
+                                    400, "stream: true needs a "
+                                    "single prompt row")
+                                return
+                            self._stream_generate(
+                                rows[0], steps, temperature, top_k,
+                                body.get("seed"), stop, priority)
+                            return
                         if beam:
                             if temperature or top_k:
                                 self.send_error(
@@ -597,7 +941,8 @@ class RESTfulAPI(Unit):
                             try:
                                 outs = api._generate_scheduled(
                                     rows, steps, temperature, top_k,
-                                    body.get("seed"), stop)
+                                    body.get("seed"), stop,
+                                    priority=priority)
                             except ValueError as e:
                                 self.send_error(400, _status_text(e))
                                 return

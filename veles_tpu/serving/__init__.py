@@ -50,7 +50,16 @@ per-token scan.  Here:
   prefix cache (SGLang lineage) over the paged block pools: finished
   requests donate their KV blocks, warm prompts skip prefill for
   every resident leading block and claim only their cold tail's
-  budget.
+  budget;
+- :mod:`veles_tpu.serving.streams` — per-request incremental token
+  delivery: ``submit(..., stream=True)`` returns a
+  :class:`TokenStream` the decode loop pushes accepted tokens into
+  (SSE surfaces on REST and the router proxies them chunk by chunk);
+- :mod:`veles_tpu.serving.openai_api` — the OpenAI-compatible facade
+  (``/v1/completions`` with streaming + usage, ``/v1/models``) and
+  the servable non-LM endpoints (batched ``/v1/embeddings`` pooled
+  hidden states, ``/v1/classify`` last-position class scores), both
+  executed on the decode loop's aux lane.
 """
 
 from veles_tpu.serving.engine import (  # noqa: F401
@@ -70,5 +79,9 @@ from veles_tpu.serving.fleet import (  # noqa: F401
     Fleet, LocalReplica, SubprocessReplica, free_port)
 from veles_tpu.serving.router import Router  # noqa: F401
 from veles_tpu.serving.scheduler import (  # noqa: F401
-    DeadlineExceededError, DrainingError, InferenceScheduler,
-    QueueFullError, RequestCancelledError, SchedulerError)
+    CLASS_NAMES, DeadlineExceededError, DrainingError,
+    InferenceScheduler, PRIORITIES, QueueFullError,
+    RequestCancelledError, SchedulerError, resolve_priority)
+from veles_tpu.serving.streams import (  # noqa: F401
+    SSE_DONE, StreamTimeoutError, TokenStream, sse_event)
+from veles_tpu.serving import openai_api  # noqa: F401

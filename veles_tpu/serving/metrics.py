@@ -135,6 +135,31 @@ def _registry_series():
             "veles_serving_prefix_blocks_shared",
             "resident blocks currently pinned by at least one "
             "in-flight request"),
+        # per-priority-class QoS series (low/normal/high): the
+        # observable contract of preemptive scheduling — high-class
+        # TTFT stays bounded BECAUSE low-class requests absorb the
+        # preemptions and sheds these count
+        "class_submitted": metrics.counter(
+            "veles_serving_class_requests_total",
+            "requests accepted into the queue, by priority class",
+            labelnames=("cls",)),
+        "class_completed": metrics.counter(
+            "veles_serving_class_completed_total",
+            "requests that finished decoding, by priority class",
+            labelnames=("cls",)),
+        "class_preempts": metrics.counter(
+            "veles_serving_class_preempts_total",
+            "mid-decode evictions, by the VICTIM's priority class",
+            labelnames=("cls",)),
+        "class_sheds": metrics.counter(
+            "veles_serving_class_sheds_total",
+            "requests shed (block pressure or a higher-class "
+            "arrival taking the seat), by the SHED class",
+            labelnames=("cls",)),
+        "class_ttft_ms": metrics.histogram(
+            "veles_serving_class_ttft_ms",
+            "submit-to-first-token latency by priority class (ms)",
+            labelnames=("cls",), buckets=MS_BUCKETS),
     }
 
 
@@ -183,6 +208,11 @@ def _router_series():
             "veles_router_replica_drains_total",
             "replica drains initiated through the router",
             labelnames=("replica",)),
+        "streams": metrics.counter(
+            "veles_router_streams_total",
+            "streaming (SSE) requests PINNED to a replica — no "
+            "retry or hedge once the first byte forwarded",
+            labelnames=("replica",)),
     }
 
 
@@ -201,6 +231,7 @@ class RouterMetrics:
         self.shed = 0
         self.restarts = 0
         self.drains = 0
+        self.streams = 0
         self._request_ms = Histogram("router_request_ms",
                                      buckets=MS_BUCKETS,
                                      reservoir=recent)
@@ -245,6 +276,11 @@ class RouterMetrics:
         events.record("router.breaker", "single", cls="Router",
                       replica=str(replica), to=state)
 
+    def record_stream(self, replica):
+        with self._lock:
+            self.streams += 1
+        self._global["streams"].labels(replica=str(replica)).inc()
+
     def record_request(self, ms):
         self._request_ms.observe(ms)
         self._global["request_ms"].observe(ms)
@@ -272,6 +308,7 @@ class RouterMetrics:
                 "hedges": self.hedges,
                 "hedge_wins": self.hedge_wins,
                 "shed": self.shed,
+                "streams_pinned": self.streams,
                 "replica_restarts": self.restarts,
                 "replica_drains": self.drains,
             }
@@ -310,15 +347,32 @@ class ServingMetrics:
         self._queued = Histogram("queued_ms", buckets=MS_BUCKETS,
                                  reservoir=recent)
         self._completions = deque(maxlen=recent)  # (t, tokens)
+        # per-priority-class counters + TTFT windows, created on the
+        # first request of each class (most deployments see one)
+        self._classes = {}
         self._t0 = time.monotonic()
         self._global = _registry_series()
 
+    def _class(self, cls):
+        """The per-class accumulator dict (lock held by callers of
+        the record_* methods that touch it)."""
+        rec = self._classes.get(cls)
+        if rec is None:
+            rec = self._classes[cls] = {
+                "submitted": 0, "completed": 0, "preempts": 0,
+                "sheds": 0,
+                "ttft": Histogram("class_ttft_ms",
+                                  buckets=MS_BUCKETS, reservoir=256)}
+        return rec
+
     # -- scheduler hooks ------------------------------------------------
 
-    def record_submit(self):
+    def record_submit(self, cls="normal"):
         with self._lock:
             self.submitted += 1
+            self._class(cls)["submitted"] += 1
         self._global["submitted"].inc()
+        self._global["class_submitted"].labels(cls=cls).inc()
 
     def record_reject(self, depth):
         with self._lock:
@@ -345,22 +399,28 @@ class ServingMetrics:
         events.record("serving.cancel", "single",
                       cls="InferenceScheduler", tokens=int(tokens))
 
-    def record_shed(self, queued_blocks):
+    def record_shed(self, queued_blocks, cls="normal"):
         with self._lock:
             self.shed += 1
             self.rejected += 1
+            self._class(cls)["sheds"] += 1
         self._global["shed"].inc()
         self._global["rejected"].inc()
+        self._global["class_sheds"].labels(cls=cls).inc()
         events.record("serving.shed", "single",
                       cls="InferenceScheduler",
-                      queued_blocks=int(queued_blocks))
+                      queued_blocks=int(queued_blocks),
+                      priority=cls)
 
-    def record_preempt(self, tokens):
+    def record_preempt(self, tokens, cls="normal"):
         with self._lock:
             self.preempts += 1
+            self._class(cls)["preempts"] += 1
         self._global["preempts"].inc()
+        self._global["class_preempts"].labels(cls=cls).inc()
         events.record("serving.preempt", "single",
-                      cls="InferenceScheduler", tokens=int(tokens))
+                      cls="InferenceScheduler", tokens=int(tokens),
+                      priority=cls)
 
     def record_resume(self, reprefill_tokens):
         with self._lock:
@@ -412,11 +472,14 @@ class ServingMetrics:
         self._global["prefix_resident"].set(int(resident))
         self._global["prefix_shared"].set(int(shared))
 
-    def record_first_token(self, ttft_ms, queued_ms):
+    def record_first_token(self, ttft_ms, queued_ms, cls="normal"):
         self._ttft.observe(ttft_ms)
         self._queued.observe(queued_ms)
+        with self._lock:
+            self._class(cls)["ttft"].observe(ttft_ms)
         self._global["ttft_ms"].observe(ttft_ms)
         self._global["queued_ms"].observe(queued_ms)
+        self._global["class_ttft_ms"].labels(cls=cls).observe(ttft_ms)
 
     def record_prefill_chunk(self, tokens, chunk_ms):
         with self._lock:
@@ -438,14 +501,16 @@ class ServingMetrics:
         self._global["total_steps"].inc(int(slots))
 
     def record_complete(self, req_tokens, duration_s, ttft_ms,
-                        queued_ms):
+                        queued_ms, cls="normal"):
         now = time.monotonic()
         with self._lock:
             self.completed += 1
             self.tokens_generated += int(req_tokens)
             self._completions.append((now, int(req_tokens)))
+            self._class(cls)["completed"] += 1
         self._global["completed"].inc()
         self._global["tokens"].inc(int(req_tokens))
+        self._global["class_completed"].labels(cls=cls).inc()
         events.record(
             "serving.request", "single", cls="InferenceScheduler",
             tokens=int(req_tokens), ttft_ms=round(ttft_ms, 3),
@@ -503,6 +568,15 @@ class ServingMetrics:
             }
         if kv:  # paged-cache occupancy (operator admission headroom)
             out.update(kv)
+        with self._lock:
+            out["classes"] = {
+                cls: {"submitted": rec["submitted"],
+                      "completed": rec["completed"],
+                      "preempts": rec["preempts"],
+                      "sheds": rec["sheds"],
+                      "ttft_ms_p50": rec["ttft"].percentile(0.50),
+                      "ttft_ms_p95": rec["ttft"].percentile(0.95)}
+                for cls, rec in self._classes.items()}
         out["ttft_ms_p50"] = self._ttft.percentile(0.50)
         out["ttft_ms_p95"] = self._ttft.percentile(0.95)
         out["ttft_ms_p99"] = self._ttft.percentile(0.99)

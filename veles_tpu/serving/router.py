@@ -48,6 +48,19 @@ registry) into fleet behavior:
   orchestrates drain → wait drained → restart → re-admit over the
   whole fleet with zero failed client requests.
 
+- **streaming + the OpenAI facade** — ``POST /generate`` /
+  ``/v1/completions`` bodies with ``"stream": true`` proxy as SSE
+  **chunk by chunk**: retries and hedging apply only until the
+  replica's response status line arrives — the FIRST forwarded byte
+  PINS the replica (tokens already delivered cannot be unsent, so
+  there is no transparent mid-stream failover; see
+  docs/robustness.md), and a client that disconnects mid-stream
+  tears down the upstream connection, which cancels the request on
+  the replica and frees its KV blocks.  ``/v1/completions``,
+  ``/v1/embeddings``, ``/v1/classify`` and ``GET /v1/models``
+  forward with the same affinity/retry/breaker machinery as
+  ``/generate``.
+
 Fault points ``router.forward`` and ``router.replica.health`` (keyed
 by replica id) wire the router into the injection registry; they run
 in the executor so a ``hang``/``delay`` stalls one attempt, not the
@@ -125,6 +138,10 @@ class _Replica(object):
                 "prefix_cache_hit_rate"),
             "spec_accept_rate": (self.last_metrics or {}).get(
                 "spec_accept_rate"),
+            # per-priority-class QoS counters (TTFT p95, preempts,
+            # sheds by class) straight off /serving/metrics — the
+            # observable half of preemptive scheduling
+            "classes": (self.last_metrics or {}).get("classes"),
         }
 
 
@@ -397,6 +414,13 @@ class Router(Logger):
             self._breaker_to(rep, "open")
 
     def _breaker_success(self, rep):
+        if rep.breaker == "open":
+            # a stale success from an attempt launched BEFORE the
+            # trip: the documented machine leaves `open` only via
+            # cooldown → half_open probe, so a late reply must not
+            # short-circuit recovery (it proves the replica was
+            # alive THEN, not that it recovered)
+            return
         rep.failures = 0
         if rep.breaker != "closed":
             self._breaker_to(rep, "closed")
@@ -411,15 +435,19 @@ class Router(Logger):
         return base * (0.5 + 0.5 * random.random())
 
     def _inspect(self, raw, headers):
-        """(idempotent, affinity_key) for a /generate body.  Greedy
-        and seed-pinned requests are idempotent (any replica answers
-        the same tokens); the affinity key is the session header or
-        the first ``affinity_tokens`` prompt tokens."""
+        """(idempotent, affinity_key, stream) for a forwarded body
+        (/generate and the /v1 facade).  Greedy and seed-pinned
+        requests are idempotent (any replica answers the same
+        tokens; embeddings/classify always are); the affinity key is
+        the session header or the first ``affinity_tokens`` prompt
+        tokens; ``stream`` marks SSE bodies for the pinning proxy."""
         try:
-            body = json.loads(raw.decode())
+            body = json.loads(raw.decode() or "{}")
             prompt = body.get("prompt")
+            if prompt is None:
+                prompt = body.get("input")
         except Exception:
-            return False, None  # the replica will 400 it
+            return False, None, False  # the replica will 400 it
         idempotent = not float(body.get("temperature") or 0.0) \
             or body.get("seed") is not None
         affinity = headers.get("x-veles-session")
@@ -427,9 +455,10 @@ class Router(Logger):
                 and isinstance(prompt, list) and prompt:
             row = prompt[0] if isinstance(prompt[0], list) else prompt
             affinity = repr(row[:self.affinity_tokens])
-        return idempotent, affinity
+        return idempotent, affinity, bool(body.get("stream"))
 
-    async def _attempt(self, rep, raw, headers, timeout):
+    async def _attempt(self, rep, raw, headers, timeout,
+                       path="/generate", method="POST"):
         """One forward, normalized to an :class:`_Outcome`, with the
         breaker/metrics accounting applied."""
         async def _payload():
@@ -441,7 +470,8 @@ class Router(Logger):
             if dropped:
                 raise ConnectionError("injected forward drop")
             return await self._http(
-                rep, "POST", "/generate", raw,
+                rep, method, path,
+                raw if method == "POST" else None,
                 {k: v for k, v in headers.items()
                  if k == "x-veles-session"})
 
@@ -481,13 +511,15 @@ class Router(Logger):
         return out
 
     async def _attempt_hedged(self, rep, raw, headers, timeout,
-                              idempotent, now):
+                              idempotent, now, path="/generate",
+                              method="POST"):
         """The primary attempt, hedged once against a second replica
         when the primary straggles past ``hedge_delay`` and the
         request is idempotent.  Returns the winning outcome (a
         deliverable one when either attempt produced it)."""
         primary = asyncio.ensure_future(
-            self._attempt(rep, raw, headers, timeout))
+            self._attempt(rep, raw, headers, timeout, path=path,
+                          method=method))
         if not idempotent or self.hedge_delay <= 0 \
                 or not self._pickable(now, exclude=(rep.id,)):
             return await primary
@@ -501,7 +533,8 @@ class Router(Logger):
             return await primary
         self.stats.record_hedge()
         hedge = asyncio.ensure_future(
-            self._attempt(rep2, raw, headers, timeout))
+            self._attempt(rep2, raw, headers, timeout, path=path,
+                          method=method))
         pending = {primary, hedge}
         best = None
         while pending:
@@ -518,12 +551,16 @@ class Router(Logger):
                 best = out
         return best
 
-    async def _forward_generate(self, raw, headers):
-        """The data-plane path: pick → attempt (hedged) → classify →
-        retry/shed, all bounded by the request deadline."""
+    async def _forward_request(self, path, raw, headers,
+                               method="POST"):
+        """The data-plane path (non-streaming): pick → attempt
+        (hedged) → classify → retry/shed, all bounded by the request
+        deadline."""
         t0 = time.monotonic()
         deadline = t0 + self.request_timeout
-        idempotent, affinity = self._inspect(raw, headers)
+        idempotent, affinity, _ = self._inspect(raw, headers)
+        if method == "GET":
+            idempotent = True
         best_tokens = None
         last = None
         attempts = 0
@@ -538,7 +575,8 @@ class Router(Logger):
             if attempts > 1:
                 self.stats.record_retry()
             out = await self._attempt_hedged(
-                rep, raw, headers, deadline - now, idempotent, now)
+                rep, raw, headers, deadline - now, idempotent, now,
+                path=path, method=method)
             if out.deliverable:
                 self.stats.record_request(
                     (time.monotonic() - t0) * 1e3)
@@ -582,6 +620,192 @@ class Router(Logger):
             retry_after=self.shed_retry_after
             if last.status == 503 else None,
             attempts=attempts, tokens_generated=best_tokens)
+
+    async def _http_begin(self, rep, method, path, body,
+                          headers=None):
+        """Open a replica request and return after the response
+        HEADERS arrive, leaving the body unread on the connection —
+        the streaming proxy's handle: ``(reader, writer, status,
+        rheaders)``.  The caller owns closing the writer."""
+        reader, writer = await asyncio.open_connection(rep.host,
+                                                       rep.port)
+        try:
+            blob = body if body is not None else b""
+            lines = ["%s %s HTTP/1.1" % (method, path),
+                     "Host: %s:%d" % (rep.host, rep.port),
+                     "Connection: close",
+                     "Content-Length: %d" % len(blob),
+                     "Content-Type: application/json"]
+            for k, v in (headers or {}).items():
+                lines.append("%s: %s" % (k, v))
+            writer.write(("\r\n".join(lines) + "\r\n\r\n").encode()
+                         + blob)
+            await writer.drain()
+            line = (await reader.readline()).decode("latin-1")
+            parts = line.split(None, 2)
+            if len(parts) < 2 or not parts[1].isdigit():
+                raise ConnectionError("bad status line %r" % line)
+            status = int(parts[1])
+            rheaders = {}
+            while True:
+                hline = await reader.readline()
+                if hline in (b"\r\n", b"\n", b""):
+                    break
+                key, _, val = hline.decode("latin-1").partition(":")
+                rheaders[key.strip().lower()] = val.strip()
+            return reader, writer, status, rheaders
+        except BaseException:
+            writer.close()
+            raise
+
+    async def _stream_proxy(self, path, headers, raw, writer):
+        """Proxy one streaming (SSE) request chunk by chunk.
+
+        Retries, backoff and replica selection apply only UNTIL a
+        replica's response status line arrives; the first forwarded
+        byte PINS the replica — tokens already delivered to the
+        client cannot be unsent, so there is no mid-stream failover
+        and no hedging (two replicas decoding one stream would bill
+        twice for idempotent output).  A mid-stream client disconnect
+        closes the upstream connection, which makes the replica's SSE
+        writer fail and CANCEL the request (slot + KV blocks free at
+        the next decode boundary).  Error replies (shed 503s, 4xx)
+        stay ordinary JSON — only a success opens the event stream."""
+        t0 = time.monotonic()
+        deadline = t0 + self.request_timeout
+        _, affinity, _ = self._inspect(raw, headers)
+        fwd = {k: v for k, v in headers.items()
+               if k == "x-veles-session"}
+        attempts = 0
+        last_status, last_body = None, b""
+        while attempts < self.retries:
+            now = time.monotonic()
+            if now >= deadline:
+                break
+            rep = self._pick(affinity, now)
+            if rep is None:
+                break
+            attempts += 1
+            if attempts > 1:
+                self.stats.record_retry()
+            rep.outstanding += 1
+            rep.requests += 1
+            upstream = up_writer = None
+            try:
+                try:
+                    dropped = await asyncio.get_running_loop() \
+                        .run_in_executor(None, faults.fire,
+                                         "router.forward", rep.id)
+                    if dropped:
+                        raise ConnectionError("injected forward drop")
+                    upstream, up_writer, status, rheaders = \
+                        await asyncio.wait_for(
+                            self._http_begin(rep, "POST", path, raw,
+                                             fwd),
+                            deadline - now)
+                except faults.InjectedHTTPError as e:
+                    status = e.status
+                    rheaders = {"content-type": "application/json"}
+                    last_body = json.dumps(
+                        {"error": {"code": status,
+                                   "message": str(e),
+                                   "injected": True}}).encode()
+                    upstream = None
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    self._breaker_failure(rep)
+                    self.stats.record_forward(rep.id, False)
+                    last_status, last_body = 502, b""
+                    continue
+                if status >= 500 and status != 503:
+                    self._breaker_failure(rep)
+                    self.stats.record_forward(rep.id, False)
+                    last_status = status
+                    if upstream is not None:
+                        try:
+                            last_body = await asyncio.wait_for(
+                                upstream.read(65536), 5.0)
+                        except Exception:
+                            last_body = b""
+                    continue
+                # the replica spoke: liveness proven (503 included)
+                self._breaker_success(rep)
+                self.stats.record_forward(rep.id, True)
+                if status == 503:
+                    try:
+                        after = float(rheaders.get("retry-after", 1))
+                    except ValueError:
+                        after = 1.0
+                    rep.saturated_until = now + min(after, 5.0)
+                # PIN: relay the reply — headers first, then bytes as
+                # they arrive (SSE frames for a 200, the structured
+                # JSON error body otherwise)
+                self.stats.record_stream(rep.id)
+                out = ["HTTP/1.1 %d %s" % (status, "OK"
+                                           if status == 200 else "X"),
+                       "Connection: close",
+                       "Content-Type: %s" % rheaders.get(
+                           "content-type", "application/json"),
+                       "X-Veles-Router-Attempts: %d" % attempts,
+                       "X-Veles-Replica: %s" % rheaders.get(
+                           "x-veles-replica", rep.id)]
+                if "content-length" in rheaders:
+                    out.append("Content-Length: %s"
+                               % rheaders["content-length"])
+                if "retry-after" in rheaders:
+                    out.append("Retry-After: %s"
+                               % rheaders["retry-after"])
+                writer.write(("\r\n".join(out) + "\r\n\r\n")
+                             .encode())
+                try:
+                    if upstream is None:   # injected reply, no socket
+                        writer.write(last_body)
+                        await writer.drain()
+                        return
+                    while True:
+                        chunk = await asyncio.wait_for(
+                            upstream.read(4096),
+                            max(1.0, deadline - time.monotonic()))
+                        if not chunk:
+                            break
+                        writer.write(chunk)
+                        await writer.drain()
+                except (ConnectionError, asyncio.IncompleteReadError,
+                        asyncio.TimeoutError):
+                    # client gone or replica stalled past the
+                    # deadline: drop the upstream connection — the
+                    # replica's SSE writer fails and cancels the
+                    # request, freeing its slot and blocks
+                    pass
+                finally:
+                    self.stats.record_request(
+                        (time.monotonic() - t0) * 1e3)
+                return
+            finally:
+                rep.outstanding -= 1
+                if up_writer is not None:
+                    up_writer.close()
+            # (unreachable: every branch above returns or continues)
+        # no replica ever produced a status line (or only 5xx) — shed
+        self.stats.record_request((time.monotonic() - t0) * 1e3)
+        if last_status is not None:
+            status, rheaders, rbody = self._error(
+                last_status, "replica error after %d attempt(s)"
+                % attempts, attempts=attempts)
+        else:
+            self.stats.record_shed()
+            status, rheaders, rbody = self._error(
+                503, "no eligible replica (fleet saturated, "
+                "draining or open)",
+                retry_after=self.shed_retry_after,
+                attempts=attempts, shed=True)
+        out = ["HTTP/1.1 %d X" % status, "Connection: close",
+               "Content-Length: %d" % len(rbody)]
+        out += ["%s: %s" % (k, v) for k, v in rheaders.items()]
+        writer.write(("\r\n".join(out) + "\r\n\r\n").encode()
+                     + rbody)
+        await writer.drain()
 
     # -- health polling --------------------------------------------------
 
@@ -688,9 +912,17 @@ class Router(Logger):
             headers["Retry-After"] = str(max(1, int(retry_after)))
         return int(code), headers, json.dumps({"error": err}).encode()
 
+    #: POST paths proxied to the replicas (streaming bodies divert
+    #: to the pinning proxy in _serve_conn)
+    FORWARD_POSTS = ("/generate", "/v1/completions",
+                     "/v1/embeddings", "/v1/classify")
+
     async def _route(self, method, path, headers, body):
-        if method == "POST" and path == "/generate":
-            return await self._forward_generate(body, headers)
+        if method == "POST" and path in self.FORWARD_POSTS:
+            return await self._forward_request(path, body, headers)
+        if method == "GET" and path == "/v1/models":
+            return await self._forward_request(path, b"", headers,
+                                               method="GET")
         if method == "GET" and path == "/healthz":
             state = await self._state()
             ok = state["eligible"] > 0
@@ -730,6 +962,13 @@ class Router(Logger):
             body = await reader.readexactly(length) if length \
                 else b""
             path = target.split("?")[0].rstrip("/") or "/"
+            if method == "POST" and path in self.FORWARD_POSTS \
+                    and self._inspect(body, headers)[2]:
+                # SSE streaming: the proxy writes the whole client
+                # response itself (headers relay chunk by chunk;
+                # first forwarded byte pins the replica)
+                await self._stream_proxy(path, headers, body, writer)
+                return
             try:
                 status, rheaders, rbody = await self._route(
                     method, path, headers, body)

@@ -77,8 +77,11 @@ plus the correction sample reproduces the spec-off stream
 bit-for-bit (greedy AND seeded; the verify samples fold the same
 per-request draw counters), rejected tails roll back logically
 (their K/V rows sit past the accepted length, masked until
-overwritten), and the occupancy/depth bucket ladder grows a
-power-of-two k axis pre-compiled at :meth:`start`.  The **radix
+overwritten), and the occupancy/depth bucket ladder grows ONE
+fixed-width draft axis (k = ``spec_k``; shorter draft sets pad and
+``lens`` masks them) pre-compiled at :meth:`start` — one verify
+executable per (B, T) instead of a per-k ladder, which halves the
+warmup compile count the flipped-on default would otherwise pay.  The **radix
 prefix cache** (``prefix_cache`` + ``prefix_evict``;
 :mod:`veles_tpu.serving.prefix_cache`) makes KV blocks
 cross-request: finished requests donate their written blocks,
@@ -87,6 +90,24 @@ the resident rows and chunk-prefill only the cold tail, claim only
 ``ceil(cold_tokens / block_size)`` new blocks (cache hits raise max
 concurrent streams), and refcount-0 residents LRU-evict under pool
 pressure.
+
+Delivery and QoS (the streaming/priority layer, see
+:mod:`veles_tpu.serving.streams`): ``submit(..., stream=True)``
+returns a :class:`~veles_tpu.serving.streams.TokenStream` the decode
+loop pushes every ACCEPTED token into at the same boundary it appends
+to ``generated`` — per-token latency for clients, spec bursts back to
+back, nothing emitted twice across a preempt→resume.  Every request
+carries a **priority class** (``low`` / ``normal`` / ``high``, default
+normal): the queue is ordered by class (FIFO within one), block-
+pressure shedding trips EARLIER for lower classes (the 503's
+Retry-After also grows as the class drops), a full queue evicts the
+youngest queued lower-class request to seat a higher one, and a
+high-class arrival that cannot admit preempts the youngest active
+LOWER-class request through the generalized
+:meth:`request_preempt` victim selection — the victim resumes
+bit-identically (the PR 7 contract), it just waits out the burst.
+Per-class TTFT/preempt/shed counters ride
+``veles_serving_class_*``.
 
 Config knobs (``root.common.serving.*``, overridable per scheduler):
 ``kv`` ("paged"/"dense"), ``block_size`` (tokens per KV block,
@@ -120,6 +141,40 @@ from veles_tpu.serving.prefill import (
     serving_window)
 from veles_tpu.serving.prefix_cache import RadixPrefixCache
 from veles_tpu.serving.spec import NgramProposer, accept_drafts
+from veles_tpu.serving.streams import TokenStream
+
+#: priority classes, lowest to highest; ints in [0, 2] also accepted
+PRIORITIES = {"low": 0, "normal": 1, "high": 2}
+CLASS_NAMES = ("low", "normal", "high")
+#: block-pressure shed trips at shed_block_factor x this fraction —
+#: the LOW class sheds at half the documented budget, NORMAL at
+#: exactly it (the pre-priority contract, unchanged), HIGH gets 1.5x
+#: headroom so an overload sacrifices low-class work first
+_SHED_FRAC = (0.5, 1.0, 1.5)
+#: class-aware Retry-After seconds on a shed 503 (a shed low-class
+#: client should back off longest — its work is what the overload
+#: sacrifices first)
+_RETRY_AFTER = (4, 2, 1)
+
+
+def resolve_priority(value):
+    """Normalize a client priority (class name or int) to [0, 2];
+    ``None`` means normal.  Raises ``ValueError`` on junk — a typo'd
+    priority must be a client error, not silently-normal service."""
+    if value is None:
+        return PRIORITIES["normal"]
+    if isinstance(value, str):
+        try:
+            return PRIORITIES[value.lower()]
+        except KeyError:
+            raise ValueError(
+                "priority must be one of %s (or an int in [0, 2])"
+                % "/".join(CLASS_NAMES))
+    if isinstance(value, bool) or not isinstance(value, int):
+        raise ValueError("priority must be a class name or int")
+    if not 0 <= value <= 2:
+        raise ValueError("priority int must be in [0, 2]")
+    return value
 
 
 class SchedulerError(Exception):
@@ -176,10 +231,10 @@ class _Request(object):
                  "generated", "cancelled", "preempts", "t_submit",
                  "t_admit", "t_first", "pf_seq", "pf_caches",
                  "pf_off", "pf_width", "pf_chunk", "pf_matched",
-                 "prefix_handle")
+                 "prefix_handle", "priority", "sink")
 
     def __init__(self, prompt, steps, temperature, top_k, stop_token,
-                 seed, deadline):
+                 seed, deadline, priority=1, sink=None):
         self.prompt = prompt
         self.steps = steps
         self.temperature = temperature
@@ -187,6 +242,8 @@ class _Request(object):
         self.stop_token = stop_token
         self.seed = seed
         self.deadline = deadline
+        self.priority = int(priority)   # 0 low / 1 normal / 2 high
+        self.sink = sink                # TokenStream._push (or None)
         self.future = concurrent.futures.Future()
         self.slot = None
         self.generated = []
@@ -344,7 +401,9 @@ class InferenceScheduler(Logger):
         self._closed = False
         self._draining = False
         self._drained = threading.Event()
-        self._preempt_n = 0          # evictions the loop owes
+        self._preempts_owed = []     # eviction demands (class bound
+        #                              per entry; None = any victim)
+        self._aux = collections.deque()  # embed/score jobs (loop-run)
         self._queued_blocks = 0      # block budget committed in-queue
         self._beat = None            # loop-iteration heartbeat stamp
         self._working = False        # loop mid-iteration (not parked)
@@ -392,7 +451,8 @@ class InferenceScheduler(Logger):
         return self
 
     def submit(self, prompt, steps, temperature=0.0, top_k=0,
-               seed=None, stop_token=None, timeout=None):
+               seed=None, stop_token=None, timeout=None,
+               priority=None, stream=False):
         """Queue one sequence for decoding; returns a Future whose
         result is the full token list (prompt + generated, ending at
         the first generated stop token if one fired).  ``timeout``
@@ -401,10 +461,19 @@ class InferenceScheduler(Logger):
         mid-decode frees the slot/blocks and fails the future with
         :class:`DeadlineExceededError`).
 
+        ``priority`` ("low"/"normal"/"high" or 0–2, default normal)
+        sets the request's QoS class: admission order, shed
+        threshold/Retry-After, and preemption victimhood are all
+        class-aware (module docstring).  ``stream=True`` returns a
+        :class:`~veles_tpu.serving.streams.TokenStream` (its
+        ``.future`` is the same future the plain path returns)
+        yielding tokens as they are accepted.
+
         Raises ``ValueError`` on malformed requests (client errors),
         :class:`QueueFullError` when admission control rejects (queue
         depth, block-pressure shed, or :class:`DrainingError` once a
         drain began)."""
+        prio = resolve_priority(priority)
         prompt = [int(t) for t in prompt]
         steps = int(steps)
         if not prompt:
@@ -431,12 +500,15 @@ class InferenceScheduler(Logger):
             seed = int.from_bytes(os.urandom(4), "little")
         ttl = float(timeout or self.request_timeout
                     or self.queue_timeout or 0)
+        ts = TokenStream(prompt) if stream else None
         req = _Request(
             prompt, steps, temperature, top_k,
             int(stop_token) if stop_token is not None else None,
             int(seed) & 0xFFFFFFFF,
-            time.monotonic() + ttl if ttl > 0 else None)
+            time.monotonic() + ttl if ttl > 0 else None,
+            priority=prio, sink=ts._push if ts is not None else None)
         need = self._blocks_for(req)
+        cls = CLASS_NAMES[prio]
         with self._wake:
             if self._closed:
                 raise SchedulerError("scheduler is closed")
@@ -445,28 +517,79 @@ class InferenceScheduler(Logger):
                 # and takes nothing new — callers retry elsewhere
                 self.stats.record_reject(len(self._queue))
                 raise DrainingError("scheduler is draining")
-            if len(self._queue) >= self.max_queue:
+            if len(self._queue) >= self.max_queue \
+                    and not self._evict_queued_locked(prio):
                 self.stats.record_reject(len(self._queue))
-                raise QueueFullError(
+                err = QueueFullError(
                     "serving queue full (%d waiting)"
                     % len(self._queue))
+                err.retry_after = _RETRY_AFTER[prio]
+                raise err
             if self.kv == "paged" and self.shed_block_factor > 0 \
                     and self._queued_blocks + need \
-                    > self.shed_block_factor * self.kv_blocks:
-                # block-pressure shed: the queue already holds more
-                # committed KV budget than the pool can turn over
-                # soon — a deterministic 503 beats a guaranteed 408
-                self.stats.record_shed(self._queued_blocks)
-                raise QueueFullError(
+                    > self.shed_block_factor * _SHED_FRAC[prio] \
+                    * self.kv_blocks:
+                # block-pressure shed, LOW class first: each class
+                # trips at its own fraction of the factor, so as
+                # pressure builds the overload sacrifices low-class
+                # work while high-class admission still has headroom
+                # — and a shed low client backs off longer
+                self.stats.record_shed(self._queued_blocks, cls=cls)
+                err = QueueFullError(
                     "overloaded: %d KV blocks committed in-queue "
-                    "(pool %d, shed factor %.1f)"
-                    % (self._queued_blocks, self.kv_blocks,
-                       self.shed_block_factor))
-            self.stats.record_submit()
-            self._queue.append(req)
+                    "(pool %d, %s-class shed at factor %.1f)"
+                    % (self._queued_blocks, self.kv_blocks, cls,
+                       self.shed_block_factor * _SHED_FRAC[prio]))
+                err.retry_after = _RETRY_AFTER[prio]
+                raise err
+            self.stats.record_submit(cls=cls)
+            self._enqueue_locked(req)
             self._queued_blocks += need
             self._wake.notify()
+        if ts is not None:
+            ts._bind(self, req.future)
+            return ts
         return req.future
+
+    def _enqueue_locked(self, req, front=False):
+        """Insert one request into the class-ordered queue (highest
+        class first, FIFO within a class); ``front=True`` requeues a
+        preempted victim at the head of ITS class so it resumes
+        before later same-class arrivals."""
+        q = self._queue
+        if front:
+            i = 0
+            while i < len(q) and q[i].priority > req.priority:
+                i += 1
+        else:
+            i = len(q)
+            while i > 0 and q[i - 1].priority < req.priority:
+                i -= 1
+        q.insert(i, req)
+
+    def _evict_queued_locked(self, prio):
+        """Depth-cap relief for a higher-class arrival: shed the
+        YOUNGEST queued strictly-lower-class request (it loses the
+        least wait) and report whether a seat opened.  The victim
+        gets the same structured 503 + its class's Retry-After a
+        front-door shed would have given it."""
+        victim = None
+        for req in reversed(self._queue):
+            if req.priority < prio:
+                victim = req
+                break
+        if victim is None:
+            return False
+        self._queue.remove(victim)
+        self._queued_blocks -= self._blocks_for(victim)
+        vcls = CLASS_NAMES[victim.priority]
+        self.stats.record_shed(self._queued_blocks, cls=vcls)
+        err = QueueFullError(
+            "shed while queued: a higher-priority request took the "
+            "last queue seat")
+        err.retry_after = _RETRY_AFTER[victim.priority]
+        victim.fail(err)
+        return True
 
     def _blocks_for(self, req):
         """The paged block budget a request commits (0 when dense)."""
@@ -505,15 +628,92 @@ class InferenceScheduler(Logger):
             self.stats.record_cancel(len(victim.generated))
         return True
 
-    def request_preempt(self, n=1):
+    def request_preempt(self, n=1, below=None):
         """Ask the loop to evict ``n`` active requests at the next
-        decode boundary (youngest first): each victim's blocks return
-        to the pool, its generated prefix is kept, and it requeues at
-        the FRONT to resume via re-prefill — the mechanism priority
-        scheduling builds on."""
+        decode boundary: victim selection takes the LOWEST priority
+        class first, youngest within it (it loses the least
+        re-prefill work).  ``below`` bounds victimhood to requests of
+        priority strictly under it (a demand with no qualifying
+        victim is dropped); ``None`` preempts from any class.  Each
+        victim's blocks return to the pool, its generated prefix is
+        kept, and it requeues at the front of its class to resume via
+        re-prefill — the mechanism priority scheduling drives."""
         with self._wake:
-            self._preempt_n += int(n)
+            self._preempts_owed.extend(
+                [None if below is None else int(below)] * int(n))
             self._wake.notify()
+
+    def submit_embed(self, rows):
+        """Queue ONE batched embedding job (``/v1/embeddings``):
+        ``rows`` are non-empty token lists; the future resolves to a
+        list of pooled unit-norm vectors (see
+        :func:`serving.openai_api.embed_pool`).  The job runs on the
+        decode loop BETWEEN decode boundaries — embeddings share the
+        engine without breaking the one-jax-thread invariant."""
+        return self._submit_aux("embed", rows)
+
+    def submit_score(self, rows):
+        """Queue ONE batched classifier-scoring job
+        (``/v1/classify``): the future resolves to per-row class
+        log-probabilities from the full chain's last-position
+        logits."""
+        return self._submit_aux("score", rows)
+
+    def _submit_aux(self, kind, rows):
+        rows = [[int(t) for t in r] for r in rows]
+        if not rows or any(not r for r in rows):
+            raise ValueError("input must be non-empty token rows")
+        widest = max(len(r) for r in rows)
+        if widest > self.window:
+            raise ValueError(
+                "input row of %d tokens exceeds the serving window "
+                "(%d)" % (widest, self.window))
+        if kind == "embed":
+            from veles_tpu.serving.openai_api import embed_supported
+            if not embed_supported(self.forwards):
+                raise ValueError("chain cannot serve embeddings")
+        fut = concurrent.futures.Future()
+        with self._wake:
+            if self._closed:
+                raise SchedulerError("scheduler is closed")
+            if self._draining:
+                raise DrainingError("scheduler is draining")
+            if len(self._aux) >= self.max_queue:
+                self.stats.record_reject(len(self._aux))
+                raise QueueFullError(
+                    "aux queue full (%d waiting)" % len(self._aux))
+            self._aux.append((kind, rows, fut))
+            self._wake.notify()
+        return fut
+
+    def _aux_tick(self):
+        """Run ONE queued embed/score job (one jitted pass) at this
+        boundary — like a prefill chunk, it delays in-flight decode by
+        a single bounded pass, not by the whole aux backlog."""
+        with self._lock:
+            if not self._aux:
+                return
+            kind, rows, fut = self._aux.popleft()
+        if fut.done():   # consumer already gave up
+            return
+        from veles_tpu.serving.openai_api import (
+            pooled_embeddings, score_rows)
+        try:
+            faults.fire("serving.scheduler.aux")
+            if kind == "embed":
+                out = pooled_embeddings(self.forwards, rows,
+                                        self.window)
+            else:
+                out = score_rows(self.forwards, rows, self.window)
+        except Exception as e:
+            fut.set_exception(
+                e if isinstance(e, SchedulerError)
+                else SchedulerError(repr(e)))
+            return
+        try:
+            fut.set_result(out)
+        except concurrent.futures.InvalidStateError:
+            pass
 
     def drain(self, timeout=None):
         """Begin a graceful drain: admission closes (submits raise
@@ -525,7 +725,8 @@ class InferenceScheduler(Logger):
         with self._wake:
             first = not self._draining
             self._draining = True
-            if not (self._queue or self._active or self._prefilling):
+            if not (self._queue or self._active or self._prefilling
+                    or self._aux):
                 self._drained.set()
             self._wake.notify()
         if first:
@@ -550,7 +751,8 @@ class InferenceScheduler(Logger):
         prefilling + decoding)."""
         with self._lock:
             return len(self._queue) + len(self._prefilling) \
-                + len(self._active) + len(self._admitting)
+                + len(self._active) + len(self._admitting) \
+                + len(self._aux)
 
     def _kv_snapshot(self):
         out = {"kv_mode": self.kv,
@@ -627,11 +829,19 @@ class InferenceScheduler(Logger):
         with self._lock:
             pending = list(self._queue) + list(self._prefilling) \
                 + list(self._active.values()) + list(self._admitting)
+            aux = list(self._aux)
             self._queue.clear()
             self._prefilling = []
             self._active.clear()
             self._admitting = []
+            self._aux.clear()
             self._queued_blocks = 0
+        for _, _, fut in aux:
+            if not fut.done():
+                try:
+                    fut.set_exception(err)
+                except concurrent.futures.InvalidStateError:
+                    pass
         cache = self.cache_ if loop_dead else None
         for req in pending:
             if req.slot is not None and cache is not None:
@@ -669,9 +879,9 @@ class InferenceScheduler(Logger):
                           for n in range(1, self.max_slots + 1)})
         depths = sorted({_bucket(n, 1, cache.blocks_per_slot)
                          for n in range(1, cache.blocks_per_slot + 1)})
-        ks = sorted({_bucket(x, 1, self.spec_k)
-                     for x in range(1, self.spec_k + 1)}) \
-            if self.spec else []
+        # the verify grid rides ONE fixed draft width (shorter draft
+        # sets pad up; lens masks) — see _step_verify
+        ks = [self.spec_k] if self.spec else []
         t0 = time.monotonic()
         for b in buckets:
             for t in depths:
@@ -724,7 +934,8 @@ class InferenceScheduler(Logger):
                 self._working = False
                 while not self._closed and not self._queue \
                         and not self._active and not self._prefilling \
-                        and not self._preempt_n:
+                        and not self._preempts_owed \
+                        and not self._aux:
                     if self._draining:
                         self._drained.set()
                     self._wake.wait()
@@ -749,6 +960,18 @@ class InferenceScheduler(Logger):
                         break
                     admits.append(req)
                     self._admitting.append(req)
+                # priority preemption: the head of the class-ordered
+                # queue outranks an active lower-class request but
+                # could not admit — owe ONE eviction at this boundary
+                # (one per iteration bounds thrash; the victim's
+                # freed blocks seat the head at the next boundary)
+                if self._queue and not self._preempts_owed:
+                    head = self._queue[0]
+                    if head.priority > 0 \
+                            and not self._can_admit(cache, head) \
+                            and any(r.priority < head.priority
+                                    for r in self._active.values()):
+                        self._preempts_owed.append(head.priority)
             # jax work OUTSIDE the lock: submit() must never block on
             # a device step
             faults.fire("serving.scheduler.loop")
@@ -759,6 +982,8 @@ class InferenceScheduler(Logger):
                 self._begin_admit(req, cache)
                 with self._lock:
                     self._admitting.remove(req)
+            if self._aux:
+                self._aux_tick()
             if self._prefilling:
                 self._prefill_tick(cache)
             if self._active:
@@ -906,29 +1131,37 @@ class InferenceScheduler(Logger):
         self._sync_kv_gauges(cache)
 
     def _do_preempts(self, cache):
-        """Evict owed preemptions at this decode boundary: youngest
-        active request first (it loses the least re-prefill work and
-        is what a priority scheduler would sacrifice for an older or
-        higher-class request).  The victim keeps its generated prefix
-        and requeues at the FRONT, so it resumes as soon as its own
-        freed blocks (or better) are available."""
+        """Evict owed preemptions at this decode boundary: lowest
+        priority class first, youngest within it (it loses the least
+        re-prefill work — exactly what a priority scheduler should
+        sacrifice for a higher-class arrival).  A demand bounded to
+        ``below`` with no strictly-lower-class victim is dropped.
+        The victim keeps its generated prefix and requeues at the
+        front of ITS class, so it resumes as soon as its own freed
+        blocks (or better) are available."""
         while True:
             with self._lock:
-                if not self._preempt_n:
+                if not self._preempts_owed:
                     return
                 if not self._active:
-                    self._preempt_n = 0  # demand dies with no targets
-                    return
-                self._preempt_n -= 1
-                req = max(self._active.values(),
-                          key=lambda r: (r.t_admit, r.slot))
+                    del self._preempts_owed[:]  # no targets: demand
+                    return                      # dies here
+                below = self._preempts_owed.pop(0)
+                victims = [r for r in self._active.values()
+                           if below is None or r.priority < below]
+                if not victims:
+                    continue   # bounded demand, no qualifying victim
+                req = max(victims,
+                          key=lambda r: (-r.priority, r.t_admit,
+                                         r.slot))
                 self._active.pop(req.slot, None)
             self._release_slot(req, cache)
             req.preempts += 1
-            self.stats.record_preempt(len(req.generated))
+            self.stats.record_preempt(len(req.generated),
+                                      cls=CLASS_NAMES[req.priority])
             self._sync_kv_gauges(cache)
             with self._lock:
-                self._queue.appendleft(req)
+                self._enqueue_locked(req, front=True)
                 self._queued_blocks += self._blocks_for(req)
 
     def _watchdog_loop(self):
@@ -1142,12 +1375,13 @@ class InferenceScheduler(Logger):
         tok = int(numpy.asarray(first_tokens(
             last, [req.temperature], [req.top_k], [req.seed],
             counts=[len(req.generated)]))[0])
-        req.generated.append(tok)
+        self._emit(req, tok)
         if req.t_first is None:  # TTFT is the FIRST first-token only
             req.t_first = time.monotonic()
             self.stats.record_first_token(
                 (req.t_first - req.t_submit) * 1e3,
-                (req.t_admit - req.t_submit) * 1e3)
+                (req.t_admit - req.t_submit) * 1e3,
+                cls=CLASS_NAMES[req.priority])
         with self._lock:
             self._active[req.slot] = req
         self._maybe_finish(req, cache)
@@ -1164,6 +1398,16 @@ class InferenceScheduler(Logger):
             self._step_paged(cache, active)
         else:
             self._step_dense(cache, active)
+
+    def _emit(self, req, tok):
+        """Accept one token: append to the request's stream AND push
+        it to the live subscription (submit(stream=True)) in the same
+        boundary — what makes SSE concatenation bit-identical to the
+        batch reply (a preempt-resume re-prefills but never re-emits;
+        only newly drawn tokens pass through here)."""
+        req.generated.append(tok)
+        if req.sink is not None:
+            req.sink(tok)
 
     def _fill_row(self, arrays, j, req):
         toks, pos, temps, topks, seeds, counts = arrays
@@ -1224,7 +1468,7 @@ class InferenceScheduler(Logger):
         self.stats.record_step(n, b)
         for j, slot in enumerate(slots):
             req = active[slot]
-            req.generated.append(int(nxt[j]))
+            self._emit(req, int(nxt[j]))
             self._maybe_finish(req, cache)
 
     def _step_verify(self, cache, active, drafts):
@@ -1239,8 +1483,12 @@ class InferenceScheduler(Logger):
         slots = sorted(active)
         n = len(slots)
         b = _bucket(n, 1, self.max_slots)
-        k = _bucket(max(len(d) for d in drafts.values()), 1,
-                    self.spec_k)
+        # fixed draft width: every verify pass runs at k = spec_k
+        # (lens masks the padding) so there is exactly ONE verify
+        # executable per (B, T) — a per-k ladder would 4x the warmup
+        # compile count for a bandwidth-bound step whose width
+        # barely moves its cost
+        k = self.spec_k
         bs = cache.block_size
         deepest = max(len(active[s].prompt)
                       + len(active[s].generated) for s in slots) + k
@@ -1277,7 +1525,7 @@ class InferenceScheduler(Logger):
             if d:
                 self.stats.record_spec(len(d), len(out) - 1)
             for tok in out:
-                req.generated.append(int(tok))
+                self._emit(req, int(tok))
                 if len(req.generated) >= req.steps \
                         or (req.stop_token is not None
                             and int(tok) == req.stop_token):
@@ -1301,7 +1549,7 @@ class InferenceScheduler(Logger):
             counts))
         self.stats.record_step(len(active), s)
         for slot, req in active.items():
-            req.generated.append(int(nxt[slot]))
+            self._emit(req, int(nxt[slot]))
             self._maybe_finish(req, cache)
 
     def _maybe_finish(self, req, cache, error=None):
@@ -1328,7 +1576,8 @@ class InferenceScheduler(Logger):
         self.stats.record_complete(
             len(req.generated), now - req.t_submit,
             (req.t_first - req.t_submit) * 1e3,
-            (req.t_admit - req.t_submit) * 1e3)
+            (req.t_admit - req.t_submit) * 1e3,
+            cls=CLASS_NAMES[req.priority])
         try:
             req.future.set_result(list(req.prompt) + req.generated)
         except concurrent.futures.InvalidStateError:
