@@ -1211,6 +1211,7 @@ def bench_router(dev, replica_counts=(1, 2, 4),
     agg = {}
     ttft = {}
     errors = 0
+    router_slo = None
     for n in replica_counts:
         router = Router(health_interval=0.5,
                         request_timeout=600.0).start()
@@ -1248,6 +1249,12 @@ def bench_router(dev, replica_counts=(1, 2, 4),
             dt = time.perf_counter() - t0
             agg[str(n)] = round(done[0] / dt, 1)
             errors += fails[0]
+            # the fleet-tail SLO block (PR 11): per-class e2e
+            # good/bad + burn rates off /router/state, kept for the
+            # largest fleet (the shape production runs)
+            state = json.load(urllib.request.urlopen(
+                url + "/router/state", timeout=30))
+            router_slo = state["router"].get("slo")
         finally:
             fleet.stop()
             router.stop()
@@ -1257,6 +1264,7 @@ def bench_router(dev, replica_counts=(1, 2, 4),
         "router_scaling_2x": round(agg["2"] / agg["1"], 3)
         if "1" in agg and "2" in agg and agg["1"] else None,
         "router_errors": errors,
+        "router_slo": router_slo,
         "router_cores": os.cpu_count(),
         "router_config": {
             "d_model": d_model, "layers": layers, "heads": heads,
@@ -1349,6 +1357,9 @@ def bench_streaming(dev):
         out["streaming_class_preempts"] = {
             cls: rec["preempts"]
             for cls, rec in snap["classes"].items()}
+        # per-class SLO accounting (PR 11): good/bad counts + the
+        # multi-window burn rates against root.common.slo.*
+        out["streaming_slo"] = snap.get("slo")
         out["streaming_config"] = {
             "d_model": d_model, "layers": layers, "heads": heads,
             "vocab": vocab, "window": window, "block_size": block,

@@ -229,6 +229,31 @@ root.common.update({
     # crash flight recorder (telemetry/flight_recorder.py): bundle
     # lands in `dir` (default: the snapshot dir) on crash/SIGUSR1
     "flightrec": {"enabled": True, "dir": None, "dump_on_exit": False},
+    # per-request distributed tracing (telemetry/reqtrace.py): trace
+    # ids minted at the edge (or accepted via X-Veles-Trace),
+    # propagated router -> replica -> scheduler, phase spans appended
+    # to the JSONL event sink.  ON by default; overhead is gated in
+    # tier-1 (<5%, the tracing_overhead marker).  Disabling stops the
+    # span emission only — ids still mint and echo, so client-side
+    # correlation keeps working
+    "reqtrace": {"enabled": True},
+    # serving SLOs (serving/metrics.py SLOTracker): per-priority-class
+    # latency objectives in ms — ttft_ms gates submit->first-token at
+    # the replica, e2e_ms gates whole-request time (replica-side AND
+    # the router's all-attempts fleet tail); None disables a class.
+    # target is the success ratio whose complement is the error
+    # budget; windows are the trailing burn-rate horizons in seconds
+    # (multi-window: pair a fast window for paging with a slow one
+    # for ticketing).  Exported as the veles_slo_* families and the
+    # "slo" block of /serving/metrics and /router/state
+    "slo": {
+        "enabled": True,
+        "target": 0.99,
+        "windows": (60.0, 300.0, 3600.0),
+        "ttft_ms": {"low": 5000.0, "normal": 2000.0, "high": 500.0},
+        "e2e_ms": {"low": 120000.0, "normal": 60000.0,
+                   "high": 30000.0},
+    },
     # continuous-batching serving knobs (serving/scheduler.py):
     # kv "paged"|"dense"; kv_blocks None derives the dense-equivalent
     # pool (max_slots * ceil(window / block_size)); prefill_chunk 0

@@ -20,6 +20,7 @@ import numpy
 from veles_tpu import faults
 from veles_tpu.loader.interactive import InteractiveLoader
 from veles_tpu.memory import Array
+from veles_tpu.telemetry import reqtrace
 from veles_tpu.units import Unit
 
 
@@ -206,7 +207,7 @@ class RESTfulAPI(Unit):
                             stop_token=stop_token)
 
     def _generate_scheduled(self, rows, steps, temperature, top_k,
-                            seed, stop, priority=None):
+                            seed, stop, priority=None, trace=None):
         """Decode a /generate body through the continuous-batching
         scheduler: every prompt row is its own request (ragged batches
         interleave in the slots like independent clients).  Returns
@@ -226,7 +227,7 @@ class RESTfulAPI(Unit):
                     row, steps, temperature=temperature, top_k=top_k,
                     seed=None if seed is None else int(seed) + i,
                     stop_token=stop, timeout=self.request_timeout,
-                    priority=priority))
+                    priority=priority, trace=trace))
             # the scheduler enforces the deadline itself (408 with
             # partial-token count); the result wait is only a backstop
             # against a wedged loop with the watchdog disabled
@@ -311,10 +312,35 @@ class RESTfulAPI(Unit):
                 auth = self.headers.get("Authorization", "")
                 return hmac.compare_digest(auth, "Bearer %s" % token)
 
+            def _trace(self):
+                """The request's trace id: the sanitized client
+                ``X-Veles-Trace`` header (direct hit or forwarded by
+                the router) or a freshly minted edge id — cached per
+                request so headers and body frames all carry ONE
+                id."""
+                tid = getattr(self, "_trace_", None)
+                if tid is None:
+                    tid = self._trace_ = reqtrace.ensure_trace_id(
+                        self.headers.get(reqtrace.TRACE_HEADER))
+                return tid
+
             def do_GET(self):
                 # drop any query string BEFORE trimming the trailing
                 # slash — load-balancer probes send /healthz?probe=1
+                self._trace_ = None  # fresh id per request
                 route = self.path.split("?")[0].rstrip("/")
+                if route == "/debug/requests":
+                    # the LIVE in-flight request table: trace id,
+                    # phase, class, age, tokens, blocks held — the
+                    # per-request half /debug/state's aggregates lack
+                    sch = api.scheduler_
+                    self._reply_json({
+                        "replica": api.replica_id,
+                        "draining": bool(api._draining_),
+                        "requests": sch.debug_requests()
+                        if sch is not None else [],
+                    })
+                    return
                 if route == "/serving/metrics":
                     if api.scheduler_ is None:
                         self.send_error(404, "no serving scheduler")
@@ -396,6 +422,8 @@ class RESTfulAPI(Unit):
                 if api.replica_id:
                     self.send_header("X-Veles-Replica",
                                      str(api.replica_id))
+                self.send_header(reqtrace.TRACE_HEADER,
+                                 self._trace())
                 self.send_header("Content-Length", str(len(blob)))
                 self.end_headers()
                 self.wfile.write(blob)
@@ -403,11 +431,14 @@ class RESTfulAPI(Unit):
             def _reply_error(self, code, message, retry_after=None,
                              **extra):
                 """Structured error reply: ``{"error": {"code",
-                "message", ...}}``; a 503's Retry-After header tells
-                retrying clients (and the future router) when this
-                replica is worth another attempt."""
+                "message", "trace_id", ...}}``; a 503's Retry-After
+                header tells retrying clients (and the router) when
+                this replica is worth another attempt, and the trace
+                id makes the FAILURE correlatable with the server-
+                side phase timeline — not just successes."""
                 err = {"code": int(code),
-                       "message": str(message or "")}
+                       "message": str(message or ""),
+                       "trace_id": self._trace()}
                 err.update({k: v for k, v in extra.items()
                             if v is not None})
                 blob = json.dumps({"error": err},
@@ -417,6 +448,8 @@ class RESTfulAPI(Unit):
                 if api.replica_id:
                     self.send_header("X-Veles-Replica",
                                      str(api.replica_id))
+                self.send_header(reqtrace.TRACE_HEADER,
+                                 self._trace())
                 if retry_after is not None:
                     self.send_header("Retry-After",
                                      str(max(1, int(retry_after))))
@@ -456,6 +489,8 @@ class RESTfulAPI(Unit):
                 if api.replica_id:
                     self.send_header("X-Veles-Replica",
                                      str(api.replica_id))
+                self.send_header(reqtrace.TRACE_HEADER,
+                                 self._trace())
                 self.end_headers()
                 self.close_connection = True
 
@@ -467,12 +502,17 @@ class RESTfulAPI(Unit):
                 disconnects mid-stream CANCELS the request — its slot
                 and KV blocks return to the pool at the next decode
                 boundary instead of decoding for nobody."""
+                import time as _time
+
                 from veles_tpu.serving.scheduler import SchedulerError
                 from veles_tpu.serving.streams import (
                     SSE_DONE, StreamTimeoutError, sse_event)
                 # backstop against a wedged loop with the watchdog
                 # off: stop waiting, cancel, tell the client
                 ts.token_timeout = api.request_timeout + 30.0
+                tron = api.scheduler_ is not None \
+                    and api.scheduler_._tron
+                t0 = _time.monotonic()
                 self._sse_headers()
                 err = None
                 try:
@@ -481,6 +521,12 @@ class RESTfulAPI(Unit):
                         self.wfile.flush()
                 except (BrokenPipeError, ConnectionError, OSError):
                     ts.cancel()
+                    if tron:
+                        reqtrace.record(
+                            ts.trace, "stream",
+                            duration=_time.monotonic() - t0,
+                            tokens=len(ts.tokens),
+                            outcome="disconnect")
                     return
                 except StreamTimeoutError as e:
                     ts.cancel()
@@ -493,6 +539,15 @@ class RESTfulAPI(Unit):
                     self.wfile.flush()
                 except (BrokenPipeError, ConnectionError, OSError):
                     pass
+                if tron:
+                    # the delivery span: how long the wire emission
+                    # ran and how many tokens it carried
+                    reqtrace.record(
+                        ts.trace, "stream",
+                        duration=_time.monotonic() - t0,
+                        tokens=len(ts.tokens),
+                        outcome="ok" if err is None
+                        else type(err).__name__)
 
             def _stream_generate(self, row, steps, temperature,
                                  top_k, seed, stop, priority):
@@ -509,7 +564,8 @@ class RESTfulAPI(Unit):
                         seed=None if seed is None else int(seed),
                         stop_token=stop,
                         timeout=api.request_timeout,
-                        priority=priority, stream=True)
+                        priority=priority, stream=True,
+                        trace=self._trace())
                 except ValueError as e:
                     self.send_error(400, _status_text(e))
                     return
@@ -518,13 +574,18 @@ class RESTfulAPI(Unit):
                     return
 
                 def final(err):
+                    # terminal/usage frames carry the trace id so a
+                    # streamed reply (success OR failure) correlates
+                    # with the server-side phase timeline
                     if err is not None:
                         return {"error": {
                             "code": getattr(err, "http_status", 500),
                             "message": _status_text(err),
+                            "trace_id": ts.trace,
                             "tokens_generated": len(ts.tokens)}}
                     return {"done": True,
                             "tokens": ts.prompt + ts.tokens,
+                            "trace_id": ts.trace,
                             "usage": {
                                 "prompt_tokens": len(ts.prompt),
                                 "completion_tokens": len(ts.tokens),
@@ -586,7 +647,7 @@ class RESTfulAPI(Unit):
                             stop_token=params["stop"],
                             timeout=api.request_timeout,
                             priority=params["priority"],
-                            stream=True)
+                            stream=True, trace=self._trace())
                     except ValueError as e:
                         self.send_error(400, _status_text(e))
                         return
@@ -603,14 +664,16 @@ class RESTfulAPI(Unit):
                             return {"error": {
                                 "code": getattr(err, "http_status",
                                                 500),
-                                "message": _status_text(err)}}
+                                "message": _status_text(err),
+                                "trace_id": ts.trace}}
                         return openai_api.completion_chunk(
                             cid, created, model, 0, [],
                             finish=openai_api.finish_reason(
                                 ts.tokens, params["steps"],
                                 params["stop"]),
                             usage=openai_api.usage_of(
-                                rows, [len(ts.tokens)]))
+                                rows, [len(ts.tokens)]),
+                            trace_id=ts.trace)
 
                     self._relay_sse(ts, chunk, final)
                     return
@@ -618,7 +681,8 @@ class RESTfulAPI(Unit):
                     outs = api._generate_scheduled(
                         rows, params["steps"], params["temperature"],
                         params["top_k"], params["seed"],
-                        params["stop"], priority=params["priority"])
+                        params["stop"], priority=params["priority"],
+                        trace=self._trace())
                 except ValueError as e:
                     self.send_error(400, _status_text(e))
                     return
@@ -694,6 +758,7 @@ class RESTfulAPI(Unit):
                         model, out, rows, top))
 
             def do_POST(self):
+                self._trace_ = None  # fresh id per request
                 route = self.path.split("?")[0].rstrip("/")
                 if route == "/v1/completions":
                     try:
@@ -942,7 +1007,8 @@ class RESTfulAPI(Unit):
                                 outs = api._generate_scheduled(
                                     rows, steps, temperature, top_k,
                                     body.get("seed"), stop,
-                                    priority=priority)
+                                    priority=priority,
+                                    trace=self._trace())
                             except ValueError as e:
                                 self.send_error(400, _status_text(e))
                                 return

@@ -26,6 +26,166 @@ def _pct(sorted_vals, q):
     return nearest_rank(sorted_vals, q)
 
 
+# -- SLO accounting -----------------------------------------------------------
+
+#: priority class names (local copy — the scheduler imports this
+#: module, so it cannot be imported back for its CLASS_NAMES)
+_SLO_CLASSES = ("low", "normal", "high")
+
+
+def _slo_conf():
+    """The effective SLO config (``root.common.slo.*``): per-class
+    latency objectives in ms for TTFT and whole-request (e2e) time, a
+    success-ratio ``target`` whose complement is the error budget,
+    and the burn-rate ``windows`` in seconds."""
+    from veles_tpu.config import root
+    slo = root.common.slo
+    return {
+        "enabled": bool(slo.get("enabled", True)),
+        "target": float(slo.get("target", 0.99)),
+        "windows": tuple(float(w) for w in
+                         slo.get("windows", (60.0, 300.0, 3600.0))),
+        "ttft_ms": {c: slo.ttft_ms.get(c, None)
+                    for c in _SLO_CLASSES},
+        "e2e_ms": {c: slo.e2e_ms.get(c, None)
+                   for c in _SLO_CLASSES},
+    }
+
+
+def _slo_series():
+    return {
+        "good": metrics.counter(
+            "veles_slo_requests_good_total",
+            "requests that met their class's latency objective, by "
+            "scope (serving TTFT/e2e at the replica, e2e at the "
+            "router), class and objective kind",
+            labelnames=("scope", "cls", "slo")),
+        "bad": metrics.counter(
+            "veles_slo_requests_bad_total",
+            "requests that MISSED their class's latency objective — "
+            "the numerator of the burn rate",
+            labelnames=("scope", "cls", "slo")),
+        "burn": metrics.gauge(
+            "veles_slo_burn_rate",
+            "error-budget burn rate over a trailing window: "
+            "(bad fraction in window) / (1 - target); 1.0 burns the "
+            "budget exactly at the objective rate, >1 burns faster "
+            "(multi-window alerting pairs a fast and a slow window)",
+            labelnames=("scope", "cls", "slo", "window")),
+        "objective": metrics.gauge(
+            "veles_slo_objective_ms",
+            "the configured latency objective (root.common.slo.*), "
+            "exported so dashboards need no config access",
+            labelnames=("scope", "cls", "slo")),
+    }
+
+
+class SLOTracker:
+    """Per-class latency-SLO accounting: good/bad counters plus
+    multi-window burn-rate gauges (the SRE alerting pair), configured
+    from ``root.common.slo.*`` at construction.  ``scope`` labels the
+    exported series ("serving" for replica-side TTFT/e2e, "router"
+    for the fleet-tail e2e clients actually see).  Thread-safe; one
+    observation is a lock, a deque append and two counter bumps."""
+
+    #: per-(cls, kind) observation window cap — at the largest
+    #: default window (1 h) this bounds memory, and a saturated ring
+    #: still yields a correct burn rate over the events it holds
+    _RING = 4096
+
+    def __init__(self, scope):
+        conf = _slo_conf()
+        self.scope = str(scope)
+        self.enabled = conf["enabled"]
+        self.target = conf["target"]
+        self.windows = conf["windows"]
+        self.objectives = {"ttft": conf["ttft_ms"],
+                           "e2e": conf["e2e_ms"]}
+        self._budget = max(1e-9, 1.0 - self.target)
+        self._lock = threading.Lock()
+        self._events = {}   # (cls, kind) -> deque[(t, bad)]
+        self._good = {}
+        self._bad = {}
+        self._global = _slo_series()
+        if self.enabled:
+            for kind, by_cls in self.objectives.items():
+                for cls, obj in by_cls.items():
+                    if obj is not None:
+                        self._global["objective"].labels(
+                            scope=self.scope, cls=cls,
+                            slo=kind).set(float(obj))
+
+    def record(self, cls, kind, ms):
+        """One finished observation: ``kind`` in {"ttft", "e2e"},
+        ``ms`` the measured latency.  No objective configured for the
+        class (or SLOs disabled) means no accounting."""
+        if not self.enabled:
+            return
+        obj = self.objectives.get(kind, {}).get(cls)
+        if obj is None:
+            return
+        bad = float(ms) > float(obj)
+        now = time.monotonic()
+        key = (cls, kind)
+        with self._lock:
+            ring = self._events.get(key)
+            if ring is None:
+                ring = self._events[key] = deque(maxlen=self._RING)
+            ring.append((now, bad))
+            if bad:
+                self._bad[key] = self._bad.get(key, 0) + 1
+            else:
+                self._good[key] = self._good.get(key, 0) + 1
+        self._global["bad" if bad else "good"].labels(
+            scope=self.scope, cls=cls, slo=kind).inc()
+        self._refresh_burn(key, now)
+
+    def _burn_rates(self, key, now):
+        """Burn rate per window from the bounded ring: bad fraction
+        in the trailing window divided by the error budget."""
+        with self._lock:
+            ring = list(self._events.get(key, ()))
+        out = {}
+        for w in self.windows:
+            recent = [bad for t, bad in ring if now - t <= w]
+            rate = (sum(recent) / len(recent) / self._budget) \
+                if recent else 0.0
+            out["%ds" % int(w)] = round(rate, 4)
+        return out
+
+    def _refresh_burn(self, key, now):
+        cls, kind = key
+        for w, rate in zip(self.windows,
+                           self._burn_rates(key, now).values()):
+            self._global["burn"].labels(
+                scope=self.scope, cls=cls, slo=kind,
+                window="%ds" % int(w)).set(rate)
+
+    def snapshot(self):
+        """JSON view for ``/serving/metrics`` / ``/router/state`` /
+        bench.py: objectives, good/bad counts and the current
+        multi-window burn rates per class and kind."""
+        now = time.monotonic()
+        with self._lock:
+            keys = list(self._events)
+            good = dict(self._good)
+            bad = dict(self._bad)
+        out = {"enabled": self.enabled, "target": self.target,
+               "windows_s": [int(w) for w in self.windows],
+               "objectives_ms": {
+                   k: {c: v for c, v in by.items() if v is not None}
+                   for k, by in self.objectives.items()},
+               "classes": {}}
+        for key in keys:
+            cls, kind = key
+            rec = out["classes"].setdefault(cls, {})
+            rec[kind] = {"good": good.get(key, 0),
+                         "bad": bad.get(key, 0),
+                         "burn_rate": self._burn_rates(key, now)}
+            self._refresh_burn(key, now)
+        return out
+
+
 def _registry_series():
     return {
         "submitted": metrics.counter(
@@ -236,6 +396,10 @@ class RouterMetrics:
                                      buckets=MS_BUCKETS,
                                      reservoir=recent)
         self._global = _router_series()
+        #: fleet-tail SLO: whole-request (all attempts + backoff)
+        #: latency vs the per-class e2e objective — what the CLIENT
+        #: experiences, as opposed to the replica-side view
+        self.slo = SLOTracker("router")
 
     def record_forward(self, replica, ok):
         outcome = "ok" if ok else "error"
@@ -281,9 +445,10 @@ class RouterMetrics:
             self.streams += 1
         self._global["streams"].labels(replica=str(replica)).inc()
 
-    def record_request(self, ms):
+    def record_request(self, ms, cls="normal"):
         self._request_ms.observe(ms)
         self._global["request_ms"].observe(ms)
+        self.slo.record(cls, "e2e", ms)
 
     def record_restart(self, replica):
         with self._lock:
@@ -315,6 +480,7 @@ class RouterMetrics:
         out["request_ms_p50"] = self._request_ms.percentile(0.50)
         out["request_ms_p95"] = self._request_ms.percentile(0.95)
         out["request_ms_p99"] = self._request_ms.percentile(0.99)
+        out["slo"] = self.slo.snapshot()
         return out
 
 
@@ -352,6 +518,9 @@ class ServingMetrics:
         self._classes = {}
         self._t0 = time.monotonic()
         self._global = _registry_series()
+        #: replica-side SLO accounting (TTFT + e2e vs the per-class
+        #: objectives under root.common.slo.*)
+        self.slo = SLOTracker("serving")
 
     def _class(self, cls):
         """The per-class accumulator dict (lock held by callers of
@@ -381,25 +550,28 @@ class ServingMetrics:
         events.record("serving.reject", "single",
                       cls="InferenceScheduler", queue_depth=depth)
 
-    def record_expire(self, queued_ms, tokens=0):
+    def record_expire(self, queued_ms, tokens=0, trace=None):
         """A request crossed its deadline — queued (tokens=0, the 408
         admission case) or mid-decode (tokens = generated so far)."""
         with self._lock:
             self.expired += 1
         self._global["expired"].inc()
+        attrs = {"trace": trace} if trace else {}
         events.record("serving.expire", "single",
                       cls="InferenceScheduler",
                       queued_ms=round(queued_ms, 3),
-                      tokens=int(tokens))
+                      tokens=int(tokens), **attrs)
 
-    def record_cancel(self, tokens):
+    def record_cancel(self, tokens, trace=None):
         with self._lock:
             self.cancelled += 1
         self._global["cancelled"].inc()
+        attrs = {"trace": trace} if trace else {}
         events.record("serving.cancel", "single",
-                      cls="InferenceScheduler", tokens=int(tokens))
+                      cls="InferenceScheduler", tokens=int(tokens),
+                      **attrs)
 
-    def record_shed(self, queued_blocks, cls="normal"):
+    def record_shed(self, queued_blocks, cls="normal", trace=None):
         with self._lock:
             self.shed += 1
             self.rejected += 1
@@ -407,20 +579,22 @@ class ServingMetrics:
         self._global["shed"].inc()
         self._global["rejected"].inc()
         self._global["class_sheds"].labels(cls=cls).inc()
+        attrs = {"trace": trace} if trace else {}
         events.record("serving.shed", "single",
                       cls="InferenceScheduler",
                       queued_blocks=int(queued_blocks),
-                      priority=cls)
+                      priority=cls, **attrs)
 
-    def record_preempt(self, tokens, cls="normal"):
+    def record_preempt(self, tokens, cls="normal", trace=None):
         with self._lock:
             self.preempts += 1
             self._class(cls)["preempts"] += 1
         self._global["preempts"].inc()
         self._global["class_preempts"].labels(cls=cls).inc()
+        attrs = {"trace": trace} if trace else {}
         events.record("serving.preempt", "single",
                       cls="InferenceScheduler", tokens=int(tokens),
-                      priority=cls)
+                      priority=cls, **attrs)
 
     def record_resume(self, reprefill_tokens):
         with self._lock:
@@ -480,6 +654,7 @@ class ServingMetrics:
         self._global["ttft_ms"].observe(ttft_ms)
         self._global["queued_ms"].observe(queued_ms)
         self._global["class_ttft_ms"].labels(cls=cls).observe(ttft_ms)
+        self.slo.record(cls, "ttft", ttft_ms)
 
     def record_prefill_chunk(self, tokens, chunk_ms):
         with self._lock:
@@ -501,7 +676,7 @@ class ServingMetrics:
         self._global["total_steps"].inc(int(slots))
 
     def record_complete(self, req_tokens, duration_s, ttft_ms,
-                        queued_ms, cls="normal"):
+                        queued_ms, cls="normal", trace=None):
         now = time.monotonic()
         with self._lock:
             self.completed += 1
@@ -511,13 +686,15 @@ class ServingMetrics:
         self._global["completed"].inc()
         self._global["tokens"].inc(int(req_tokens))
         self._global["class_completed"].labels(cls=cls).inc()
+        self.slo.record(cls, "e2e", duration_s * 1e3)
+        attrs = {"trace": trace} if trace else {}
         events.record(
             "serving.request", "single", cls="InferenceScheduler",
             tokens=int(req_tokens), ttft_ms=round(ttft_ms, 3),
             queued_ms=round(queued_ms, 3),
             duration_ms=round(duration_s * 1e3, 3),
             tokens_per_sec=round(req_tokens / duration_s, 1)
-            if duration_s > 0 else None)
+            if duration_s > 0 else None, **attrs)
 
     # -- reads ----------------------------------------------------------
 
@@ -583,4 +760,5 @@ class ServingMetrics:
         out["queued_ms_p50"] = self._queued.percentile(0.50)
         tps = self.recent_tokens_per_sec()
         out["tokens_per_sec_recent"] = round(tps, 1) if tps else None
+        out["slo"] = self.slo.snapshot()
         return out

@@ -305,10 +305,12 @@ def completion_reply(cid, created, model, choices, usage):
 
 
 def completion_chunk(cid, created, model, index, tokens,
-                     finish=None, usage=None):
+                     finish=None, usage=None, trace_id=None):
     """One SSE chunk of a streaming completion: the newly accepted
-    tokens (spec bursts arrive together), finish_reason/usage only on
-    the terminal chunk (the OpenAI shape)."""
+    tokens (spec bursts arrive together), finish_reason/usage — and
+    the request ``trace_id`` for server-side correlation — only on
+    the terminal chunk (the OpenAI shape, plus the non-standard
+    trace field this tokenizer-free engine adds)."""
     out = {"id": cid, "object": "text_completion", "created": created,
            "model": model,
            "choices": [{"index": index, "text": text_of(tokens),
@@ -316,6 +318,8 @@ def completion_chunk(cid, created, model, index, tokens,
                         "finish_reason": finish, "logprobs": None}]}
     if usage is not None:
         out["usage"] = usage
+    if trace_id is not None:
+        out["trace_id"] = trace_id
     return out
 
 

@@ -61,6 +61,17 @@ registry) into fleet behavior:
   forward with the same affinity/retry/breaker machinery as
   ``/generate``.
 
+- **request tracing + SLOs** — every request gets a trace id at the
+  edge (``X-Veles-Trace``, accepted-or-minted, echoed on EVERY reply
+  including structured errors) that is propagated to the replica; the
+  routed request is a ``router.request`` span and each retry/hedge
+  attempt a ``router.attempt`` child span in the JSONL event sink
+  (merge with the replica logs via ``telemetry.trace_export
+  --request <id>``).  ``GET /debug/requests`` lists the live
+  in-flight proxy table, and ``/router/state`` carries the fleet-tail
+  SLO block (per-class e2e good/bad + multi-window burn rates,
+  ``root.common.slo.*``).
+
 Fault points ``router.forward`` and ``router.replica.health`` (keyed
 by replica id) wire the router into the injection registry; they run
 in the executor so a ``hang``/``delay`` stalls one attempt, not the
@@ -73,6 +84,7 @@ kwarg); see ``config.py`` for the full table.
 """
 
 import asyncio
+import itertools
 import json
 import random
 import threading
@@ -80,8 +92,10 @@ import time
 import zlib
 
 from veles_tpu import faults
-from veles_tpu.logger import Logger
+from veles_tpu.logger import Logger, events
 from veles_tpu.serving.metrics import RouterMetrics
+from veles_tpu.telemetry import reqtrace
+from veles_tpu.telemetry.spans import next_span_id
 
 #: outcomes the router hands to the client as-is (2xx/3xx/4xx — the
 #: replica spoke; 5xx and transport errors are the router's to mask)
@@ -224,8 +238,14 @@ class Router(Logger):
             _router_conf("shed_retry_after", 2)
             if shed_retry_after is None else shed_retry_after)
         self.stats = RouterMetrics()
+        #: request tracing (telemetry/reqtrace.py), read once — the
+        #: per-attempt gate is an attribute test
+        self._tron = reqtrace.enabled()
         self._seed_replicas = [tuple(r) for r in replicas]
         self._replicas = {}        # id -> _Replica (loop thread only)
+        self._inflight = {}        # seq -> live request info (loop
+        #                            thread only, like _replicas)
+        self._req_seq = itertools.count(1)
         self._lock = threading.Lock()
         self._loop = None
         self._thread = None
@@ -250,6 +270,8 @@ class Router(Logger):
         for spec in self._seed_replicas:
             self.add_replica(*spec)
         self._ready.set()
+        # flight-recorder / debug surface (weakly held)
+        reqtrace.register("router", self)
         self.info("router on http://%s:%d -> %d replica(s)",
                   self.host, self.port, len(self._seed_replicas))
         return self
@@ -435,19 +457,21 @@ class Router(Logger):
         return base * (0.5 + 0.5 * random.random())
 
     def _inspect(self, raw, headers):
-        """(idempotent, affinity_key, stream) for a forwarded body
-        (/generate and the /v1 facade).  Greedy and seed-pinned
+        """(idempotent, affinity_key, stream, cls) for a forwarded
+        body (/generate and the /v1 facade).  Greedy and seed-pinned
         requests are idempotent (any replica answers the same
         tokens; embeddings/classify always are); the affinity key is
         the session header or the first ``affinity_tokens`` prompt
-        tokens; ``stream`` marks SSE bodies for the pinning proxy."""
+        tokens; ``stream`` marks SSE bodies for the pinning proxy;
+        ``cls`` is the priority class name (SLO accounting — the
+        replica still authoritatively validates it)."""
         try:
             body = json.loads(raw.decode() or "{}")
             prompt = body.get("prompt")
             if prompt is None:
                 prompt = body.get("input")
         except Exception:
-            return False, None, False  # the replica will 400 it
+            return False, None, False, "normal"  # replica will 400 it
         idempotent = not float(body.get("temperature") or 0.0) \
             or body.get("seed") is not None
         affinity = headers.get("x-veles-session")
@@ -455,12 +479,26 @@ class Router(Logger):
                 and isinstance(prompt, list) and prompt:
             row = prompt[0] if isinstance(prompt[0], list) else prompt
             affinity = repr(row[:self.affinity_tokens])
-        return idempotent, affinity, bool(body.get("stream"))
+        prio = body.get("priority")
+        if isinstance(prio, int) and not isinstance(prio, bool) \
+                and 0 <= prio <= 2:
+            cls = ("low", "normal", "high")[prio]
+        elif isinstance(prio, str) \
+                and prio.lower() in ("low", "normal", "high"):
+            cls = prio.lower()
+        else:
+            cls = "normal"
+        return idempotent, affinity, bool(body.get("stream")), cls
 
     async def _attempt(self, rep, raw, headers, timeout,
-                       path="/generate", method="POST"):
+                       path="/generate", method="POST", trace=None,
+                       attempt=0, hedge=False):
         """One forward, normalized to an :class:`_Outcome`, with the
-        breaker/metrics accounting applied."""
+        breaker/metrics accounting applied.  Each attempt — retries
+        and hedges alike — is its OWN child span (``router.attempt``
+        begin/end pair carrying the trace id, attempt number and
+        replica), so the merged Chrome trace shows exactly which
+        replica each leg of a retried request ran on."""
         async def _payload():
             # executor: an armed hang/delay stalls this attempt (and
             # times out below like any straggler), not the event loop
@@ -473,8 +511,15 @@ class Router(Logger):
                 rep, method, path,
                 raw if method == "POST" else None,
                 {k: v for k, v in headers.items()
-                 if k == "x-veles-session"})
+                 if k in ("x-veles-session", "x-veles-trace")})
 
+        span = None
+        if self._tron and trace is not None:
+            span = next_span_id()
+            events.record("router.attempt", "begin", cls="Router",
+                          span=span, trace=trace, attempt=attempt,
+                          replica=rep.id, hedge=hedge)
+        t0 = time.monotonic()
         rep.outstanding += 1
         rep.requests += 1
         try:
@@ -486,13 +531,29 @@ class Router(Logger):
                 # a replica that REPLIES an error (http_error action)
                 out = _Outcome(rep, e.status, {}, json.dumps(
                     {"error": {"code": e.status, "message": str(e),
-                               "injected": True}}).encode())
+                               "injected": True,
+                               "trace_id": trace}}).encode())
             except asyncio.CancelledError:
+                if span is not None:
+                    events.record("router.attempt", "end",
+                                  cls="Router", span=span,
+                                  trace=trace, attempt=attempt,
+                                  replica=rep.id, hedge=hedge,
+                                  duration=time.monotonic() - t0,
+                                  outcome="cancelled")
                 raise
             except Exception as e:
                 out = _Outcome(rep, error=e)
         finally:
             rep.outstanding -= 1
+        if span is not None:
+            events.record("router.attempt", "end", cls="Router",
+                          span=span, trace=trace, attempt=attempt,
+                          replica=rep.id, hedge=hedge,
+                          duration=time.monotonic() - t0,
+                          status=out.status,
+                          outcome="ok" if out.error is None
+                          else type(out.error).__name__)
         now = time.monotonic()
         if out.error is not None \
                 or (out.status >= 500 and out.status != 503):
@@ -512,14 +573,15 @@ class Router(Logger):
 
     async def _attempt_hedged(self, rep, raw, headers, timeout,
                               idempotent, now, path="/generate",
-                              method="POST"):
+                              method="POST", trace=None, attempt=0):
         """The primary attempt, hedged once against a second replica
         when the primary straggles past ``hedge_delay`` and the
         request is idempotent.  Returns the winning outcome (a
         deliverable one when either attempt produced it)."""
         primary = asyncio.ensure_future(
             self._attempt(rep, raw, headers, timeout, path=path,
-                          method=method))
+                          method=method, trace=trace,
+                          attempt=attempt))
         if not idempotent or self.hedge_delay <= 0 \
                 or not self._pickable(now, exclude=(rep.id,)):
             return await primary
@@ -534,7 +596,8 @@ class Router(Logger):
         self.stats.record_hedge()
         hedge = asyncio.ensure_future(
             self._attempt(rep2, raw, headers, timeout, path=path,
-                          method=method))
+                          method=method, trace=trace,
+                          attempt=attempt, hedge=True))
         pending = {primary, hedge}
         best = None
         while pending:
@@ -552,15 +615,43 @@ class Router(Logger):
         return best
 
     async def _forward_request(self, path, raw, headers,
-                               method="POST"):
+                               method="POST", trace=None):
         """The data-plane path (non-streaming): pick → attempt
         (hedged) → classify → retry/shed, all bounded by the request
-        deadline."""
+        deadline.  The whole routed request is a ``router.request``
+        span parenting one ``router.attempt`` span per try, and it
+        sits in the live in-flight table (``GET /debug/requests``)
+        until answered."""
         t0 = time.monotonic()
         deadline = t0 + self.request_timeout
-        idempotent, affinity, _ = self._inspect(raw, headers)
+        idempotent, affinity, _, cls = self._inspect(raw, headers)
         if method == "GET":
             idempotent = True
+        root_span = None
+        if self._tron and trace is not None:
+            root_span = next_span_id()
+            events.record("router.request", "begin", cls="Router",
+                          span=root_span, trace=trace, path=path)
+        seq = next(self._req_seq)
+        info = {"trace": trace, "path": path, "t0": t0,
+                "attempts": 0, "replica": None, "stream": False,
+                "cls": cls}
+        self._inflight[seq] = info
+        try:
+            return await self._forward_attempts(
+                path, raw, headers, method, trace, t0, deadline,
+                idempotent, affinity, cls, info)
+        finally:
+            self._inflight.pop(seq, None)
+            if root_span is not None:
+                events.record("router.request", "end", cls="Router",
+                              span=root_span, trace=trace, path=path,
+                              duration=time.monotonic() - t0,
+                              attempts=info["attempts"])
+
+    async def _forward_attempts(self, path, raw, headers, method,
+                                trace, t0, deadline, idempotent,
+                                affinity, cls, info):
         best_tokens = None
         last = None
         attempts = 0
@@ -572,18 +663,23 @@ class Router(Logger):
             if rep is None:
                 break  # fleet-level shed (or nothing left to try)
             attempts += 1
+            info["attempts"] = attempts
+            info["replica"] = rep.id
             if attempts > 1:
                 self.stats.record_retry()
             out = await self._attempt_hedged(
                 rep, raw, headers, deadline - now, idempotent, now,
-                path=path, method=method)
+                path=path, method=method, trace=trace,
+                attempt=attempts)
             if out.deliverable:
                 self.stats.record_request(
-                    (time.monotonic() - t0) * 1e3)
+                    (time.monotonic() - t0) * 1e3, cls=cls)
                 rheaders = {
                     "Content-Type": out.headers.get(
                         "content-type", "application/json"),
                     "X-Veles-Router-Attempts": str(attempts)}
+                if trace is not None:
+                    rheaders["X-Veles-Trace"] = trace
                 if "x-veles-replica" in out.headers:
                     rheaders["X-Veles-Replica"] = \
                         out.headers["x-veles-replica"]
@@ -602,24 +698,26 @@ class Router(Logger):
                 break
             await asyncio.sleep(delay)
         # every attempt failed (or none was possible) — shed/report
-        self.stats.record_request((time.monotonic() - t0) * 1e3)
+        self.stats.record_request((time.monotonic() - t0) * 1e3,
+                                  cls=cls)
         if last is None:
             self.stats.record_shed()
             return self._error(
                 503, "no eligible replica (fleet saturated, "
                 "draining or open)", retry_after=self.shed_retry_after,
-                attempts=attempts, shed=True)
+                attempts=attempts, shed=True, trace=trace)
         if last.error is not None:
             return self._error(
                 502, "replica unreachable after %d attempt(s): %s"
                 % (attempts, last.error), attempts=attempts,
-                tokens_generated=best_tokens)
+                tokens_generated=best_tokens, trace=trace)
         return self._error(
             last.status, "replica error after %d attempt(s)"
             % attempts,
             retry_after=self.shed_retry_after
             if last.status == 503 else None,
-            attempts=attempts, tokens_generated=best_tokens)
+            attempts=attempts, tokens_generated=best_tokens,
+            trace=trace)
 
     async def _http_begin(self, rep, method, path, body,
                           headers=None):
@@ -658,7 +756,8 @@ class Router(Logger):
             writer.close()
             raise
 
-    async def _stream_proxy(self, path, headers, raw, writer):
+    async def _stream_proxy(self, path, headers, raw, writer,
+                            trace=None):
         """Proxy one streaming (SSE) request chunk by chunk.
 
         Retries, backoff and replica selection apply only UNTIL a
@@ -673,9 +772,35 @@ class Router(Logger):
         stay ordinary JSON — only a success opens the event stream."""
         t0 = time.monotonic()
         deadline = t0 + self.request_timeout
-        _, affinity, _ = self._inspect(raw, headers)
+        _, affinity, _, cls = self._inspect(raw, headers)
         fwd = {k: v for k, v in headers.items()
-               if k == "x-veles-session"}
+               if k in ("x-veles-session", "x-veles-trace")}
+        root_span = None
+        if self._tron and trace is not None:
+            root_span = next_span_id()
+            events.record("router.request", "begin", cls="Router",
+                          span=root_span, trace=trace, path=path,
+                          stream=True)
+        seq = next(self._req_seq)
+        info = {"trace": trace, "path": path, "t0": t0,
+                "attempts": 0, "replica": None, "stream": True,
+                "cls": cls}
+        self._inflight[seq] = info
+        try:
+            await self._stream_attempts(
+                path, raw, writer, trace, t0, deadline, affinity,
+                cls, fwd, info)
+        finally:
+            self._inflight.pop(seq, None)
+            if root_span is not None:
+                events.record("router.request", "end", cls="Router",
+                              span=root_span, trace=trace, path=path,
+                              stream=True,
+                              duration=time.monotonic() - t0,
+                              attempts=info["attempts"])
+
+    async def _stream_attempts(self, path, raw, writer, trace, t0,
+                               deadline, affinity, cls, fwd, info):
         attempts = 0
         last_status, last_body = None, b""
         while attempts < self.retries:
@@ -686,8 +811,18 @@ class Router(Logger):
             if rep is None:
                 break
             attempts += 1
+            info["attempts"] = attempts
+            info["replica"] = rep.id
             if attempts > 1:
                 self.stats.record_retry()
+            span = None
+            if self._tron and trace is not None:
+                span = next_span_id()
+                events.record("router.attempt", "begin",
+                              cls="Router", span=span, trace=trace,
+                              attempt=attempts, replica=rep.id,
+                              stream=True)
+            t_att = time.monotonic()
             rep.outstanding += 1
             rep.requests += 1
             upstream = up_writer = None
@@ -709,7 +844,8 @@ class Router(Logger):
                     last_body = json.dumps(
                         {"error": {"code": status,
                                    "message": str(e),
-                                   "injected": True}}).encode()
+                                   "injected": True,
+                                   "trace_id": trace}}).encode()
                     upstream = None
                 except asyncio.CancelledError:
                     raise
@@ -750,6 +886,8 @@ class Router(Logger):
                        "X-Veles-Router-Attempts: %d" % attempts,
                        "X-Veles-Replica: %s" % rheaders.get(
                            "x-veles-replica", rep.id)]
+                if trace is not None:
+                    out.append("X-Veles-Trace: %s" % trace)
                 if "content-length" in rheaders:
                     out.append("Content-Length: %s"
                                % rheaders["content-length"])
@@ -780,32 +918,74 @@ class Router(Logger):
                     pass
                 finally:
                     self.stats.record_request(
-                        (time.monotonic() - t0) * 1e3)
+                        (time.monotonic() - t0) * 1e3, cls=cls)
                 return
             finally:
                 rep.outstanding -= 1
                 if up_writer is not None:
                     up_writer.close()
+                if span is not None:
+                    events.record(
+                        "router.attempt", "end", cls="Router",
+                        span=span, trace=trace, attempt=attempts,
+                        replica=rep.id, stream=True,
+                        duration=time.monotonic() - t_att)
             # (unreachable: every branch above returns or continues)
         # no replica ever produced a status line (or only 5xx) — shed
-        self.stats.record_request((time.monotonic() - t0) * 1e3)
+        self.stats.record_request((time.monotonic() - t0) * 1e3,
+                                  cls=cls)
         if last_status is not None:
             status, rheaders, rbody = self._error(
                 last_status, "replica error after %d attempt(s)"
-                % attempts, attempts=attempts)
+                % attempts, attempts=attempts, trace=trace)
         else:
             self.stats.record_shed()
             status, rheaders, rbody = self._error(
                 503, "no eligible replica (fleet saturated, "
                 "draining or open)",
                 retry_after=self.shed_retry_after,
-                attempts=attempts, shed=True)
+                attempts=attempts, shed=True, trace=trace)
         out = ["HTTP/1.1 %d X" % status, "Connection: close",
                "Content-Length: %d" % len(rbody)]
         out += ["%s: %s" % (k, v) for k, v in rheaders.items()]
         writer.write(("\r\n".join(out) + "\r\n\r\n").encode()
                      + rbody)
         await writer.drain()
+
+    # -- live in-flight inspection ---------------------------------------
+
+    def _inflight_rows(self):
+        """The router-side in-flight table: one row per request the
+        router is still proxying (trace id, path, age, attempt count,
+        current replica, streaming flag) — the router half of ``GET
+        /debug/requests``.  Loop thread only."""
+        now = time.monotonic()
+        return [{
+            "trace": info["trace"], "phase": "proxy",
+            "path": info["path"],
+            "age_s": round(now - info["t0"], 3),
+            "attempts": info["attempts"],
+            "replica": info["replica"],
+            "stream": info["stream"], "cls": info["cls"],
+        } for info in self._inflight.values()]
+
+    def debug_requests(self, timeout=2.0):
+        """Thread-safe snapshot of :meth:`_inflight_rows` (the
+        flight-recorder registry calls this from whatever thread is
+        dumping; a dead/stuck loop answers [] instead of hanging the
+        crash path)."""
+        with self._lock:
+            loop = self._loop
+        if loop is None:
+            return []
+
+        async def _rows():
+            return self._inflight_rows()
+        try:
+            return asyncio.run_coroutine_threadsafe(
+                _rows(), loop).result(timeout)
+        except Exception:
+            return []
 
     # -- health polling --------------------------------------------------
 
@@ -904,10 +1084,19 @@ class Router(Logger):
             except Exception:
                 pass
 
-    def _error(self, code, message, retry_after=None, **extra):
+    def _error(self, code, message, retry_after=None, trace=None,
+               **extra):
+        """Structured error reply; ``trace`` rides the body as
+        ``trace_id`` AND the ``X-Veles-Trace`` header, so a failed or
+        slow request is correlatable from the client side (the
+        ``attempts`` extra says how many replicas were tried)."""
         err = {"code": int(code), "message": str(message)}
+        if trace is not None:
+            err["trace_id"] = trace
         err.update({k: v for k, v in extra.items() if v is not None})
         headers = {"Content-Type": "application/json"}
+        if trace is not None:
+            headers["X-Veles-Trace"] = trace
         if retry_after is not None:
             headers["Retry-After"] = str(max(1, int(retry_after)))
         return int(code), headers, json.dumps({"error": err}).encode()
@@ -917,12 +1106,21 @@ class Router(Logger):
     FORWARD_POSTS = ("/generate", "/v1/completions",
                      "/v1/embeddings", "/v1/classify")
 
-    async def _route(self, method, path, headers, body):
+    async def _route(self, method, path, headers, body, trace=None):
         if method == "POST" and path in self.FORWARD_POSTS:
-            return await self._forward_request(path, body, headers)
+            return await self._forward_request(path, body, headers,
+                                               trace=trace)
         if method == "GET" and path == "/v1/models":
             return await self._forward_request(path, b"", headers,
-                                               method="GET")
+                                               method="GET",
+                                               trace=trace)
+        if method == "GET" and path == "/debug/requests":
+            # live in-flight table (loop thread owns _inflight — no
+            # locks needed, same invariant as the replica registry)
+            return (200, {"Content-Type": "application/json"},
+                    json.dumps({"role": "router",
+                                "requests": self._inflight_rows()},
+                               default=str).encode())
         if method == "GET" and path == "/healthz":
             state = await self._state()
             ok = state["eligible"] > 0
@@ -962,21 +1160,29 @@ class Router(Logger):
             body = await reader.readexactly(length) if length \
                 else b""
             path = target.split("?")[0].rstrip("/") or "/"
+            # the EDGE mint: accept the client's X-Veles-Trace when
+            # sane, else mint — and propagate it to the replica via
+            # the same (sanitized) header so one id spans the fleet
+            trace = reqtrace.ensure_trace_id(
+                headers.get("x-veles-trace"))
+            headers["x-veles-trace"] = trace
             if method == "POST" and path in self.FORWARD_POSTS \
                     and self._inspect(body, headers)[2]:
                 # SSE streaming: the proxy writes the whole client
                 # response itself (headers relay chunk by chunk;
                 # first forwarded byte pins the replica)
-                await self._stream_proxy(path, headers, body, writer)
+                await self._stream_proxy(path, headers, body, writer,
+                                         trace=trace)
                 return
             try:
                 status, rheaders, rbody = await self._route(
-                    method, path, headers, body)
+                    method, path, headers, body, trace=trace)
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # the router must outlive any bug
                 status, rheaders, rbody = self._error(
-                    500, "router error: %r" % (e,))
+                    500, "router error: %r" % (e,), trace=trace)
+            rheaders.setdefault("X-Veles-Trace", trace)
             reason = {200: "OK", 202: "Accepted"}.get(status, "X")
             out = ["HTTP/1.1 %d %s" % (status, reason),
                    "Connection: close",

@@ -118,6 +118,19 @@ two; 0 disables chunking, default 64), ``request_timeout`` /
 ``watchdog`` / ``shed_block_factor`` (lifecycle knobs above; 0
 disables each), ``spec`` / ``spec_k`` (speculative decoding),
 ``prefix_cache`` / ``prefix_evict`` (the radix cache above).
+
+Observability: every request carries a **trace id**
+(``submit(trace=...)``; minted when absent, propagated from the
+``X-Veles-Trace`` header by the REST layer and router) and the
+scheduler records its phase timeline — queue wait, admission (cold
+vs prefix-warm, blocks claimed), each prefill chunk, batched
+decode/verify boundaries (one span per boundary, per-request token
+counts), preempt/resume, first token, retire — through
+:mod:`veles_tpu.telemetry.reqtrace` into the JSONL event sink
+(``trace_export --request <id>`` rebuilds the timeline).
+:meth:`debug_requests` is the live in-flight table behind ``GET
+/debug/requests``; per-class SLO good/bad counts and multi-window
+burn rates (``root.common.slo.*``) ride ``stats.slo``.
 """
 
 import collections
@@ -130,6 +143,7 @@ import numpy
 
 from veles_tpu import faults
 from veles_tpu.logger import Logger
+from veles_tpu.telemetry import reqtrace
 from veles_tpu.serving.engine import (
     first_tokens, paged_decode_step, slot_decode_step,
     verify_step_paged, verify_supported)
@@ -231,10 +245,10 @@ class _Request(object):
                  "generated", "cancelled", "preempts", "t_submit",
                  "t_admit", "t_first", "pf_seq", "pf_caches",
                  "pf_off", "pf_width", "pf_chunk", "pf_matched",
-                 "prefix_handle", "priority", "sink")
+                 "prefix_handle", "priority", "sink", "trace")
 
     def __init__(self, prompt, steps, temperature, top_k, stop_token,
-                 seed, deadline, priority=1, sink=None):
+                 seed, deadline, priority=1, sink=None, trace=None):
         self.prompt = prompt
         self.steps = steps
         self.temperature = temperature
@@ -244,6 +258,7 @@ class _Request(object):
         self.deadline = deadline
         self.priority = int(priority)   # 0 low / 1 normal / 2 high
         self.sink = sink                # TokenStream._push (or None)
+        self.trace = trace              # request trace id (reqtrace)
         self.future = concurrent.futures.Future()
         self.slot = None
         self.generated = []
@@ -390,6 +405,10 @@ class InferenceScheduler(Logger):
             _serving_conf("prefix_evict", True)
             if prefix_evict is None else prefix_evict)
         self.stats = ServingMetrics()
+        #: per-request tracing (telemetry/reqtrace.py), read ONCE at
+        #: construction — the per-boundary gate must be an attribute
+        #: test, not a config-tree walk
+        self._tron = reqtrace.enabled()
         self._queue = collections.deque()
         self._active = {}            # slot -> _Request (decoding)
         self._prefilling = []        # admitted, mid-chunked-prefill
@@ -448,11 +467,15 @@ class InferenceScheduler(Logger):
                 target=self._watchdog_loop, daemon=True,
                 name="serving-watchdog")
             self._watchdog_thread.start()
+        # flight-recorder / debug surface: a hang dump can enumerate
+        # this scheduler's live requests (weakly held — close() needs
+        # no deregistration)
+        reqtrace.register("scheduler", self)
         return self
 
     def submit(self, prompt, steps, temperature=0.0, top_k=0,
                seed=None, stop_token=None, timeout=None,
-               priority=None, stream=False):
+               priority=None, stream=False, trace=None):
         """Queue one sequence for decoding; returns a Future whose
         result is the full token list (prompt + generated, ending at
         the first generated stop token if one fired).  ``timeout``
@@ -467,7 +490,11 @@ class InferenceScheduler(Logger):
         class-aware (module docstring).  ``stream=True`` returns a
         :class:`~veles_tpu.serving.streams.TokenStream` (its
         ``.future`` is the same future the plain path returns)
-        yielding tokens as they are accepted.
+        yielding tokens as they are accepted.  ``trace`` attaches a
+        request trace id (the ``X-Veles-Trace`` propagation value —
+        sanitized here; None mints a fresh one): every phase span the
+        scheduler records for this request carries it, which is what
+        ``trace_export --request`` merges on.
 
         Raises ``ValueError`` on malformed requests (client errors),
         :class:`QueueFullError` when admission control rejects (queue
@@ -500,13 +527,17 @@ class InferenceScheduler(Logger):
             seed = int.from_bytes(os.urandom(4), "little")
         ttl = float(timeout or self.request_timeout
                     or self.queue_timeout or 0)
+        trace = reqtrace.ensure_trace_id(trace)
         ts = TokenStream(prompt) if stream else None
+        if ts is not None:
+            ts.trace = trace
         req = _Request(
             prompt, steps, temperature, top_k,
             int(stop_token) if stop_token is not None else None,
             int(seed) & 0xFFFFFFFF,
             time.monotonic() + ttl if ttl > 0 else None,
-            priority=prio, sink=ts._push if ts is not None else None)
+            priority=prio, sink=ts._push if ts is not None else None,
+            trace=trace)
         need = self._blocks_for(req)
         cls = CLASS_NAMES[prio]
         with self._wake:
@@ -534,7 +565,8 @@ class InferenceScheduler(Logger):
                 # pressure builds the overload sacrifices low-class
                 # work while high-class admission still has headroom
                 # — and a shed low client backs off longer
-                self.stats.record_shed(self._queued_blocks, cls=cls)
+                self.stats.record_shed(self._queued_blocks, cls=cls,
+                                       trace=trace)
                 err = QueueFullError(
                     "overloaded: %d KV blocks committed in-queue "
                     "(pool %d, %s-class shed at factor %.1f)"
@@ -583,7 +615,8 @@ class InferenceScheduler(Logger):
         self._queue.remove(victim)
         self._queued_blocks -= self._blocks_for(victim)
         vcls = CLASS_NAMES[victim.priority]
-        self.stats.record_shed(self._queued_blocks, cls=vcls)
+        self.stats.record_shed(self._queued_blocks, cls=vcls,
+                               trace=victim.trace)
         err = QueueFullError(
             "shed while queued: a higher-priority request took the "
             "last queue seat")
@@ -625,7 +658,8 @@ class InferenceScheduler(Logger):
         if victim.slot is None and not victim.cancelled:
             # was queued: no device state to release — fail right here
             victim.fail(RequestCancelledError(reason))
-            self.stats.record_cancel(len(victim.generated))
+            self.stats.record_cancel(len(victim.generated),
+                                     trace=victim.trace)
         return True
 
     def request_preempt(self, n=1, below=None):
@@ -799,6 +833,48 @@ class InferenceScheduler(Logger):
         snap["drained"] = self._drained.is_set()
         snap["queued_kv_blocks"] = queued_blocks
         return snap
+
+    def debug_requests(self):
+        """Live in-flight request table (``GET /debug/requests`` and
+        the flight-recorder bundle): one row per request the
+        scheduler still owes an answer, with its trace id, phase,
+        class, age and the KV blocks it holds.  Monitoring-grade
+        reads — the loop thread owns the cache tables, so block
+        counts are len()/int-read consistent, not transactional."""
+        now = time.monotonic()
+        cache = self.cache_
+        with self._lock:
+            rows = [("queued", r) for r in self._queue] \
+                + [("admitting", r) for r in self._admitting] \
+                + [("prefill", r) for r in self._prefilling] \
+                + [("decode", r) for r in self._active.values()]
+        out = []
+        for phase, req in rows:
+            blocks = shared = 0
+            if req.slot is not None and self.kv == "paged" \
+                    and cache is not None:
+                blocks = int(cache.n_blocks[req.slot])
+                shared = int(cache.n_shared[req.slot])
+            row = {
+                "trace": req.trace,
+                "phase": phase,
+                "cls": CLASS_NAMES[req.priority],
+                "age_s": round(now - req.t_submit, 3),
+                "prompt_tokens": len(req.prompt),
+                "tokens": len(req.generated),
+                "steps": req.steps,
+                "blocks": blocks,
+                "blocks_shared": shared,
+                "blocks_budget": self._blocks_for(req),
+                "preempts": req.preempts,
+                "stream": req.sink is not None,
+                "deadline_in_s": round(req.deadline - now, 3)
+                if req.deadline is not None else None,
+            }
+            if phase == "prefill":
+                row["prefill_off"] = req.pf_off
+            out.append(row)
+        return out
 
     def check_kv(self):
         """Invariant sweep over the paged cache INCLUDING the prefix
@@ -1105,7 +1181,8 @@ class InferenceScheduler(Logger):
                 self._drop_inflight(req, cache)
             elif req.cancelled:
                 self._drop_inflight(req, cache)
-                self.stats.record_cancel(len(req.generated))
+                self.stats.record_cancel(len(req.generated),
+                                         trace=req.trace)
                 req.fail(RequestCancelledError(
                     "cancelled after %d generated tokens"
                     % len(req.generated)))
@@ -1113,7 +1190,8 @@ class InferenceScheduler(Logger):
                 self._drop_inflight(req, cache)
                 age_ms = (now - req.t_submit) * 1e3
                 self.stats.record_expire(age_ms,
-                                         tokens=len(req.generated))
+                                         tokens=len(req.generated),
+                                         trace=req.trace)
                 req.fail(DeadlineExceededError(
                     "deadline exceeded after %.0f ms (%d tokens "
                     "generated)" % (age_ms, len(req.generated)),
@@ -1158,7 +1236,8 @@ class InferenceScheduler(Logger):
             self._release_slot(req, cache)
             req.preempts += 1
             self.stats.record_preempt(len(req.generated),
-                                      cls=CLASS_NAMES[req.priority])
+                                      cls=CLASS_NAMES[req.priority],
+                                      trace=req.trace)
             self._sync_kv_gauges(cache)
             with self._lock:
                 self._enqueue_locked(req, front=True)
@@ -1217,7 +1296,8 @@ class InferenceScheduler(Logger):
                 self._queued_blocks -= self._blocks_for(req)
                 queued_ms = (now - req.t_submit) * 1e3
                 self.stats.record_expire(queued_ms,
-                                         tokens=len(req.generated))
+                                         tokens=len(req.generated),
+                                         trace=req.trace)
                 req.fail(DeadlineExceededError(
                     "queued %.0f ms without a free slot" % queued_ms,
                     tokens_generated=len(req.generated)))
@@ -1245,6 +1325,21 @@ class InferenceScheduler(Logger):
             self.stats.record_resume(len(seq))
         req.pf_seq = seq
         p_len = len(seq)
+        if self._tron:
+            # the queue-wait span [submit, admit] plus the admission
+            # decision: cold vs prefix-warm and the blocks claimed —
+            # the first two entries of a request's phase timeline
+            need = self._blocks_for(req)
+            reqtrace.record(
+                req.trace, "queue",
+                duration=req.t_admit - req.t_submit,
+                cls=CLASS_NAMES[req.priority],
+                resume=bool(req.preempts))
+            reqtrace.record(
+                req.trace, "admit", slot=req.slot, tokens=p_len,
+                warm_blocks=req.pf_matched,
+                blocks_claimed=max(0, need - req.pf_matched),
+                resume=bool(req.preempts))
         if req.pf_matched:
             self._admit_warm(req, cache)
             return
@@ -1306,6 +1401,7 @@ class InferenceScheduler(Logger):
         p_w = min(width, max(self.window, p_len))
         padded = numpy.zeros((1, p_w), numpy.int32)
         padded[0, :p_len] = req.pf_seq
+        t0 = time.perf_counter()
         try:
             faults.fire("serving.scheduler.prefill")
             row_caches, last = prefill(
@@ -1314,6 +1410,10 @@ class InferenceScheduler(Logger):
         except Exception as e:
             self._retire(req, cache, error=e)
             return
+        if self._tron:
+            reqtrace.record(req.trace, "prefill",
+                            duration=time.perf_counter() - t0,
+                            tokens=p_len)
         self._finish_admit(req, cache, row_caches, last)
 
     def _prefill_tick(self, cache):
@@ -1346,6 +1446,10 @@ class InferenceScheduler(Logger):
             return
         self.stats.record_prefill_chunk(
             clen, (time.perf_counter() - t0) * 1e3)
+        if self._tron:
+            reqtrace.record(req.trace, "prefill_chunk",
+                            duration=time.perf_counter() - t0,
+                            off=off, tokens=clen)
         req.pf_off = end
         if end >= p_len:
             with self._lock:
@@ -1382,6 +1486,11 @@ class InferenceScheduler(Logger):
                 (req.t_first - req.t_submit) * 1e3,
                 (req.t_admit - req.t_submit) * 1e3,
                 cls=CLASS_NAMES[req.priority])
+            if self._tron:
+                reqtrace.record(
+                    req.trace, "first_token",
+                    ttft_ms=round(
+                        (req.t_first - req.t_submit) * 1e3, 3))
         with self._lock:
             self._active[req.slot] = req
         self._maybe_finish(req, cache)
@@ -1462,14 +1571,23 @@ class InferenceScheduler(Logger):
         for j, slot in enumerate(slots):
             self._fill_row(arrays, j, active[slot])
         tables[:n] = cache.table_rows(slots, t)
+        t0 = time.perf_counter()
         nxt = numpy.asarray(paged_decode_step(
             self.forwards, cache, toks, pos, tables, temps, topks,
             seeds, counts))
+        dt = time.perf_counter() - t0
         self.stats.record_step(n, b)
         for j, slot in enumerate(slots):
             req = active[slot]
             self._emit(req, int(nxt[j]))
             self._maybe_finish(req, cache)
+        if self._tron:
+            emitted = {}
+            for s in slots:  # batch rows may SHARE a client trace id
+                tr = active[s].trace
+                emitted[tr] = emitted.get(tr, 0) + 1
+            reqtrace.record_step(emitted, duration=dt,
+                                 mode="decode", slots=n, bucket=b)
 
     def _step_verify(self, cache, active, drafts):
         """Speculative step: every active slot rides ONE batched
@@ -1514,23 +1632,32 @@ class InferenceScheduler(Logger):
             seeds[j] = req.seed
             counts[j] = len(req.generated)
         tables[:n] = cache.table_rows(slots, t)
+        t0 = time.perf_counter()
         nxt = numpy.asarray(verify_step_paged(
             self.forwards, cache, toks, pos, lens, tables, temps,
             topks, seeds, counts))
+        dt = time.perf_counter() - t0
         self.stats.record_step(n, b)
+        emitted = {}
         for j, slot in enumerate(slots):
             req = active[slot]
             d = list(drafts.get(slot, ()))[:k]
             out = accept_drafts(d, nxt[j, :len(d) + 1])
             if d:
                 self.stats.record_spec(len(d), len(out) - 1)
+            before = len(req.generated)
             for tok in out:
                 self._emit(req, int(tok))
                 if len(req.generated) >= req.steps \
                         or (req.stop_token is not None
                             and int(tok) == req.stop_token):
                     break
+            emitted[req.trace] = emitted.get(req.trace, 0) \
+                + len(req.generated) - before
             self._maybe_finish(req, cache)
+        if self._tron:
+            reqtrace.record_step(emitted, duration=dt, mode="verify",
+                                 slots=n, bucket=b, k=k)
 
     def _step_dense(self, cache, active):
         """Legacy full-batch step: free slots decode garbage rows."""
@@ -1544,13 +1671,21 @@ class InferenceScheduler(Logger):
         arrays = (toks, pos, temps, topks, seeds, counts)
         for slot, req in active.items():
             self._fill_row(arrays, slot, req)
+        t0 = time.perf_counter()
         nxt = numpy.asarray(slot_decode_step(
             self.forwards, cache, toks, pos, temps, topks, seeds,
             counts))
+        dt = time.perf_counter() - t0
         self.stats.record_step(len(active), s)
         for slot, req in active.items():
             self._emit(req, int(nxt[slot]))
             self._maybe_finish(req, cache)
+        if self._tron:
+            emitted = {}
+            for r in active.values():
+                emitted[r.trace] = emitted.get(r.trace, 0) + 1
+            reqtrace.record_step(emitted, duration=dt, mode="decode",
+                                 slots=len(active), bucket=s)
 
     def _maybe_finish(self, req, cache, error=None):
         done = error is not None \
@@ -1565,6 +1700,16 @@ class InferenceScheduler(Logger):
             self._active.pop(req.slot, None)
         self._release_slot(req, cache, finished=error is None)
         self._sync_kv_gauges(cache)
+        if self._tron:
+            # an INSTANT at the retire boundary ("duration" would
+            # backdate it into a request-spanning bar): total_s is
+            # the whole submit->retire wall time as an attribute
+            reqtrace.record(
+                req.trace, "retire", tokens=len(req.generated),
+                total_s=round(time.monotonic() - req.t_submit, 6),
+                preempts=req.preempts,
+                outcome="ok" if error is None
+                else type(error).__name__)
         if error is not None:
             req.fail(error if isinstance(error, SchedulerError)
                      else SchedulerError(repr(error)))
@@ -1577,7 +1722,7 @@ class InferenceScheduler(Logger):
             len(req.generated), now - req.t_submit,
             (req.t_first - req.t_submit) * 1e3,
             (req.t_admit - req.t_submit) * 1e3,
-            cls=CLASS_NAMES[req.priority])
+            cls=CLASS_NAMES[req.priority], trace=req.trace)
         try:
             req.future.set_result(list(req.prompt) + req.generated)
         except concurrent.futures.InvalidStateError:

@@ -77,6 +77,10 @@ class TokenStream(object):
         #: per-token consumer patience in seconds (None blocks —
         #: safe: every future resolves via deadline/watchdog/close)
         self.token_timeout = token_timeout
+        #: the request's trace id (set at submit) — what the SSE
+        #: terminal frame echoes so a streamed reply is correlatable
+        #: with the server-side phase timeline
+        self.trace = None
         self.future = None
         self._scheduler = None
         self._q = queue.SimpleQueue()

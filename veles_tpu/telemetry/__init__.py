@@ -22,6 +22,8 @@ from veles_tpu.telemetry.health import (  # noqa: F401
 from veles_tpu.telemetry.registry import (  # noqa: F401
     Counter, DEFAULT_BUCKETS, Gauge, Histogram, MS_BUCKETS,
     MetricsRegistry, metrics, nearest_rank)
+from veles_tpu.telemetry.reqtrace import (  # noqa: F401
+    TRACE_HEADER, clean_trace_id, ensure_trace_id, new_trace_id)
 from veles_tpu.telemetry.spans import (  # noqa: F401
     iter_spans, next_span_id, span)
 

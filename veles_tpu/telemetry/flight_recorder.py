@@ -21,7 +21,10 @@ to dump at the moment of death:
   ``<snapshot_dir>/flightrec-<pid>.json`` — containing the recent
   span events, the full metrics-registry snapshot, the effective
   config, jax/platform environment, per-thread stacks, the health
-  monitor state and the log tail.
+  monitor state, the log tail, and the LIVE in-flight request table
+  (``requests``: trace id, phase, age, blocks held — from every
+  scheduler/router registered with
+  :mod:`veles_tpu.telemetry.reqtrace`).
 
 ``GET /debug/state`` on both HTTP services serves the same bundle
 ingredients from the live process (see ``docs/observability.md``).
@@ -206,6 +209,15 @@ class FlightRecorder:
         try:
             from veles_tpu.telemetry.registry import metrics
             info["metrics"] = metrics.snapshot()
+        except Exception:
+            pass
+        try:
+            # the LIVE in-flight request table (trace id, phase, age,
+            # blocks held) from every registered scheduler/router —
+            # a hang dump must say WHICH requests were stuck, not
+            # just where the threads stood
+            from veles_tpu.telemetry import reqtrace
+            info["requests"] = reqtrace.inflight_table()
         except Exception:
             pass
         try:
