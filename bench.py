@@ -1139,6 +1139,209 @@ def bench_spec(dev):
     return out
 
 
+def bench_kv_quant(dev):
+    """Quantized KV cache + fused verify (the ISSUE-12 pair):
+
+    - ``serving_max_streams_int8`` vs ``_fp32`` — concurrent streams
+      actually decoding for the SAME KV HBM budget in BYTES: the
+      fp32 pool's ``kv_blocks x bytes_per_block`` budget is re-spent
+      on int8 blocks (``bytes_per_token`` ratio ~1.9x under the bf16
+      policy — int8 rows + one f32 scale per row per tensor), so the
+      int8 pool admits proportionally more blocks and the peak
+      stream count follows;
+    - ``kv_quant_decode_tokens_per_sec`` — decode throughput spec
+      on/off x kv_dtype on the repetitive-text trained chain (the
+      dequant cost rides the same step the spec win rides);
+    - ``spec_verify_fused_speedup`` — spec-on fp32 decode throughput
+      with the single-pass fused verify vs the PR 9 two-pass
+      scatter-then-gather verify (>= 1.0 expected: the fused pass
+      removes the in-step HBM round-trip of the run's K/V);
+    - ``kv_bytes_per_token_{fp32,int8}`` — the measured per-token
+      HBM cost each layout reports in ``/serving/metrics``.
+
+    Sized down hard on CPU so driver runs stay fast."""
+    from veles_tpu.config import root
+    from veles_tpu.serving import InferenceScheduler
+
+    cpu = dev.jax_device.platform == "cpu"
+    if cpu:
+        d_model, layers, heads, vocab = 64, 2, 2, 256
+        window, block, steps, spec_k = 128, 16, 56, 8
+        batch, train_steps = 16, 30
+        budget_blocks_fp32 = 16
+    else:
+        d_model, layers, heads, vocab = 1024, 8, 8, 32768
+        window, block, steps, spec_k = 1024, 16, 512, 8
+        batch, train_steps = 16, 60
+        budget_blocks_fp32 = 256
+    rng = numpy.random.default_rng(0)
+    pattern = (numpy.arange(12) * 17 % vocab).tolist()
+    fw = _spec_trained_chain(dev, d_model, layers, heads, vocab,
+                             window, batch, pattern, train_steps,
+                             "bench-kv-quant")
+    prompt = (pattern * 8)[:64]
+    out = {}
+
+    # -- streams at the SAME HBM byte budget -------------------------
+    p_short, s_short = 8, 24
+    per_req = -(-(p_short + s_short) // block)
+
+    def peak_streams(kv_dtype, kv_blocks):
+        cap = kv_blocks // per_req
+        sch = InferenceScheduler(
+            fw, max_slots=min(64, max(cap, 1)), window=window,
+            max_queue=4 * max(cap, 1), queue_timeout=600.0,
+            kv="paged", block_size=block, kv_blocks=kv_blocks,
+            kv_dtype=kv_dtype, prefill_chunk=0, spec=False,
+            prefix_cache=False, shed_block_factor=0,
+            warm_buckets=False).start()
+        try:
+            futs = [sch.submit(
+                rng.integers(0, vocab, (p_short,)).tolist(),
+                s_short, seed=i) for i in range(cap + 2)]
+            peak = 0
+            while any(not f.done() for f in futs):
+                peak = max(peak, sch.metrics()["active_slots"])
+                time.sleep(0.005)
+            for f in futs:
+                if not f.cancelled():
+                    try:
+                        f.result(600)
+                    except Exception:
+                        pass
+            return peak, sch.metrics()["kv_bytes_per_token"]
+        finally:
+            sch.close()
+
+    streams_fp32, bpt_fp32 = peak_streams("fp32", budget_blocks_fp32)
+    budget_bytes = budget_blocks_fp32 * block * bpt_fp32
+    # probe the int8 layout's per-token cost, then spend the SAME
+    # byte budget on int8 blocks
+    _, bpt_int8 = peak_streams("int8", per_req)
+    blocks_int8 = budget_bytes // (block * bpt_int8)
+    streams_int8, _ = peak_streams("int8", blocks_int8)
+    out["serving_max_streams_fp32"] = streams_fp32
+    out["serving_max_streams_int8"] = streams_int8
+    out["serving_max_streams_int8_ratio"] = round(
+        streams_int8 / streams_fp32, 3) if streams_fp32 else None
+    out["kv_bytes_per_token_fp32"] = bpt_fp32
+    out["kv_bytes_per_token_int8"] = bpt_int8
+    out["kv_quant_hbm_budget_bytes"] = int(budget_bytes)
+
+    # -- decode tok/s: spec on/off x kv_dtype ------------------------
+    def decode_tps(spec, kv_dtype):
+        sch = InferenceScheduler(
+            fw, max_slots=4, window=window, max_queue=16,
+            queue_timeout=600.0, kv="paged", block_size=block,
+            kv_dtype=kv_dtype, prefill_chunk=0, spec=spec,
+            spec_k=spec_k, prefix_cache=False,
+            warm_buckets=False).start()
+        try:
+            # warm EVERY occupancy bucket the timed runs hit — a
+            # first 4-slot compile must not be timed
+            for n in (1, 2, 4):
+                ws = [sch.submit(prompt, max(steps // 4, 8),
+                                 seed=i) for i in range(n)]
+                for f in ws:
+                    f.result(600)
+            best = 0.0
+            for _ in range(3):
+                t0 = time.perf_counter()
+                futs = [sch.submit(prompt, steps, seed=i)
+                        for i in range(4)]
+                toks = sum(len(f.result(600)) - len(prompt)
+                           for f in futs)
+                best = max(best,
+                           toks / (time.perf_counter() - t0))
+            return round(best, 1)
+        finally:
+            sch.close()
+
+    tps = {}
+    for kv_dtype in ("fp32", "int8"):
+        tps[kv_dtype] = {
+            "spec_off": decode_tps(False, kv_dtype),
+            "spec_on": decode_tps(True, kv_dtype)}
+    out["kv_quant_decode_tokens_per_sec"] = tps
+    out["kv_quant_decode_int8_ratio_spec_on"] = round(
+        tps["int8"]["spec_on"] / tps["fp32"]["spec_on"], 3) \
+        if tps["fp32"]["spec_on"] else None
+
+    # -- fused vs two-pass verify at spec-on fp32 defaults -----------
+    # measured at the VERIFY STEP itself (engine.verify_step_paged —
+    # the executable the spec-on decode loop calls every boundary):
+    # end-to-end tok/s buries the step under prefill/sampling/loop
+    # overhead, while the step latency shows exactly what fusion
+    # buys — the run's K/V no longer round-trips scatter-then-gather
+    # through the pool, and the donated pool update stops copying
+    # the whole pool every step
+    from veles_tpu.serving.engine import verify_step_paged
+    from veles_tpu.serving.kv_slots import PagedKVCache
+
+    # pool sized like a (small) deployment rather than the streams
+    # experiment — the two-pass executable copies the WHOLE pool
+    # every step (no donation, the PR 9 behavior), so the copy cost
+    # the fused path deletes must be visible at bench scale the way
+    # it is at production scale (where pools are GBs, not MBs)
+    def verify_setup():
+        cache = PagedKVCache(fw, max_slots=8, window=window,
+                             block_size=block, kv_blocks=2048)
+        slots = [cache.alloc(3 * window // 4) for _ in range(8)]
+        k1 = spec_k + 1
+        args = (numpy.asarray(
+                    rng.integers(0, vocab, (8, k1)), numpy.int32),
+                numpy.full((8,), window // 2, numpy.int32),
+                numpy.full((8,), k1, numpy.int32),
+                cache.table_rows(slots, cache.blocks_per_slot),
+                numpy.zeros((8,), numpy.float32),
+                numpy.zeros((8,), numpy.int32),
+                numpy.arange(8, dtype=numpy.uint32),
+                numpy.zeros((8,), numpy.int32))
+        return cache, args
+
+    saved = root.common.serving.get("fused_verify", False)
+    samples = {False: [], True: []}
+    rigs = {}
+    try:
+        for fused_on in (False, True):
+            root.common.serving.fused_verify = fused_on
+            rigs[fused_on] = verify_setup()
+            for _ in range(3):   # compile + settle out of the timing
+                verify_step_paged(fw, rigs[fused_on][0],
+                                  *rigs[fused_on][1])
+        for _ in range(5):       # interleave rounds: drift-proof
+            for fused_on in (False, True):
+                root.common.serving.fused_verify = fused_on
+                cache, vargs = rigs[fused_on]
+                for _ in range(20):
+                    t0 = time.perf_counter()
+                    numpy.asarray(verify_step_paged(fw, cache,
+                                                    *vargs))
+                    samples[fused_on].append(
+                        time.perf_counter() - t0)
+    finally:
+        root.common.serving.fused_verify = saved
+    med = {k: sorted(v)[len(v) // 2] for k, v in samples.items()}
+    out["spec_verify_two_pass_step_us"] = round(med[False] * 1e6, 1)
+    out["spec_verify_fused_step_us"] = round(med[True] * 1e6, 1)
+    out["spec_verify_fused_speedup"] = round(
+        med[False] / med[True], 3) if med[True] else None
+
+    out["kv_quant_config"] = {
+        "d_model": d_model, "layers": layers, "heads": heads,
+        "vocab": vocab, "window": window, "block_size": block,
+        "steps": steps, "spec_k": spec_k,
+        "budget_blocks_fp32": budget_blocks_fp32,
+        "blocks_int8_same_budget": int(blocks_int8),
+        "streams_prompt": p_short, "streams_steps": s_short,
+        "train_steps": train_steps,
+        "workload": "chain trained on a cyclic 12-token pattern; "
+                    "streams measured on distinct random prompts "
+                    "with spec/prefix off so concurrency is the "
+                    "only variable"}
+    return out
+
+
 def bench_router(dev, replica_counts=(1, 2, 4),
                  requests_per_client=4):
     """Fleet scaling through the HTTP router (``serving/router.py``
@@ -1553,6 +1756,10 @@ def main():
     except Exception as e:    # same guard as the other serving entries
         spec_rec = {"spec_error": repr(e)[:300]}
     try:
+        kv_quant_rec = bench_kv_quant(dev)
+    except Exception as e:    # same guard as the other serving entries
+        kv_quant_rec = {"kv_quant_error": repr(e)[:300]}
+    try:
         router_rec = bench_router(dev)
     except Exception as e:     # fleet bench must not sink the run
         router_rec = {"router_error": repr(e)[:300]}
@@ -1604,6 +1811,7 @@ def main():
     record.update(serving)
     record.update(serving_sweep)
     record.update(spec_rec)
+    record.update(kv_quant_rec)
     record.update(router_rec)
     record.update(streaming_rec)
     record.update(input_pipe)
@@ -1672,6 +1880,11 @@ def main():
         "prefix_cold_ttft_ms", "prefix_warm_ttft_ratio",
         "prefix_max_streams_warm", "prefix_max_streams_cold",
         "spec_error",
+        "serving_max_streams_int8", "serving_max_streams_fp32",
+        "serving_max_streams_int8_ratio",
+        "spec_verify_fused_speedup",
+        "kv_bytes_per_token_fp32", "kv_bytes_per_token_int8",
+        "kv_quant_error",
         "router_aggregate_tokens_per_sec", "router_ttft_p95_ms",
         "router_scaling_2x", "router_cores", "router_error",
         "streaming_ttfb_p95_ms", "streaming_intertoken_p95_ms",
@@ -1735,8 +1948,18 @@ def main_streaming():
         "carried")
 
 
+def main_kv_quant():
+    """``python bench.py kv_quant`` — the quantized-KV + fused-verify
+    bench alone."""
+    return _main_standalone(
+        bench_kv_quant, "kv_quant_bench_source",
+        "PR12 standalone kv-quant/fused-verify bench run; other "
+        "entries carried")
+
+
 if __name__ == "__main__":
     sys.exit(main_router() if "router" in sys.argv[1:]
              else main_spec() if "spec" in sys.argv[1:]
              else main_streaming() if "streaming" in sys.argv[1:]
+             else main_kv_quant() if "kv_quant" in sys.argv[1:]
              else main())

@@ -214,6 +214,34 @@ def derive_metrics(name, metrics):
     return metrics
 
 
+def run_kv_quant():
+    """Int8-KV quality record (in-process — this one measures the
+    serving engine, not a trained config family): CE delta and greedy
+    top-1 agreement of int8 vs fp32 KV pools on the SAME trained tiny
+    chain the spec bench uses, through the real paged verify path
+    (``veles_tpu/serving/kv_quality.py``; the bound itself is
+    asserted in tier-1 — tests/test_kv_quant.py — this run records
+    the measured numbers beside the training families)."""
+    import numpy
+    sys.path.insert(0, REPO)
+    from veles_tpu.backends import Device
+    from veles_tpu.serving.kv_quality import kv_quant_quality
+    from bench import _spec_trained_chain
+    t0 = time.time()
+    vocab = 256
+    pattern = (numpy.arange(12) * 17 % vocab).tolist()
+    fw = _spec_trained_chain(Device(), 64, 2, 2, vocab, 128, 16,
+                             pattern, 30, "quality-kv-quant")
+    rng = numpy.random.default_rng(0)
+    seqs = [(pattern * 11)[:96],           # the text it learned
+            rng.integers(0, vocab, (96,)).tolist()]  # and noise
+    rec = kv_quant_quality(fw, seqs, block_size=16)
+    rec["seconds"] = round(time.time() - t0, 1)
+    rec["target"] = ("kv_quant_ce_delta <= the declared tolerance "
+                     "(the int8-KV gate; tier-1 asserts it)")
+    return rec
+
+
 def summarize(runs):
     """The at-a-glance block: ours vs the reference's published number
     per family."""
@@ -249,6 +277,10 @@ def main(argv=None):
             rec["metrics"] = derive_metrics(name, rec["metrics"])
         out["runs"][name] = rec
         print(json.dumps(rec.get("metrics", rec), indent=1), flush=True)
+    if not args.only or args.only == "kv_quant":
+        print("== kv_quant", flush=True)
+        out["kv_quant"] = run_kv_quant()
+        print(json.dumps(out["kv_quant"], indent=1), flush=True)
     out["summary"] = summarize(out["runs"])
     with open(os.path.join(REPO, args.out), "w") as f:
         json.dump(out, f, indent=1)

@@ -40,6 +40,8 @@ REQUIRED_REGISTRATIONS = (
     ("serving/kv_slots.py", "serving.kv_insert_row"),
     ("serving/kv_slots.py", "serving.kv_insert_blocks"),
     ("serving/kv_slots.py", "serving.kv_gather_blocks"),
+    ("serving/kv_slots.py", "serving.kv_quant_insert_blocks"),
+    ("serving/kv_slots.py", "serving.kv_quant_gather_blocks"),
 )
 
 def _is_trackjit_name(name):

@@ -275,10 +275,22 @@ root.common.update({
     # knobs are opt-OUT (spec needs a verify-capable chain and
     # prefix_cache needs chunked prefill + a pow2 block size — the
     # scheduler falls back automatically when unsupported)
+    # kv_dtype "fp32" keeps the compute-dtype pools (bit-parity
+    # baseline); "int8" stores the paged K/V pools quantized with
+    # per-row scales beside the block tables — ~half the bytes per
+    # cached token, so the same kv_blocks HBM budget decodes ~2x the
+    # concurrent streams (quality-gated: serving/kv_quality.py +
+    # quality.py kv_quant record).  fused_verify scores the
+    # speculative run in ONE pass (no scatter-then-gather round
+    # trip); it is allclose rather than bit-identical to the
+    # two-pass verify, so the fp32 parity baseline keeps it OFF
+    # (int8 pools always verify fused)
     "serving": {
         "kv": "paged",
         "block_size": 16,
         "kv_blocks": None,
+        "kv_dtype": "fp32",
+        "fused_verify": False,
         "prefill_chunk": 64,
         "warm_buckets": True,
         "request_timeout": 120.0,

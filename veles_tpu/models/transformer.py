@@ -45,7 +45,7 @@ class TransformerBlock(ForwardBase):
 
     def __init__(self, workflow, heads=4, hidden=None, causal=True,
                  n_experts=0, top_k=2, attn_block_size=None,
-                 attn_impl=None, **kwargs):
+                 attn_impl=None, int8_decode=False, **kwargs):
         super(TransformerBlock, self).__init__(workflow,
                                                include_bias=True,
                                                **kwargs)
@@ -57,6 +57,13 @@ class TransformerBlock(ForwardBase):
         #: attention core override: "flash" | "blockwise" | "dense"
         #: (None = auto; models/attention.mha_apply)
         self.attn_impl = attn_impl
+        #: int8 weight-only matmuls for the DECODE-side MLP and
+        #: output projection (ops/gemm.int8_matmul — per-column
+        #: scales fused into the store epilogue).  Decode steps only:
+        #: training/prefill keep the policy matmul.  Weights quantize
+        #: inside the traced step (frozen serving params fold to
+        #: constants under jit)
+        self.int8_decode = bool(int8_decode)
         self.n_experts = int(n_experts)
         self.top_k = int(top_k)
         if self.n_experts and self.top_k > self.n_experts:
@@ -125,12 +132,36 @@ class TransformerBlock(ForwardBase):
             attn_impl=getattr(self, "attn_impl", None),
             backend=dev.jax_device.platform if dev else None)
 
-    def _ffn(self, params, x):
+    def _w8_matmul(self, x, w):
+        """Weight-only int8 matmul of a decode activation ``x``
+        [b, s, d1] by ``w`` [d1, d2]: quantize per output column,
+        accumulate int8 products, dequant fused in the epilogue
+        (ops/gemm.py).  Returns [b, s, d2] f32."""
+        from veles_tpu import dtypes
+        from veles_tpu.ops import gemm
+        b, s, d1 = x.shape
+        wq, scale = gemm.int8_weight_quantize(w)
+        dev = getattr(self, "device", None)
+        out = gemm.int8_matmul(
+            x.reshape(b * s, d1).astype(dtypes.compute_dtype()),
+            wq, scale,
+            backend=dev.jax_device.platform if dev else None)
+        return out.reshape(b, s, -1)
+
+    def _ffn(self, params, x, w8=False):
         from veles_tpu import dtypes
         cd = dtypes.compute_dtype()
         if self.n_experts:
             from veles_tpu.models.moe import moe_apply
             return moe_apply(params, x, self.top_k, "strict_relu")
+        if w8:   # decode-side weight-only int8 (see int8_decode)
+            h1 = self._w8_matmul(x, params["ffn_w1"])
+            h1 = jnp.maximum(
+                h1 + params["ffn_b1"].astype(jnp.float32),
+                0.0).astype(cd)
+            y = self._w8_matmul(h1, params["ffn_w2"])
+            return (y + params["ffn_b2"].astype(
+                jnp.float32)).astype(x.dtype)
         h1 = jnp.einsum("bsd,dh->bsh", x.astype(cd),
                         params["ffn_w1"].astype(cd),
                         preferred_element_type=jnp.float32)
@@ -174,21 +205,26 @@ class TransformerBlock(ForwardBase):
 
         return proj("wq"), proj("wk"), proj("wv")
 
-    def _attn_tail(self, params, x, o):
+    def _attn_tail(self, params, x, o, w8=False):
         """Output projection + residual + FFN half over an attention
         context ``o`` [b, s, d] (the shared tail of every decode-step
         variant; the paged step computes ``o`` in
-        ``ops.paged_attention``)."""
+        ``ops.paged_attention``).  ``w8`` switches the projection and
+        MLP to the int8 weight-only path (decode steps with
+        ``int8_decode`` set)."""
         from veles_tpu import dtypes
         cd = dtypes.compute_dtype()
         ad = dtypes.accum_dtype()
         prec = dtypes.matmul_precision()
-        attn = jnp.einsum("bsd,de->bse", o.astype(cd),
-                          params["wo"].astype(cd), precision=prec,
-                          preferred_element_type=ad).astype(x.dtype)
+        if w8:
+            attn = self._w8_matmul(o, params["wo"]).astype(x.dtype)
+        else:
+            attn = jnp.einsum("bsd,de->bse", o.astype(cd),
+                              params["wo"].astype(cd), precision=prec,
+                              preferred_element_type=ad).astype(x.dtype)
         y = x + attn
         return y + self._ffn(params, _layer_norm(
-            y, params["ln2_scale"], params["ln2_bias"]))
+            y, params["ln2_scale"], params["ln2_bias"]), w8=w8)
 
     def _attn_out(self, params, x, probs, vh):
         """probs·V + the shared tail."""
@@ -287,11 +323,32 @@ class TransformerBlock(ForwardBase):
         return self._attn_out(params, x, probs, vh), \
             {"k": ck, "v": cv}
 
-    def init_block_pool(self, num_blocks, block_size, dtype):
+    def init_block_pool(self, num_blocks, block_size, dtype,
+                        kv_dtype="fp32"):
         """Zeroed paged K/V pools, [num_blocks, block_size, d] each —
         the block-granular counterpart of :meth:`init_cache` (see
-        serving/kv_slots.PagedKVCache)."""
-        return self.init_cache(num_blocks, block_size, dtype)
+        serving/kv_slots.PagedKVCache).  ``kv_dtype="int8"`` stores
+        the pools as int8 with per-row f32 dequant scales
+        ([num_blocks, block_size], keys ``k_scale``/``v_scale``)
+        living beside them — zero scales make the trash block's
+        garbage dequantize to exact 0.0."""
+        base = self.init_cache(num_blocks, block_size, dtype)
+        if kv_dtype == "fp32":
+            return base
+        if kv_dtype != "int8":
+            raise ValueError("kv_dtype must be 'fp32' or 'int8'")
+        return {
+            "k": jnp.zeros(base["k"].shape, jnp.int8),
+            "v": jnp.zeros(base["v"].shape, jnp.int8),
+            "k_scale": jnp.zeros((num_blocks, block_size),
+                                 jnp.float32),
+            "v_scale": jnp.zeros((num_blocks, block_size),
+                                 jnp.float32),
+        }
+
+    def _backend(self):
+        dev = getattr(self, "device", None)
+        return dev.jax_device.platform if dev else None
 
     def apply_step_paged(self, params, x, pos, tables, pool):
         """Decode ONE position PER ROW against a PAGED KV pool: x
@@ -299,13 +356,27 @@ class TransformerBlock(ForwardBase):
         and writing through ``tables`` [batch, T] physical block ids
         (serving/kv_slots.PagedKVCache).  Row-for-row the same math as
         :meth:`apply_step_slots` restricted to the gathered blocks —
-        greedy token parity with the dense slot cache is tested."""
-        from veles_tpu.ops.paged_attention import paged_decode_attention
+        greedy token parity with the dense slot cache is tested.  An
+        INT8 pool (``k_scale`` beside the buffers) quantizes the new
+        row on the scatter and dequantizes fused into the gather
+        (ops/paged_attention.py q8 paths; the pallas kernel on
+        accelerator targets)."""
+        from veles_tpu.ops.paged_attention import (
+            paged_decode_attention, paged_decode_attention_q8)
         q, k_new, v_new = self._qkv(params, x)
+        w8 = self.int8_decode
+        if "k_scale" in pool:
+            pk, pv, sk, sv, o = paged_decode_attention_q8(
+                q, k_new, v_new, pool["k"], pool["v"],
+                pool["k_scale"], pool["v_scale"], tables, pos,
+                self.heads, backend=self._backend())
+            return self._attn_tail(params, x, o, w8=w8), \
+                {"k": pk, "v": pv, "k_scale": sk, "v_scale": sv}
         pk, pv, o = paged_decode_attention(
             q, k_new, v_new, pool["k"], pool["v"], tables, pos,
             self.heads)
-        return self._attn_tail(params, x, o), {"k": pk, "v": pv}
+        return self._attn_tail(params, x, o, w8=w8), \
+            {"k": pk, "v": pv}
 
     def apply_verify_paged(self, params, x, pos, lens, tables, pool):
         """Speculative-decoding VERIFY step: score a width-K1 token
@@ -315,14 +386,36 @@ class TransformerBlock(ForwardBase):
         block) — against the paged pool in ONE pass.  Position-for-
         position the same math as :meth:`apply_step_paged` (its
         K1 = 1 special case), so accepting the matched prefix of the
-        scored run reproduces sequential decode exactly."""
-        from veles_tpu.ops.paged_attention import \
-            paged_verify_attention
+        scored run reproduces sequential decode exactly.
+
+        INT8 pools always take the fused q8 verify (quantizing
+        scatter + dequant-fused attend); fp32 pools take the PR 9
+        two-pass path unless ``root.common.serving.fused_verify`` is
+        set — the fused single-pass variant is allclose, not
+        bit-identical, so the parity baseline stays two-pass."""
+        from veles_tpu.ops.paged_attention import (
+            paged_verify_attention, paged_verify_attention_fused,
+            paged_verify_attention_q8)
         q, k_new, v_new = self._qkv(params, x)
-        pk, pv, o = paged_verify_attention(
-            q, k_new, v_new, pool["k"], pool["v"], tables, pos, lens,
-            self.heads)
-        return self._attn_tail(params, x, o), {"k": pk, "v": pv}
+        w8 = self.int8_decode
+        if "k_scale" in pool:
+            pk, pv, sk, sv, o = paged_verify_attention_q8(
+                q, k_new, v_new, pool["k"], pool["v"],
+                pool["k_scale"], pool["v_scale"], tables, pos, lens,
+                self.heads, backend=self._backend())
+            return self._attn_tail(params, x, o, w8=w8), \
+                {"k": pk, "v": pv, "k_scale": sk, "v_scale": sv}
+        from veles_tpu.config import root
+        if root.common.serving.get("fused_verify", False):
+            pk, pv, o = paged_verify_attention_fused(
+                q, k_new, v_new, pool["k"], pool["v"], tables, pos,
+                lens, self.heads, backend=self._backend())
+        else:
+            pk, pv, o = paged_verify_attention(
+                q, k_new, v_new, pool["k"], pool["v"], tables, pos,
+                lens, self.heads)
+        return self._attn_tail(params, x, o, w8=w8), \
+            {"k": pk, "v": pv}
 
     def apply_step_slots(self, params, x, pos, cache):
         """Decode ONE position PER ROW: x [batch, 1, d] where row n
@@ -395,6 +488,8 @@ class TransformerBlock(ForwardBase):
             cfg["attn_block_size"] = int(self.attn_block_size)
         if self.attn_impl:  # an explicit core pin must survive export
             cfg["attn_impl"] = self.attn_impl
+        if self.int8_decode:  # v2 key — omit when unused
+            cfg["int8_decode"] = True
         return cfg
 
 

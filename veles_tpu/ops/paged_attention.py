@@ -30,13 +30,47 @@ cache (tested in tests/test_serving.py).  The width-K cousin
 :func:`paged_verify_attention` scores a run of K1 consecutive tokens
 per row in one pass — the speculative-decoding verify step
 (tests/test_spec.py proves spec-on/spec-off token parity).  This jnp formulation lowers
-to a gather + batched GEMM on every backend; a fused pallas kernel
-(keeping the gathered blocks in VMEM) would slot in behind the same
-signature, the way ``ops/flash.py`` fronts the training attention.
+to a gather + batched GEMM on every backend; the fused pallas kernel
+(``ops/pallas_paged.py`` — gathered blocks stay in VMEM, dequant
+fused for int8 pools) slots in behind the same signatures on
+accelerator targets, the way ``ops/flash.py`` fronts the training
+attention.  The ``*_q8`` variants below serve INT8 pools (per-row
+scales beside the blocks; see serving/kv_slots.PagedKVCache), and
+``paged_verify_attention_fused`` is the single-pass verify that
+keeps the run's K/V out of the pool round-trip.
 """
 
 import jax
 import jax.numpy as jnp
+
+#: symmetric int8 quantization range — the KV pools store
+#: round(x / scale) with scale = rowmax(|x|) / 127, one f32 scale per
+#: (block, row) living beside the pools, so every token row
+#: round-trips within amax/254 per element and the trash block's
+#: all-zero rows dequantize to exactly 0.0 (the masked-garbage-is-
+#: finite invariant the fp32 path already relies on)
+INT8_QMAX = 127.0
+
+
+def quantize_kv_rows(x):
+    """Per-row symmetric int8 quantization of K/V rows ``x``
+    [..., d]: returns ``(q, scale)`` with ``q`` int8 [..., d] and
+    ``scale`` f32 [...] such that ``q * scale ~= x`` (absmax scaling;
+    an all-zero row gets scale 0 and dequantizes to exact zeros)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = amax / INT8_QMAX
+    q = jnp.where(scale[..., None] > 0.0,
+                  xf / jnp.maximum(scale[..., None], 1e-30), 0.0)
+    q = jnp.clip(jnp.round(q), -INT8_QMAX, INT8_QMAX)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv_rows`: ``q`` int8 [..., d],
+    ``scale`` [...] → [..., d] in ``dtype``."""
+    return (q.astype(jnp.float32)
+            * scale[..., None].astype(jnp.float32)).astype(dtype)
 
 
 def paged_verify_attention(q, k_new, v_new, pool_k, pool_v, tables,
@@ -131,3 +165,172 @@ def paged_decode_attention(q, k_new, v_new, pool_k, pool_v, tables,
     probs = jax.nn.softmax(logits, axis=-1)
     return pk, pv, jnp.einsum("bhqk,bkhd->bqhd", probs,
                               vh).reshape(b, 1, d)
+
+
+# -- int8 quantized pools ---------------------------------------------------
+#
+# Same math as the fp32 paths above with TWO twists: the new token's
+# K/V rows quantize ON the scatter (per-row absmax scale stored at the
+# same [block, row] coordinates, so scales follow blocks through every
+# donate/evict/gather move by construction), and the gather
+# dequantizes into the compute dtype before the usual masked softmax
+# (fp32 accumulation unchanged).  On an accelerator target the gather
+# + dequant + attend runs as the fused pallas kernel
+# (ops/pallas_paged.py) instead of materializing the [B, T·bs, d]
+# dequantized gather.
+
+def _q8_ctx(q, pk, pv, sk, sv, tables, qpos, heads, backend):
+    """Shared gather→dequant→attend tail of the q8 decode/verify
+    paths: queries [B, K1, d] at positions ``qpos`` [B, K1], causal
+    mask ``key <= qpos`` per query."""
+    from veles_tpu import dtypes
+    from veles_tpu.ops.common import use_interpret
+    if not use_interpret(backend):
+        from veles_tpu.ops.pallas_paged import pallas_paged_attend
+        return pallas_paged_attend(q, pk, pv, tables, qpos, heads,
+                                   scale_k=sk, scale_v=sv,
+                                   backend=backend)
+    cd = dtypes.compute_dtype()
+    b, k1, d = q.shape
+    h = heads
+    hd = d // h
+    bs = pk.shape[1]
+    kg = dequantize_kv(pk[tables], sk[tables], cd)
+    vg = dequantize_kv(pv[tables], sv[tables], cd)
+    length = kg.shape[1] * bs
+    qh = q.reshape(b, k1, h, hd)
+    kh = kg.reshape(b, length, h, hd)
+    vh = vg.reshape(b, length, h, hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) \
+        * (1.0 / jnp.sqrt(hd))
+    mask = (jnp.arange(length)[None, None, :]
+            <= qpos[:, :, None])[:, None]
+    logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vh).reshape(b, k1, d)
+
+
+def paged_decode_attention_q8(q, k_new, v_new, pool_k, pool_v,
+                              scale_k, scale_v, tables, pos, heads,
+                              backend=None):
+    """:func:`paged_decode_attention` over INT8 pools: the new
+    token's K/V quantize on the scatter (scale written beside them at
+    ``scale[blk, off]``), the gather dequantizes block rows with
+    their scales, attention accumulates in f32.  ``scale_k`` /
+    ``scale_v`` [num_blocks, block_size] f32 ride beside the pools.
+
+    Returns ``(pool_k', pool_v', scale_k', scale_v', context)``."""
+    bs = pool_k.shape[1]
+    blk = jnp.take_along_axis(tables, (pos // bs)[:, None],
+                              axis=1)[:, 0]
+    off = pos % bs
+    qk, sk_new = quantize_kv_rows(k_new[:, 0])
+    qv, sv_new = quantize_kv_rows(v_new[:, 0])
+    pk = pool_k.at[blk, off].set(qk)
+    pv = pool_v.at[blk, off].set(qv)
+    sk = scale_k.at[blk, off].set(sk_new)
+    sv = scale_v.at[blk, off].set(sv_new)
+    ctx = _q8_ctx(q, pk, pv, sk, sv, tables, pos[:, None], heads,
+                  backend)
+    return pk, pv, sk, sv, ctx
+
+
+def paged_verify_attention_q8(q, k_new, v_new, pool_k, pool_v,
+                              scale_k, scale_v, tables, pos, lens,
+                              heads, backend=None):
+    """:func:`paged_verify_attention` over INT8 pools — the fused
+    speculative-verify path: ONE quantizing scatter of the width-K1
+    run (padding past ``lens`` lands in the trash block, scale
+    included), then ONE gather→dequant→attend pass (the pallas kernel
+    on accelerator targets).  In-pass keys read back QUANTIZED —
+    verify sees exactly the cache state later decode steps will read,
+    which is what the quality gate measures.
+
+    Returns ``(pool_k', pool_v', scale_k', scale_v', context)``."""
+    b, k1, d = q.shape
+    bs = pool_k.shape[1]
+    qpos = pos[:, None] + jnp.arange(k1)[None, :]          # [B, K1]
+    valid = jnp.arange(k1)[None, :] < lens[:, None]        # [B, K1]
+    blk = jnp.take_along_axis(tables, qpos // bs, axis=1)
+    blk = jnp.where(valid, blk, 0)                         # pad -> trash
+    off = jnp.where(valid, qpos % bs, 0)
+    qk, sk_new = quantize_kv_rows(k_new)
+    qv, sv_new = quantize_kv_rows(v_new)
+    pk = pool_k.at[blk, off].set(qk)
+    pv = pool_v.at[blk, off].set(qv)
+    sk = scale_k.at[blk, off].set(sk_new)
+    sv = scale_v.at[blk, off].set(sv_new)
+    ctx = _q8_ctx(q, pk, pv, sk, sv, tables, qpos, heads, backend)
+    return pk, pv, sk, sv, ctx
+
+
+def paged_verify_attention_fused(q, k_new, v_new, pool_k, pool_v,
+                                 tables, pos, lens, heads,
+                                 backend=None):
+    """Single-pass fp32 verify.  The PR 9 two-pass path scatters the
+    run's K/V into the POOL and then gathers it back out before
+    attending — the attention waits on a write to (and under jit
+    without donation, a full copy of) the multi-megabyte pool just to
+    read back the handful of rows it wrote.  Here the gather reads
+    the PRE-scatter pool and the run's rows are scattered into the
+    small GATHERED buffer instead ([B, T·bs, d] — the write is
+    O(batch·k), not O(pool)), which takes the pool update off the
+    attention's critical path entirely: the engine donates the pool
+    buffers to this step, so the scatter lands in place and the
+    per-step pool copy disappears.
+
+    The gathered buffer ends up elementwise IDENTICAL to the
+    two-pass gather at every causally-visible position, and the
+    attention subgraph has the same shapes and ops — valid output
+    rows are bit-identical to :func:`paged_verify_attention`
+    (rows past ``lens`` are garbage under both, as documented).
+
+    On an accelerator target the gather+attend half runs as the
+    fused pallas kernel instead (ops/pallas_paged.py), which also
+    never materializes the gather.
+
+    Returns ``(pool_k', pool_v', context)`` like the two-pass path."""
+    from veles_tpu import dtypes
+    from veles_tpu.ops.common import use_interpret
+    cd = dtypes.compute_dtype()
+    b, k1, d = q.shape
+    h = heads
+    hd = d // h
+    bs = pool_k.shape[1]
+    qpos = pos[:, None] + jnp.arange(k1)[None, :]          # [B, K1]
+    valid = jnp.arange(k1)[None, :] < lens[:, None]        # [B, K1]
+    blk = jnp.take_along_axis(tables, qpos // bs, axis=1)
+    blk = jnp.where(valid, blk, 0)                         # pad -> trash
+    off = jnp.where(valid, qpos % bs, 0)
+    pk = pool_k.at[blk, off].set(k_new.astype(pool_k.dtype))
+    pv = pool_v.at[blk, off].set(v_new.astype(pool_v.dtype))
+    if not use_interpret(backend):
+        # accelerator target: the fused pallas kernel attends over
+        # the POST-scatter pool (same numerics as the two-pass jnp
+        # path, without materializing the gather)
+        from veles_tpu.ops.pallas_paged import pallas_paged_attend
+        return pk, pv, pallas_paged_attend(q, pk, pv, tables, qpos,
+                                           heads, backend=backend)
+    kg = pool_k[tables].astype(cd)                # pre-scatter pools
+    vg = pool_v[tables].astype(cd)
+    length = kg.shape[1] * bs
+    kg = kg.reshape(b, length, d)
+    vg = vg.reshape(b, length, d)
+    # the run's rows land in the GATHERED buffer — the same values
+    # the two-pass gather reads back at these positions (per-row
+    # qpos entries are distinct; positions past a row's len only
+    # ever feed masked scores)
+    rows = jnp.arange(b)[:, None]
+    kg = kg.at[rows, qpos].set(k_new.astype(cd))
+    vg = vg.at[rows, qpos].set(v_new.astype(cd))
+    qh = q.reshape(b, k1, h, hd)
+    kh = kg.reshape(b, length, h, hd)
+    vh = vg.reshape(b, length, h, hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) \
+        * (1.0 / jnp.sqrt(hd))
+    mask = (jnp.arange(length)[None, None, :]
+            <= qpos[:, :, None])[:, None]
+    logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return pk, pv, jnp.einsum("bhqk,bkhd->bqhd", probs,
+                              vh).reshape(b, k1, d)

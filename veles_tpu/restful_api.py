@@ -90,10 +90,10 @@ class RESTfulAPI(Unit):
                  max_slots=4, serving_window=None, max_queue=32,
                  max_steps=None, max_batch=None, serving_kv=None,
                  serving_block_size=None, serving_kv_blocks=None,
-                 serving_prefill_chunk=None, serving_spec=None,
-                 serving_spec_k=None, serving_prefix_cache=None,
-                 serving_warm_buckets=None, replica_id=None,
-                 **kwargs):
+                 serving_kv_dtype=None, serving_prefill_chunk=None,
+                 serving_spec=None, serving_spec_k=None,
+                 serving_prefix_cache=None, serving_warm_buckets=None,
+                 replica_id=None, **kwargs):
         super(RESTfulAPI, self).__init__(workflow, **kwargs)
         self.loader = loader
         #: fleet identity: every reply carries it as X-Veles-Replica
@@ -122,6 +122,10 @@ class RESTfulAPI(Unit):
         self.serving_kv = serving_kv
         self.serving_block_size = serving_block_size
         self.serving_kv_blocks = serving_kv_blocks
+        #: KV pool storage dtype ("fp32"/"int8"; None defers to
+        #: ``root.common.serving.kv_dtype``) — int8 roughly doubles
+        #: concurrent streams per HBM budget, quality-gated
+        self.serving_kv_dtype = serving_kv_dtype
         self.serving_prefill_chunk = serving_prefill_chunk
         #: speculative decoding / radix prefix cache (None defers to
         #: ``root.common.serving.{spec,spec_k,prefix_cache}``)
@@ -272,6 +276,7 @@ class RESTfulAPI(Unit):
                     kv=self.serving_kv,
                     block_size=self.serving_block_size,
                     kv_blocks=self.serving_kv_blocks,
+                    kv_dtype=self.serving_kv_dtype,
                     prefill_chunk=self.serving_prefill_chunk,
                     spec=self.serving_spec,
                     spec_k=self.serving_spec_k,

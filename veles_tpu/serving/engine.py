@@ -181,6 +181,7 @@ def paged_decode_step(forwards, cache, toks, pos, tables, temps,
     b, t = tables.shape
     cache_key = (_arch_sig(forwards), b, t, cache.block_size,
                  cache.capacity_blocks,
+                 getattr(cache, "kv_dtype", "fp32"),
                  str(dtypes.compute_dtype()),
                  str(dtypes.matmul_precision()))
     fn = _paged_step_cached(cache_key,
@@ -229,8 +230,16 @@ def _make_verify_step(forwards):
 
 
 @functools.lru_cache(maxsize=64)
-def _verify_step_cached(cache_key, closure):
-    return track_jit("serving.verify_step", jax.jit(closure.fn))
+def _verify_step_cached(cache_key, closure, donate=False):
+    # the fused/int8 verify paths take the pool update off the
+    # attention's critical path (ops/paged_attention.py), so the pool
+    # buffers can be DONATED — the scatter lands in place instead of
+    # copying the whole pool every step.  Safe: the caller swaps
+    # cache.pools for the returned pools immediately (the donated
+    # arrays are never read again).  The legacy two-pass executable
+    # keeps the PR 9 no-donation behavior byte-for-byte.
+    return track_jit("serving.verify_step", jax.jit(
+        closure.fn, donate_argnums=(9,) if donate else ()))
 
 
 def verify_step_paged(forwards, cache, toks, pos, lens, tables,
@@ -255,17 +264,25 @@ def verify_step_paged(forwards, cache, toks, pos, lens, tables,
     sample, the "free" correction token), which reproduces the
     spec-off stream bit-for-bit for greedy AND per-seed sampling."""
     from veles_tpu import dtypes
+    from veles_tpu.config import root
     params = _device_params(forwards)
     tables = jnp.asarray(tables, jnp.int32)
     toks = jnp.asarray(toks, jnp.int32)
     b, t = tables.shape
     k1 = toks.shape[1]
+    # kv_dtype and the fused-verify knob both change the traced
+    # verify body (TransformerBlock.apply_verify_paged reads them at
+    # trace time) — they must key the executable or a toggle would
+    # silently reuse the stale trace
+    kv_dtype = getattr(cache, "kv_dtype", "fp32")
+    fused = bool(root.common.serving.get("fused_verify", False))
     cache_key = (_arch_sig(forwards), b, k1, t, cache.block_size,
-                 cache.capacity_blocks,
+                 cache.capacity_blocks, kv_dtype, fused,
                  str(dtypes.compute_dtype()),
                  str(dtypes.matmul_precision()))
     fn = _verify_step_cached(cache_key,
-                             _StepClosure(_make_verify_step(forwards)))
+                             _StepClosure(_make_verify_step(forwards)),
+                             donate=fused or kv_dtype == "int8")
     nxt, cache.pools = fn(
         params, toks, jnp.asarray(pos, jnp.int32),
         jnp.asarray(lens, jnp.int32), tables,

@@ -1,0 +1,128 @@
+"""Quality gate for the quantized KV cache.
+
+int8 pools trade per-element precision for ~2x streams per HBM
+budget; that trade must be MEASURED, not asserted.  This module
+teacher-forces a sequence through the REAL paged verify path —
+block-size-wide :meth:`apply_verify_paged` passes, so every key a
+position attends over was quantized when its block was written,
+exactly the cache state live decode reads — once over fp32 pools and
+once over int8, and reports:
+
+- ``ce_fp32`` / ``ce_int8`` / ``ce_delta`` — mean next-token
+  cross-entropy (nats) under each pool dtype, and the int8 penalty;
+- ``top1_agreement`` — the fraction of positions whose greedy argmax
+  matches between the two runs (what a greedy client would notice);
+- ``within_tolerance`` — ``ce_delta <= KV_QUANT_CE_TOLERANCE``, the
+  bound tier-1 asserts (tests/test_kv_quant.py) and ``quality.py``
+  records, which is what gates flipping ``kv_dtype`` on a fleet.
+
+The harness drives the chain eagerly (no jit) — it is a measurement
+rig, not a serving path; ``quality.py`` runs it on the trained tiny
+chain and merges the record into the quality JSON.
+"""
+
+import numpy
+
+import jax.numpy as jnp
+
+#: declared int8-KV quality bound, in nats of mean next-token CE
+#: delta vs fp32 pools on the quality chains.  Per-row absmax int8
+#: keeps K/V within amax/254 per element; on the trained tiny chain
+#: the measured delta sits well under 0.02 — the bound leaves margin
+#: without ever excusing a broken quant path (a scale bug costs
+#: whole nats)
+KV_QUANT_CE_TOLERANCE = 0.05
+
+
+def _verify_pass(forwards, params, toks, pos, lens, tables, pools):
+    """One teacher-forced chunk through the chain's verify path —
+    the same unit dispatch as ``engine._make_verify_step``, returning
+    logits instead of samples."""
+    h = jnp.asarray(toks, jnp.int32)
+    out = dict(pools)
+    for i, u in enumerate(forwards):
+        if hasattr(u, "init_cache"):
+            h, out[i] = u.apply_verify_paged(params[i], h, pos, lens,
+                                             tables, out[i])
+        elif hasattr(u, "apply_verify_slots"):
+            h = u.apply_verify_slots(params[i], h, pos)
+        else:
+            h = u.apply(params[i], h)
+    return numpy.asarray(h.astype(jnp.float32)), out
+
+
+def teacher_forced_logits(forwards, seq, block_size=16,
+                          kv_dtype="fp32"):
+    """Per-position next-token logits of ``seq`` through the paged
+    verify path over ``kv_dtype`` pools, fed ``block_size`` tokens
+    per pass (the spec-verify width regime: keys within a pass are
+    written this pass, everything earlier reads back through the
+    pool — quantized when int8).  Returns [L, vocab] f32 where row j
+    predicts ``seq[j + 1]`` (L = the whole-block prefix length)."""
+    from veles_tpu import dtypes
+    from veles_tpu.models.generate import _device_params
+    params = _device_params(forwards)
+    bs = int(block_size)
+    n_blocks = len(seq) // bs
+    if n_blocks < 1:
+        raise ValueError("sequence shorter than one block")
+    pools = {}
+    for i, u in enumerate(forwards):
+        if not hasattr(u, "init_cache"):
+            continue
+        if not hasattr(u, "init_block_pool"):
+            raise ValueError("%s has no init_block_pool"
+                             % type(u).__name__)
+        pools[i] = u.init_block_pool(n_blocks + 1, bs,
+                                     dtypes.compute_dtype(),
+                                     kv_dtype=kv_dtype)
+    tables = jnp.asarray(
+        numpy.arange(1, n_blocks + 1, dtype=numpy.int32)[None, :])
+    lens = jnp.asarray([bs], jnp.int32)
+    rows = []
+    for t in range(n_blocks):
+        chunk = numpy.asarray(seq[t * bs:(t + 1) * bs],
+                              numpy.int32)[None, :]
+        pos = jnp.asarray([t * bs], jnp.int32)
+        logits, pools = _verify_pass(forwards, params, chunk, pos,
+                                     lens, tables, pools)
+        rows.append(logits[0])
+    return numpy.concatenate(rows, axis=0)
+
+
+def _mean_ce(logits, targets):
+    z = logits - logits.max(axis=-1, keepdims=True)
+    logp = z - numpy.log(numpy.exp(z).sum(axis=-1, keepdims=True))
+    return float(-logp[numpy.arange(len(targets)), targets].mean())
+
+
+def kv_quant_quality(forwards, seqs, block_size=16,
+                     tolerance=KV_QUANT_CE_TOLERANCE):
+    """Measure the int8-KV quality cost on ``seqs`` (token lists):
+    teacher-forced CE + greedy top-1 agreement, fp32 pools vs int8,
+    through the identical verify path.  Returns the record quality.py
+    stores and tier-1 asserts on."""
+    ce_fp, ce_q8, agree, total = [], [], 0, 0
+    for seq in seqs:
+        lf = teacher_forced_logits(forwards, seq, block_size, "fp32")
+        lq = teacher_forced_logits(forwards, seq, block_size, "int8")
+        n = min(len(lf), len(seq) - 1)   # row j predicts seq[j + 1]
+        targets = numpy.asarray(seq[1:n + 1], numpy.intp)
+        ce_fp.append(_mean_ce(lf[:n], targets))
+        ce_q8.append(_mean_ce(lq[:n], targets))
+        agree += int((lf[:n].argmax(-1) == lq[:n].argmax(-1)).sum())
+        total += n
+    ce_fp32 = float(numpy.mean(ce_fp))
+    ce_int8 = float(numpy.mean(ce_q8))
+    delta = ce_int8 - ce_fp32
+    return {
+        "kv_quant_ce_fp32": round(ce_fp32, 6),
+        "kv_quant_ce_int8": round(ce_int8, 6),
+        "kv_quant_ce_delta": round(delta, 6),
+        "kv_quant_top1_agreement": round(agree / total, 6)
+        if total else None,
+        "kv_quant_ce_tolerance": tolerance,
+        "kv_quant_within_tolerance": bool(delta <= tolerance),
+        "kv_quant_positions": total,
+        "kv_quant_block_size": int(block_size),
+    }

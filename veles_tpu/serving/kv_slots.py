@@ -97,6 +97,54 @@ def _gather_blocks_jit():
     return track_jit("serving.kv_gather_blocks", jax.jit(pair))
 
 
+def _quant_block_pair(pool_k, pool_v, scale_k, scale_v, src_k, src_v,
+                      ids, start):
+    # the int8 counterpart of _block_pair: quantize the staging rows
+    # per row on the way in, writing the f32 scales at the SAME
+    # [block, row] coordinates — scales follow blocks through every
+    # later move (donate / evict / gather) because block ids index
+    # both arrays
+    from veles_tpu.ops.paged_attention import quantize_kv_rows
+    n = ids.shape[0]
+    bs = pool_k.shape[1]
+    d = src_k.shape[-1]
+    sk = jax.lax.dynamic_slice(
+        src_k, (jnp.int32(0), start, jnp.int32(0)),
+        (1, n * bs, d))[0].reshape(n, bs, -1)
+    sv = jax.lax.dynamic_slice(
+        src_v, (jnp.int32(0), start, jnp.int32(0)),
+        (1, n * bs, d))[0].reshape(n, bs, -1)
+    qk, sck = quantize_kv_rows(sk)
+    qv, scv = quantize_kv_rows(sv)
+    return (pool_k.at[ids].set(qk), pool_v.at[ids].set(qv),
+            scale_k.at[ids].set(sck), scale_v.at[ids].set(scv))
+
+
+@functools.lru_cache(maxsize=1)
+def _insert_blocks_q8_jit():
+    # lazy like _gather_blocks_jit — no module-level executable ref
+    return track_jit("serving.kv_quant_insert_blocks",
+                     jax.jit(_quant_block_pair))
+
+
+@functools.lru_cache(maxsize=1)
+def _gather_blocks_q8_jit():
+    # warm-path gather out of an INT8 pool: dequantize the resident
+    # rows against their scales into the f32 staging row — the cold
+    # tail then attends over exactly the K/V later decode steps read
+    def pair(pool_k, pool_v, scale_k, scale_v, dst_k, dst_v, ids):
+        from veles_tpu.ops.paged_attention import dequantize_kv
+        n = ids.shape[0]
+        bs = pool_k.shape[1]
+        sk = dequantize_kv(pool_k[ids], scale_k[ids],
+                           dst_k.dtype).reshape(1, n * bs, -1)
+        sv = dequantize_kv(pool_v[ids], scale_v[ids],
+                           dst_v.dtype).reshape(1, n * bs, -1)
+        return (jax.lax.dynamic_update_slice(dst_k, sk, (0, 0, 0)),
+                jax.lax.dynamic_update_slice(dst_v, sv, (0, 0, 0)))
+    return track_jit("serving.kv_quant_gather_blocks", jax.jit(pair))
+
+
 def _insert_layer(layer, src, fn, *args):
     """Insert one layer's staging K/V via the paired jitted call,
     falling back per-name for exotic cache pytrees."""
@@ -190,10 +238,23 @@ class PagedKVCache:
     ``max_slots · ceil(window / block_size)``, so a default-sized pool
     admits everything the dense cache would).  ``window`` stays the
     per-request length bound (the positional-table limit), NOT a
-    per-request memory reservation."""
+    per-request memory reservation.
+
+    ``kv_dtype`` — ``"fp32"`` (the compute-dtype pools above; parity
+    baseline, byte-for-byte the PR 5 layout) or ``"int8"``: pools
+    stored as int8 with per-row f32 dequant scales
+    ([num_blocks, block_size], keys ``k_scale``/``v_scale``) living
+    beside them in the same per-layer dict.  Scales are indexed by
+    PHYSICAL block id exactly like the pools, so they follow blocks
+    through every ownership move — prefix-cache donation, eviction,
+    warm gather, preempt→resume — with no extra bookkeeping.
+    Inserts quantize (``serving.kv_quant_insert_blocks``), the warm
+    gather dequantizes (``serving.kv_quant_gather_blocks``), and the
+    decode/verify steps quantize-on-scatter / dequant-on-gather in
+    ``ops/paged_attention.py``."""
 
     def __init__(self, forwards, max_slots, window, block_size=16,
-                 kv_blocks=None):
+                 kv_blocks=None, kv_dtype="fp32"):
         from veles_tpu import dtypes
         self.max_slots = int(max_slots)
         self.window = int(window)
@@ -202,17 +263,37 @@ class PagedKVCache:
             raise ValueError("need max_slots >= 1 and window >= 2")
         if self.block_size < 1:
             raise ValueError("need block_size >= 1")
+        if kv_dtype not in ("fp32", "int8"):
+            raise ValueError("kv_dtype must be 'fp32' or 'int8'")
+        self.kv_dtype = kv_dtype
         self.blocks_per_slot = -(-self.window // self.block_size)
         self.capacity_blocks = int(
             kv_blocks or self.max_slots * self.blocks_per_slot)
         if self.capacity_blocks < 1:
             raise ValueError("need kv_blocks >= 1")
         num = self.capacity_blocks + 1          # + the trash block 0
-        self.pools = {
-            i: u.init_cache(num, self.block_size,
-                            dtypes.compute_dtype())
-            for i, u in enumerate(forwards)
-            if hasattr(u, "init_cache")}
+        if kv_dtype == "int8":
+            # int8 needs block-pool-aware units (the scale layout is
+            # theirs to consume in apply_step_paged)
+            missing = [type(u).__name__ for u in forwards
+                       if hasattr(u, "init_cache")
+                       and not hasattr(u, "init_block_pool")]
+            if missing:
+                raise ValueError(
+                    "kv_dtype='int8' needs init_block_pool on every "
+                    "cacheable block; missing on %s" % missing)
+            self.pools = {
+                i: u.init_block_pool(num, self.block_size,
+                                     dtypes.compute_dtype(),
+                                     kv_dtype="int8")
+                for i, u in enumerate(forwards)
+                if hasattr(u, "init_cache")}
+        else:
+            self.pools = {
+                i: u.init_cache(num, self.block_size,
+                                dtypes.compute_dtype())
+                for i, u in enumerate(forwards)
+                if hasattr(u, "init_cache")}
         if not self.pools:
             raise ValueError("chain has no cacheable blocks")
         self._free_slots = list(range(self.max_slots - 1, -1, -1))
@@ -245,6 +326,21 @@ class PagedKVCache:
     @property
     def used_blocks(self):
         return self.capacity_blocks - len(self._free_blocks)
+
+    def bytes_per_token(self):
+        """HBM bytes ONE cached token costs across every layer's
+        pools — the denominator of "streams per HBM dollar" (int8
+        pays ``2·d + 8`` per layer where the compute dtype pays
+        ``2·d·itemsize``; reported in ``/serving/metrics`` and
+        Prometheus as ``kv_bytes_per_token``)."""
+        total = 0
+        for layer in self.pools.values():
+            for name, arr in layer.items():
+                if name.endswith("_scale"):   # one scale per row
+                    total += arr.dtype.itemsize
+                else:
+                    total += arr.shape[-1] * arr.dtype.itemsize
+        return int(total)
 
     def blocks_needed(self, total_tokens):
         return -(-max(int(total_tokens), 1) // self.block_size)
@@ -350,6 +446,19 @@ class PagedKVCache:
         assert len(owned) == self.capacity_blocks, \
             "block leaked: %d tracked of %d" % (len(owned),
                                                 self.capacity_blocks)
+        if self.kv_dtype == "int8":
+            # scales-follow-blocks: every int8 pool must carry scale
+            # arrays indexed by the same block axis (content checks
+            # ride the gather/insert tests; this catches a layer
+            # whose scales were dropped on a functional swap)
+            for i, layer in self.pools.items():
+                assert {"k", "v", "k_scale", "v_scale"} \
+                    <= set(layer), \
+                    "layer %s lost its scale arrays" % (i,)
+                for name in ("k", "v"):
+                    assert layer[name + "_scale"].shape \
+                        == layer[name].shape[:2], \
+                        "layer %s %s_scale shape drifted" % (i, name)
 
     def table_rows(self, slots, width):
         """The packed [len(slots), width] block-table batch the
@@ -383,8 +492,16 @@ class PagedKVCache:
                 raise ValueError(
                     "staging width %d < %d blocks x %d" %
                     (wk, need, self.block_size))
-            self.pools[i] = _insert_layer(layer, src, _insert_blocks,
-                                          ids, start)
+            if self.kv_dtype == "int8":
+                k, v, sk, sv = _insert_blocks_q8_jit()(
+                    layer["k"], layer["v"], layer["k_scale"],
+                    layer["v_scale"], src["k"], src["v"], ids, start)
+                self.pools[i] = {"k": k, "v": v, "k_scale": sk,
+                                 "v_scale": sv}
+            else:
+                self.pools[i] = _insert_layer(layer, src,
+                                              _insert_blocks,
+                                              ids, start)
 
     def load_staging(self, row_caches, ids):
         """Copy resident blocks ``ids`` (a matched prompt prefix)
@@ -396,6 +513,15 @@ class PagedKVCache:
         if not len(ids):
             return row_caches
         ids = jnp.asarray(numpy.asarray(ids, numpy.int32))
+        if self.kv_dtype == "int8":
+            fn = _gather_blocks_q8_jit()
+            out = {}
+            for i, layer in self.pools.items():
+                src = row_caches[i]
+                k, v = fn(layer["k"], layer["v"], layer["k_scale"],
+                          layer["v_scale"], src["k"], src["v"], ids)
+                out[i] = {"k": k, "v": v}
+            return out
         fn = _gather_blocks_jit()
         out = {}
         for i, layer in self.pools.items():

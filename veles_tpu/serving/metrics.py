@@ -223,6 +223,16 @@ def _registry_series():
             "veles_serving_kv_blocks_free",
             "paged-KV blocks available for admission (memory-pressure"
             " rejections start when a prompt's budget exceeds this)"),
+        "kv_dtype": metrics.gauge(
+            "veles_serving_kv_dtype",
+            "KV pool storage dtype in use (1 on the active dtype's "
+            "series — fp32 is the parity baseline, int8 the "
+            "quantized ~2x-streams layout)", labelnames=("dtype",)),
+        "kv_bytes_per_token": metrics.gauge(
+            "veles_serving_kv_bytes_per_token",
+            "HBM bytes one cached token costs across all layers' "
+            "pools (scales included) — the streams-per-HBM-dollar "
+            "denominator"),
         "prefill_chunks": metrics.counter(
             "veles_serving_prefill_chunk_total",
             "prompt chunks prefilled (chunked-prefill path)"),
@@ -667,6 +677,16 @@ class ServingMetrics:
     def set_kv_blocks(self, used, free):
         self._global["kv_blocks_used"].set(int(used))
         self._global["kv_blocks_free"].set(int(free))
+
+    def set_kv_dtype(self, kv_dtype, bytes_per_token):
+        """Advertise the KV pool layout (once, at cache build): the
+        active dtype's labeled series reads 1, the other 0 — a
+        dashboard can tell at a glance which fleet replicas run
+        quantized pools and what a cached token costs them."""
+        for d in ("fp32", "int8"):
+            self._global["kv_dtype"].labels(dtype=d).set(
+                1 if d == kv_dtype else 0)
+        self._global["kv_bytes_per_token"].set(int(bytes_per_token))
 
     def record_step(self, active, slots):
         with self._lock:

@@ -113,10 +113,24 @@ Config knobs (``root.common.serving.*``, overridable per scheduler):
 ``kv`` ("paged"/"dense"), ``block_size`` (tokens per KV block,
 default 16), ``kv_blocks`` (pool capacity in blocks; default the
 dense-equivalent ``max_slots · ceil(window / block_size)``),
+``kv_dtype`` ("fp32" default — the bit-parity baseline — or "int8":
+paged pools stored quantized with per-row scales beside the block
+tables, roughly halving bytes per cached token so the same HBM
+budget decodes ~2x the concurrent streams; quality-gated by
+``serving/kv_quality.py`` and ``quality.py``'s kv_quant record.
+Under int8 a preempt→resume continues within quantization noise
+rather than bit-identically — the re-prefill computes deeper
+layers from f32 staging attention where the original decode read
+dequantized keys — while warm radix resubmits stay exact because
+matched blocks are REUSED, not recomputed; the fp32 default keeps
+every PR 7 bit-exactness contract),
 ``prefill_chunk`` (chunk width in tokens, rounded up to a power of
 two; 0 disables chunking, default 64), ``request_timeout`` /
 ``watchdog`` / ``shed_block_factor`` (lifecycle knobs above; 0
 disables each), ``spec`` / ``spec_k`` (speculative decoding),
+``fused_verify`` (score the spec run single-pass instead of the
+scatter-then-gather two-pass — allclose, not bit-identical, so the
+parity baseline keeps it off; int8 pools always verify fused),
 ``prefix_cache`` / ``prefix_evict`` (the radix cache above).
 
 Observability: every request carries a **trace id**
@@ -305,7 +319,7 @@ class InferenceScheduler(Logger):
     def __init__(self, forwards, max_slots=4, window=None,
                  max_queue=32, queue_timeout=30.0, prefill_bucket=8,
                  kv=None, block_size=None, kv_blocks=None,
-                 prefill_chunk=None, warm_buckets=None,
+                 kv_dtype=None, prefill_chunk=None, warm_buckets=None,
                  request_timeout=None, watchdog=None,
                  shed_block_factor=None, spec=None, spec_k=None,
                  prefix_cache=None, prefix_evict=None):
@@ -344,6 +358,19 @@ class InferenceScheduler(Logger):
         self.kv_blocks = int(
             kv_blocks or self.max_slots * self.blocks_per_slot) \
             if self.kv == "paged" else 0
+        #: KV pool storage dtype: "fp32" (compute-dtype pools; the
+        #: parity baseline — token streams byte-identical to PR 5-11)
+        #: or "int8" (per-row scales beside the block tables, ~half
+        #: the bytes per cached token → ~2x streams per HBM budget;
+        #: quality-gated, see serving/kv_quality.py).  Paged only.
+        kv_dtype = kv_dtype or _serving_conf("kv_dtype", "fp32")
+        if kv_dtype not in ("fp32", "int8"):
+            raise ValueError("kv_dtype must be 'fp32' or 'int8'")
+        if kv_dtype == "int8" and self.kv != "paged":
+            self.info("kv_dtype='int8' needs the paged cache; "
+                      "falling back to fp32")
+            kv_dtype = "fp32"
+        self.kv_dtype = kv_dtype
         chunk = prefill_chunk if prefill_chunk is not None \
             else _serving_conf("prefill_chunk", 64)
         chunk = int(chunk or 0)
@@ -794,6 +821,9 @@ class InferenceScheduler(Logger):
                "prefilling": len(self._prefilling)}
         cache = self.cache_
         if self.kv == "paged":
+            out["kv_dtype"] = self.kv_dtype
+            out["kv_bytes_per_token"] = \
+                cache.bytes_per_token() if cache is not None else None
             out["kv_block_size"] = self.block_size
             out["kv_blocks_total"] = self.kv_blocks
             # the loop thread owns the free lists; these reads are
@@ -940,7 +970,8 @@ class InferenceScheduler(Logger):
             return PagedKVCache(self.forwards, self.max_slots,
                                 self.window,
                                 block_size=self.block_size,
-                                kv_blocks=self.kv_blocks)
+                                kv_blocks=self.kv_blocks,
+                                kv_dtype=self.kv_dtype)
         return SlotKVCache(self.forwards, self.max_slots, self.window)
 
     def _warm_paged(self, cache):
@@ -995,6 +1026,9 @@ class InferenceScheduler(Logger):
             if self.kv == "paged" and self.warm_buckets:
                 self._warm_paged(cache)
             self.cache_ = cache
+            if self.kv == "paged":
+                self.stats.set_kv_dtype(self.kv_dtype,
+                                        cache.bytes_per_token())
         except Exception as e:  # surface init failures to clients
             with self._wake:
                 self._closed = True
