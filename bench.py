@@ -1342,6 +1342,204 @@ def bench_kv_quant(dev):
     return out
 
 
+def bench_tp(dev):
+    """Tensor-parallel paged serving + disaggregated prefill/decode
+    (the PR-13 scale-out pair; ``serving/tp.py`` + ``serving/
+    disagg.py``):
+
+    - ``tp_max_dmodel_per_chip_hbm`` — the widest d_model whose
+      weights PLUS full ``kv_blocks`` pool fit a FIXED per-chip HBM
+      budget, measured on the real device arrays (sharded arrays
+      count nbytes/tp per chip, replicated ones in full), at tp=1 vs
+      tp=2 — the serve-a-model-bigger-than-one-chip headline; the
+      tp=2 winner is then actually SERVED once to prove the width is
+      servable, not just allocatable;
+    - ``tp_aggregate_tokens_per_sec`` — decode throughput at 4
+      concurrent streams per mesh shape ({1} vs {"tp": 2}).  On the
+      CPU substrate the tp=2 number measures the COLLECTIVE overhead
+      floor (tiny matmuls + psum on one core) — the metric exists so
+      accelerator runs can read scaling off the same key;
+    - ``disagg_ttft_p95_ms`` — short-request TTFT p95 under mixed
+      long-prompt traffic, colocated (chunked prefill interleaves
+      with decode on ONE engine) vs disaggregated (longs prefill on
+      a specialist, the decode replica only ever imports blocks) —
+      the DistServe interference claim on this engine.
+
+    Sized down hard on CPU so driver runs stay fast."""
+    import concurrent.futures as cf
+
+    from veles_tpu.serving import (
+        InferenceScheduler, per_chip_bytes)
+
+    out = {}
+    cpu = dev.jax_device.platform == "cpu"
+    vocab = 32 if cpu else 32768
+    layers = 2 if cpu else 8
+    window = 64 if cpu else 1024
+    block = 8
+    kv_blocks = 16 if cpu else 512
+
+    # -- max servable d_model at a fixed per-chip budget -----------------
+    def chip_cost(d_model, tp):
+        fw = _serving_chain(dev, d_model, layers, 4, vocab, window,
+                            "tp-width-%d-%d" % (d_model, tp))
+        sch = InferenceScheduler(
+            fw, max_slots=2, window=window, kv="paged",
+            block_size=block, kv_blocks=kv_blocks, prefill_chunk=0,
+            spec=False, prefix_cache=False, warm_buckets=False,
+            tp=tp).start()
+        assert sch.tp == tp, \
+            "tp=%d fell back (devices? divisibility?) — the bench " \
+            "numbers would silently measure the unsharded path" % tp
+        try:
+            if sch.tp_ is not None:
+                params = sch.tp_.device_params(fw)
+            else:
+                params = {i: {n: a.devmem
+                              for n, a in u.param_arrays().items()}
+                          for i, u in enumerate(fw)}
+            return per_chip_bytes({"params": params,
+                                   "pools": sch.cache_.pools}), \
+                fw, sch
+        except BaseException:
+            sch.close()
+            raise
+
+    widths = ([32, 64, 96, 128] if cpu
+              else [1024, 2048, 4096, 8192])
+    costs = {}
+    for d in widths:
+        c1, _, s1 = chip_cost(d, 0)
+        s1.close()
+        c2, fw2, s2 = chip_cost(d, 2)
+        costs[d] = (c1, c2)
+        if d == widths[-1]:
+            # prove the widest tp=2 config actually serves
+            toks = s2.submit([1, 2, 3], 4, seed=0).result(600)
+            assert len(toks) == 7
+        s2.close()
+    # the budget: tight enough that the widest width overflows ONE
+    # chip but fits two — the midpoint of its two footprints
+    budget = (costs[widths[-1]][0] + costs[widths[-1]][1]) // 2
+    max1 = max([d for d in widths if costs[d][0] <= budget],
+               default=0)
+    max2 = max([d for d in widths if costs[d][1] <= budget],
+               default=0)
+    out["tp_max_dmodel_per_chip_hbm"] = {
+        "budget_bytes": int(budget), "tp1": max1, "tp2": max2,
+        "per_chip_bytes": {str(d): [int(a), int(b)]
+                           for d, (a, b) in costs.items()}}
+
+    # -- aggregate decode tok/s vs mesh shape ----------------------------
+    d_model = 64 if cpu else 1024
+    fw = _serving_chain(dev, d_model, layers, 4, vocab, window,
+                        "tp-tps")
+    steps, slots = (24, 4) if cpu else (128, 8)
+
+    def decode_tps(tp):
+        sch = InferenceScheduler(
+            fw, max_slots=slots, window=window, kv="paged",
+            block_size=block, prefill_chunk=0, spec=False,
+            prefix_cache=False, warm_buckets=False, tp=tp).start()
+        assert sch.tp == tp
+        try:
+            best = 0.0
+            for _ in range(2):   # round 1 eats the bucket compiles
+                t0 = time.perf_counter()
+                futs = [sch.submit([1 + i, 2, 3, 4], steps, seed=i)
+                        for i in range(slots)]
+                toks = sum(len(f.result(600)) - 4 for f in futs)
+                best = max(best,
+                           toks / (time.perf_counter() - t0))
+            return round(best, 1)
+        finally:
+            sch.close()
+
+    out["tp_aggregate_tokens_per_sec"] = {
+        "mesh1": decode_tps(0), "mesh_tp2": decode_tps(2)}
+
+    # -- disaggregation: short-request TTFT under long-prompt load -------
+    long_p = list(range(1, vocab))[:24] * 2       # chunked prefill
+    short_p = [3, 1, 4, 1]
+    chunk = 8
+    n_long, n_short = (3, 8) if cpu else (8, 32)
+
+    def p95(vals):
+        vals = sorted(vals)
+        return round(vals[max(0, int(numpy.ceil(0.95 * len(vals)))
+                              - 1)] * 1e3, 3)
+
+    def ttft_colocated():
+        sch = InferenceScheduler(
+            fw, max_slots=4, window=window, kv="paged",
+            block_size=block, prefill_chunk=chunk, spec=False,
+            prefix_cache=False, warm_buckets=False).start()
+        try:
+            sch.submit(short_p, 4, seed=0).result(600)   # warm
+            lat = []
+            longs = [sch.submit(long_p, 8, seed=i)
+                     for i in range(n_long)]
+            for i in range(n_short):
+                t0 = time.perf_counter()
+                ts = sch.submit(short_p, 8, seed=i, stream=True)
+                next(iter(ts))
+                lat.append(time.perf_counter() - t0)
+                ts.cancel()
+            for f in longs:
+                f.result(600)
+            return p95(lat)
+        finally:
+            sch.close()
+
+    def ttft_disagg():
+        kw = dict(max_slots=4, window=window, kv="paged",
+                  block_size=block, prefill_chunk=chunk, spec=False,
+                  prefix_cache=False, warm_buckets=False)
+        pre = InferenceScheduler(fw, role="prefill", **kw).start()
+        dcd = InferenceScheduler(fw, role="decode", **kw).start()
+        pool = cf.ThreadPoolExecutor(2)
+
+        def handoff(prompt, steps, seed, stream=False):
+            h = pre.submit_prefill(prompt).result(600)
+            rec = pre.kv_export(h["handle"])
+            return dcd.submit_imported(rec, steps, seed=seed,
+                                       stream=stream)
+        try:
+            handoff(short_p, 4, 0).result(600)           # warm
+            lat = []
+            longs = [pool.submit(
+                lambda i=i: handoff(long_p, 8, i).result(600))
+                for i in range(n_long)]
+            for i in range(n_short):
+                t0 = time.perf_counter()
+                ts = handoff(short_p, 8, i, stream=True)
+                next(iter(ts))
+                lat.append(time.perf_counter() - t0)
+                ts.cancel()
+            for f in longs:
+                f.result(600)
+            return p95(lat)
+        finally:
+            pool.shutdown(wait=False)
+            pre.close()
+            dcd.close()
+
+    out["disagg_ttft_p95_ms"] = {"colocated": ttft_colocated(),
+                                 "disaggregated": ttft_disagg()}
+    out["tp_bench_config"] = {
+        "d_model": d_model, "layers": layers, "vocab": vocab,
+        "window": window, "block_size": block,
+        "kv_blocks": kv_blocks, "widths": widths,
+        "long_prompt": len(long_p), "short_prompt": len(short_p),
+        "prefill_chunk": chunk, "n_long": n_long,
+        "n_short": n_short,
+        "note": "CPU substrate: tp=2 tok/s measures collective "
+                "overhead on one core, not ICI scaling; the width "
+                "and TTFT metrics are substrate-honest (real array "
+                "bytes, real interleaving)"}
+    return out
+
+
 def bench_router(dev, replica_counts=(1, 2, 4),
                  requests_per_client=4):
     """Fleet scaling through the HTTP router (``serving/router.py``
@@ -1957,9 +2155,36 @@ def main_kv_quant():
         "entries carried")
 
 
+def main_tp():
+    """``python bench.py tp`` — the tensor-parallel +
+    disaggregation bench alone.  On the CPU substrate the tp mesh
+    needs VIRTUAL devices, sized before jax's first import (the
+    tests get this from conftest; the standalone bench sets it up
+    itself) — harmless on accelerator runs, where the host platform
+    is not the serving substrate."""
+    import os
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
+    else:
+        import jax
+        try:
+            jax.config.update("jax_num_cpu_devices", 2)
+        except (RuntimeError, AttributeError):
+            pass   # backends up / old jax: the assert below catches
+    return _main_standalone(
+        bench_tp, "tp_bench_source",
+        "PR13 standalone tensor-parallel/disaggregation bench run; "
+        "other entries carried")
+
+
 if __name__ == "__main__":
     sys.exit(main_router() if "router" in sys.argv[1:]
              else main_spec() if "spec" in sys.argv[1:]
              else main_streaming() if "streaming" in sys.argv[1:]
              else main_kv_quant() if "kv_quant" in sys.argv[1:]
+             else main_tp() if "tp" in sys.argv[1:]
              else main())

@@ -16,6 +16,8 @@ would vanish into the orphaned capture tempfiles).
 import os
 import sys
 
+import pytest
+
 # hard-set, not setdefault: the ambient environment may select a TPU
 # platform (e.g. JAX_PLATFORMS=axon) and tests must stay on virtual CPUs
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -29,6 +31,40 @@ def _needs_reexec():
     if os.environ.get("VELES_TPU_TEST_REEXEC") == "1":
         return False
     return bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+
+
+@pytest.fixture(scope="session")
+def spec_trained_chain():
+    """ONE briefly-trained tiny LM chain for the WHOLE session
+    (bench._spec_trained_chain at the test_kv_quant sizes: d=16,
+    2 layers, 2 heads, vocab 12, window 64, trained 12 steps to
+    continue a cyclic pattern) — shared by test_spec, test_kv_quant
+    and test_tp so tier-1 trains it once instead of per test.
+    Yields ``(forwards, pattern)``; the weights are frozen after
+    training (schedulers only read them), so any number of tests may
+    build schedulers over the same chain, and identical param shapes
+    mean they all share the compiled step executables too.  Trains
+    under f32 so the downstream parity/quality assertions see the
+    same weights the pre-fixture tests trained."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import _spec_trained_chain
+    from veles_tpu.backends import Device
+    from veles_tpu.config import root
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    try:
+        pattern = [3, 1, 4, 1, 5, 9, 2, 6]
+        fw = _spec_trained_chain(
+            Device(backend="numpy"), 16, 2, 2, 12, 64, 8,
+            [p % 12 for p in pattern], 12, "session-trained")
+    finally:
+        # restore BEFORE yielding — a session fixture's teardown
+        # runs at session END, and holding f32 for the rest of the
+        # run would contaminate every bf16-default test after the
+        # first user; consumers pin their own f32 fixture per test
+        root.common.precision.compute_dtype = saved
+    yield fw, pattern
 
 
 def pytest_runtest_protocol(item, nextitem):

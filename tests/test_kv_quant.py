@@ -465,24 +465,16 @@ def test_fused_verify_scheduler_stream_parity(f32, fused_verify):
 
 # -- quality gate --------------------------------------------------------------
 
-def test_kv_quant_ce_bound_on_trained_chain(f32):
+def test_kv_quant_ce_bound_on_trained_chain(f32, spec_trained_chain):
     """The declared int8-KV quality bound HOLDS, measured (not
-    logged) on a briefly-trained tiny chain through the real verify
-    path: CE delta within KV_QUANT_CE_TOLERANCE and near-total
-    greedy top-1 agreement.  quality.py records the same numbers at
-    bench scale."""
-    import os
-    import sys
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    from bench import _spec_trained_chain
+    logged) on a briefly-trained tiny chain (the session-scoped
+    conftest fixture — trained ONCE for test_spec/test_kv_quant/
+    test_tp) through the real verify path: CE delta within
+    KV_QUANT_CE_TOLERANCE and near-total greedy top-1 agreement.
+    quality.py records the same numbers at bench scale."""
     from veles_tpu.serving.kv_quality import (
         KV_QUANT_CE_TOLERANCE, kv_quant_quality)
-    dev = Device(backend="numpy")
-    pattern = [3, 1, 4, 1, 5, 9, 2, 6]
-    fw = _spec_trained_chain(dev, 16, 2, 2, 12, 64, 8,
-                             [p % 12 for p in pattern], 12,
-                             "kvq-trained")
+    fw, pattern = spec_trained_chain
     rng = numpy.random.default_rng(8)
     seqs = [([p % 12 for p in pattern] * 8)[:48],
             rng.integers(0, 12, (48,)).tolist()]

@@ -134,12 +134,16 @@ def test_spec_token_parity(f32):
     assert dense == base
 
 
-def test_spec_accept_rate_on_repetitive_prompts(f32):
+def test_spec_accept_rate_on_repetitive_prompts(f32,
+                                                spec_trained_chain):
     """Repetitive prompts must actually accept drafts (the whole
     point), the emitted streams still match spec-off, and rollback
-    accounting balances drafted = accepted + rolled back."""
-    fw = _tiny_fw("spec-accept")
-    prompts = [[4, 5, 6] * 6, [2, 9] * 9, [3] * 12]
+    accounting balances drafted = accepted + rolled back.  Runs on
+    the session-scoped TRAINED chain (conftest) — a model that has
+    learned its text is the regime the proposer exists for, and
+    sharing the fixture keeps tier-1 from training per test."""
+    fw, pattern = spec_trained_chain
+    prompts = [(pattern * 3)[:18], [2, 9] * 9, [3] * 12]
     submits = [(p, 16, dict(seed=0)) for p in prompts]
     base, _ = _run_sched(fw, submits, kv="paged", block_size=4,
                          prefill_chunk=0, spec=False)

@@ -42,6 +42,8 @@ REQUIRED_REGISTRATIONS = (
     ("serving/kv_slots.py", "serving.kv_gather_blocks"),
     ("serving/kv_slots.py", "serving.kv_quant_insert_blocks"),
     ("serving/kv_slots.py", "serving.kv_quant_gather_blocks"),
+    ("serving/kv_slots.py", "serving.kv_export_blocks"),
+    ("serving/kv_slots.py", "serving.kv_import_blocks"),
 )
 
 def _is_trackjit_name(name):

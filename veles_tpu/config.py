@@ -285,7 +285,17 @@ root.common.update({
     # trip); it is allclose rather than bit-identical to the
     # two-pass verify, so the fp32 parity baseline keeps it OFF
     # (int8 pools always verify fused)
+    # tp shards the jitted serving steps over a {"tp": N} mesh
+    # (Megatron column/row weight splits, head-wise paged K/V pools
+    # — per-chip kv_blocks HBM drops by the factor; serving/tp.py);
+    # 0 disables.  role disaggregates prefill from decode across a
+    # fleet: "prefill" replicas chunk-prefill and export finished KV
+    # blocks (GET /serving/kv_export/<handle>), "decode" replicas
+    # import them (POST /serving/kv_import) and run the decode loop;
+    # "both" (default) keeps the colocated single-replica shape.
     "serving": {
+        "tp": 0,
+        "role": "both",
         "kv": "paged",
         "block_size": 16,
         "kv_blocks": None,

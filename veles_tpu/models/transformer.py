@@ -122,6 +122,46 @@ class TransformerBlock(ForwardBase):
                        self.weights_stddev, h, d)
             self.ffn_b2.reset(numpy.zeros((d,), numpy.float32))
 
+    # -- tensor-parallel serving layout (serving/tp.py) -----------------
+
+    def tp_shardable(self, tp):
+        """True when this block's Megatron layout divides over ``tp``
+        shards: heads, model dim and FFN hidden all divisible (the
+        head-wise K/V pool split and the column/row weight splits
+        must land on whole heads / whole columns).  MoE FFNs shard
+        over ``ep``, not ``tp`` (they opt out here), and the int8
+        weight-only decode path quantizes per column INSIDE the trace
+        — its dequant epilogue does not commute with the row-parallel
+        partial sums, so it stays single-chip."""
+        tp = int(tp)
+        if tp < 2:
+            return False
+        if self.n_experts or self.int8_decode:
+            return False
+        d = self.wq.mem.shape[0]
+        return self.heads % tp == 0 and d % tp == 0 \
+            and int(self.hidden or 4 * d) % tp == 0
+
+    def tp_param_spec(self, name, tp):
+        """Megatron-style spec for one parameter under a ``tp`` mesh
+        axis, or None (replicate): wq/wk/wv and the FFN up-projection
+        are COLUMN-parallel (each shard owns whole heads / hidden
+        columns, so attention and the activation stay chip-local),
+        wo and the FFN down-projection ROW-parallel (their outputs
+        are the per-layer cross-chip reductions XLA inserts).  LN
+        scales and the output-side biases replicate — they apply
+        after the reduction."""
+        from jax.sharding import PartitionSpec as P
+        if not self.tp_shardable(tp):
+            return None
+        if name in ("wq", "wk", "wv", "ffn_w1"):
+            return P(None, "tp")
+        if name in ("wo", "ffn_w2"):
+            return P("tp", None)
+        if name == "ffn_b1":
+            return P("tp")
+        return None
+
     def _mha(self, params, x):
         from veles_tpu.models.attention import mha_apply
         dev = getattr(self, "device", None)

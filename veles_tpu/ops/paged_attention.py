@@ -38,6 +38,17 @@ attention.  The ``*_q8`` variants below serve INT8 pools (per-row
 scales beside the blocks; see serving/kv_slots.PagedKVCache), and
 ``paged_verify_attention_fused`` is the single-pass verify that
 keeps the run's K/V out of the pool round-trip.
+
+Tensor-parallel serving (serving/tp.py) runs these same functions
+SPMD with the pools sharded HEAD-WISE over the ``tp`` mesh axis
+(``[num_blocks, block_size, d/tp]`` per chip): the scatter, block
+gather, per-head attention and the int8 per-row amax all partition
+over the feature axis without code changes here — GSPMD keeps each
+head's Q·K/probs·V chip-local (tp divides heads, so the
+``[..., h, hd]`` reshape lands on whole heads), and only the output
+projection downstream reduces across chips.  The int8 scales stay
+replicated: their amax over the sharded axis reduces exactly, so
+the quantized pool bytes are bit-identical to an unsharded pool's.
 """
 
 import jax

@@ -93,6 +93,7 @@ class RESTfulAPI(Unit):
                  serving_kv_dtype=None, serving_prefill_chunk=None,
                  serving_spec=None, serving_spec_k=None,
                  serving_prefix_cache=None, serving_warm_buckets=None,
+                 serving_tp=None, serving_role=None,
                  replica_id=None, **kwargs):
         super(RESTfulAPI, self).__init__(workflow, **kwargs)
         self.loader = loader
@@ -135,6 +136,17 @@ class RESTfulAPI(Unit):
         #: None defers to root.common.serving.warm_buckets; tests pin
         #: False (the bucket-ladder warmup is the compile hog)
         self.serving_warm_buckets = serving_warm_buckets
+        #: tensor-parallel mesh size (None defers to
+        #: ``root.common.serving.tp``; 0 = unsharded) — shards the
+        #: jitted serving steps so weights + paged pools split over
+        #: N chips (serving/tp.py)
+        self.serving_tp = serving_tp
+        #: disaggregation role (None defers to
+        #: ``root.common.serving.role``): "prefill" replicas serve
+        #: POST /serving/prefill + GET /serving/kv_export/<handle>
+        #: only; "decode" replicas adopt exports via POST
+        #: /serving/kv_import; "both" (default) is colocated
+        self.serving_role = serving_role
         #: /generate resource caps — an unbounded request would pay a
         #: giant alloc + a multi-second compile before failing; None
         #: defers to root.common.api.{max_steps,max_batch}
@@ -281,14 +293,19 @@ class RESTfulAPI(Unit):
                     spec=self.serving_spec,
                     spec_k=self.serving_spec_k,
                     prefix_cache=self.serving_prefix_cache,
-                    warm_buckets=self.serving_warm_buckets).start()
+                    warm_buckets=self.serving_warm_buckets,
+                    tp=self.serving_tp,
+                    role=self.serving_role,
+                    replica_id=self.replica_id).start()
                 self.info(
                     "serving scheduler: %d slots, window %d, "
                     "queue cap %d, kv=%s (block %d), prefill "
-                    "chunk %d", self.scheduler_.max_slots,
+                    "chunk %d, tp=%d, role=%s",
+                    self.scheduler_.max_slots,
                     self.scheduler_.window, self.max_queue,
                     self.scheduler_.kv, self.scheduler_.block_size,
-                    self.scheduler_.prefill_chunk)
+                    self.scheduler_.prefill_chunk,
+                    self.scheduler_.tp, self.scheduler_.role)
             else:
                 self.info("chain not slot-servable; /generate stays "
                           "on the serialized decode path")
@@ -352,6 +369,23 @@ class RESTfulAPI(Unit):
                         return
                     self._reply_json(api.scheduler_.metrics())
                     return
+                if route.startswith("/serving/kv_export/"):
+                    # disaggregated handoff, the wire half: serve one
+                    # parked prefill export (one-shot — the fetch
+                    # consumes it; the handle is the capability)
+                    if api.scheduler_ is None:
+                        self.send_error(404, "no serving scheduler")
+                        return
+                    from veles_tpu.serving.disagg import encode_export
+                    rec = api.scheduler_.kv_export(
+                        route.rsplit("/", 1)[1])
+                    if rec is None:
+                        self.send_error(
+                            404, "unknown or expired kv export "
+                            "handle")
+                        return
+                    self._reply_json(encode_export(rec))
+                    return
                 if route == "/healthz":
                     # liveness + health-policy state: 200 while the
                     # model is trainable/servable, 503 once the halt
@@ -368,9 +402,17 @@ class RESTfulAPI(Unit):
                     # (plus the boolean): a router parses it to route
                     # the replica as draining, which is NOT a health
                     # failure and must not trip its circuit breaker
+                    sch = api.scheduler_
                     reply = {"status": status, "pid": os.getpid(),
                              "replica": api.replica_id,
                              "draining": bool(api._draining_),
+                             # role-aware routing reads this: the
+                             # router sends prefill traffic only to
+                             # prefill/both replicas and client
+                             # decode only to decode/both
+                             "role": sch.role if sch is not None
+                             else "both",
+                             "tp": sch.tp if sch is not None else 0,
                              "health": state}
                     if api._draining_:
                         status = reply["status"] = "draining"
@@ -762,9 +804,119 @@ class RESTfulAPI(Unit):
                     self._reply_json(openai_api.classify_reply(
                         model, out, rows, top))
 
+            def _serving_prefill(self):
+                """POST /serving/prefill — the disaggregated fleet's
+                prefill half (roles "prefill"/"both"): chunk-prefill
+                one prompt row, park its raw KV blocks + first-token
+                logits under a handle, reply the handle.  The decode
+                half fetches the export and POSTs it to
+                /serving/kv_import on a decode replica."""
+                from veles_tpu.serving.scheduler import SchedulerError
+                if api.forwards is None or api.scheduler_ is None:
+                    self.send_error(404, "no servable model chain")
+                    return
+                try:
+                    body = self._read_body()
+                    prompt = body.get("prompt")
+                    if not isinstance(prompt, list) or not prompt \
+                            or isinstance(prompt[0], list):
+                        self.send_error(
+                            400, "prompt must be ONE flat token "
+                            "list (prefill export is per-request)")
+                        return
+                    rows = [[int(t) for t in prompt]]
+                except (TypeError, ValueError):
+                    self.send_error(400, "prompt must be a flat "
+                                    "list of token ids")
+                    return
+                err = api._validate_rows(rows)
+                if err:
+                    self.send_error(400, err)
+                    return
+                try:
+                    fut = api.scheduler_.submit_prefill(
+                        rows[0], seed=body.get("seed"),
+                        timeout=api.request_timeout,
+                        priority=body.get("priority"),
+                        trace=self._trace())
+                    out = fut.result(api.request_timeout + 30.0)
+                except ValueError as e:
+                    self.send_error(400, _status_text(e))
+                    return
+                except SchedulerError as e:
+                    self._reply_scheduler_error(e)
+                    return
+                except concurrent.futures.TimeoutError:
+                    self._reply_error(408, "prefill timed out")
+                    return
+                out["trace_id"] = self._trace()
+                self._reply_json(out)
+
+            def _serving_kv_import(self):
+                """POST /serving/kv_import — the decode half (roles
+                "decode"/"both"): adopt an exported prefill record
+                and decode; replies like a single-row /generate."""
+                from veles_tpu.serving.disagg import decode_export
+                from veles_tpu.serving.scheduler import SchedulerError
+                if api.forwards is None or api.scheduler_ is None:
+                    self.send_error(404, "no servable model chain")
+                    return
+                try:
+                    body = self._read_body()
+                    export = decode_export(body.get("export") or {})
+                    steps = int(body.get("steps", 0))
+                    temperature = float(body.get("temperature")
+                                        or 0.0)
+                    top_k = int(body.get("top_k") or 0)
+                    stop = body.get("stop")
+                    stop = int(stop) if stop is not None else None
+                except (TypeError, ValueError) as e:
+                    self.send_error(400, _status_text(e))
+                    return
+                if steps > api._cap("max_steps", 2048):
+                    self.send_error(400, "steps %d exceeds "
+                                    "max_steps" % steps)
+                    return
+                try:
+                    fut = api.scheduler_.submit_imported(
+                        export, steps, temperature=temperature,
+                        top_k=top_k, seed=body.get("seed"),
+                        stop_token=stop,
+                        timeout=api.request_timeout,
+                        priority=body.get("priority"),
+                        trace=self._trace())
+                    toks = fut.result(api.request_timeout + 30.0)
+                except ValueError as e:
+                    self.send_error(400, _status_text(e))
+                    return
+                except SchedulerError as e:
+                    self._reply_scheduler_error(e)
+                    return
+                except concurrent.futures.TimeoutError:
+                    self._reply_error(408, "decode timed out",
+                                      tokens_generated=0)
+                    return
+                self._reply_json({"tokens": toks})
+
             def do_POST(self):
                 self._trace_ = None  # fresh id per request
                 route = self.path.split("?")[0].rstrip("/")
+                if route in ("/serving/prefill",
+                             "/serving/kv_import"):
+                    try:
+                        faults.fire("restful.generate")
+                        if route == "/serving/prefill":
+                            self._serving_prefill()
+                        else:
+                            self._serving_kv_import()
+                    except faults.InjectedHTTPError as e:
+                        self._reply_error(
+                            e.status, _status_text(e),
+                            retry_after=1 if e.status == 503
+                            else None)
+                    except Exception as e:
+                        self.send_error(500, _status_text(e))
+                    return
                 if route == "/v1/completions":
                     try:
                         faults.fire("restful.generate")

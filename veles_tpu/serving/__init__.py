@@ -59,7 +59,18 @@ per-token scan.  Here:
   (``/v1/completions`` with streaming + usage, ``/v1/models``) and
   the servable non-LM endpoints (batched ``/v1/embeddings`` pooled
   hidden states, ``/v1/classify`` last-position class scores), both
-  executed on the decode loop's aux lane.
+  executed on the decode loop's aux lane;
+- :mod:`veles_tpu.serving.tp` — tensor-parallel serving: the jitted
+  steps shard over a ``{"tp": N}`` mesh (Megatron column/row weight
+  splits, HEAD-WISE paged pools — per-chip ``kv_blocks`` HBM drops
+  by the mesh factor) while every host-side structure stays
+  replicated, so a model too wide for one chip still serves with
+  tp=1-bit-identical greedy streams;
+- :mod:`veles_tpu.serving.disagg` — disaggregated prefill/decode
+  (DistServe lineage): prefill-role replicas export finished KV
+  blocks raw (scales riding along) under a handle, decode-role
+  replicas import them and run only the token loop, and the router
+  dispatches by role — handoff streams identical to colocated.
 """
 
 from veles_tpu.serving.engine import (  # noqa: F401
@@ -81,7 +92,12 @@ from veles_tpu.serving.router import Router  # noqa: F401
 from veles_tpu.serving.scheduler import (  # noqa: F401
     CLASS_NAMES, DeadlineExceededError, DrainingError,
     InferenceScheduler, PRIORITIES, QueueFullError,
-    RequestCancelledError, SchedulerError, resolve_priority)
+    RequestCancelledError, RoleMismatchError, SchedulerError,
+    resolve_priority)
+from veles_tpu.serving.tp import (  # noqa: F401
+    ServingTP, per_chip_bytes, tp_supported)
+from veles_tpu.serving.disagg import (  # noqa: F401
+    decode_export, encode_export)
 from veles_tpu.serving.streams import (  # noqa: F401
     SSE_DONE, StreamTimeoutError, TokenStream, sse_event)
 from veles_tpu.serving import openai_api  # noqa: F401

@@ -1,0 +1,93 @@
+"""Disaggregated prefill/decode — the KV handoff wire format.
+
+DistServe-style disaggregation (Zhong et al., 2024) splits a serving
+fleet into PREFILL specialists (chunked prefill, no decode loop
+tenancy) and DECODE specialists (token loop only), removing the
+prefill/decode interference chunked prefill merely bounds.  The
+handoff is the paged cache's own block transport: a prefill replica
+finishes a prompt, gathers the slot's blocks RAW
+(``PagedKVCache.export_blocks`` — int8 stays int8, scales ride
+along), and parks the record under a handle; ``GET
+/serving/kv_export/<handle>`` serves it in the JSON envelope below;
+the decode replica scatters the blocks into its own table
+(``import_blocks``) and samples the first token from the exported
+last-position logits — the stream is then identical to the colocated
+path (fp32 bit-exact; int8 blocks import unrequantized, so the
+resident bytes match the exporter's exactly).
+
+Wire format (JSON; arrays as base64 of C-order bytes)::
+
+    {"handle": "...", "prompt": [ids...], "length": P,
+     "kv_dtype": "fp32"|"int8", "block_size": 16,
+     "logits": {"b64": ..., "dtype": "float32", "shape": [vocab]},
+     "layers": {"<chain idx>": {"k": <arr>, "v": <arr>
+                                [, "k_scale": <arr>, "v_scale": <arr>]}}}
+
+K/V arrays are ``[ceil(P / block_size), block_size, d]`` in the
+exporting pool's storage dtype; scale arrays are
+``[blocks, block_size]`` f32.  Positions ≥ P in the last block hold
+the staging zeros the colocated insert would have written — the
+causal mask never reads them, and carrying them keeps the import a
+plain block scatter.  Importer validation (dtype/block-size/shape
+mismatches are client errors) lives in
+``InferenceScheduler.submit_imported``.
+"""
+
+import base64
+import uuid
+
+import numpy
+
+
+def mint_handle():
+    """An unguessable export handle (the record may hold model
+    activations — the handle is the only capability to fetch it)."""
+    return uuid.uuid4().hex
+
+
+def _encode_array(a):
+    a = numpy.ascontiguousarray(a)
+    return {"b64": base64.b64encode(a.tobytes()).decode("ascii"),
+            "dtype": str(a.dtype), "shape": list(a.shape)}
+
+
+def _decode_array(obj):
+    raw = base64.b64decode(obj["b64"])
+    return numpy.frombuffer(raw, dtype=numpy.dtype(obj["dtype"])) \
+        .reshape([int(s) for s in obj["shape"]]).copy()
+
+
+def encode_export(record):
+    """Serialize a scheduler export record (numpy arrays) into the
+    JSON-safe envelope above."""
+    return {
+        "handle": record["handle"],
+        "prompt": [int(t) for t in record["prompt"]],
+        "length": int(record["length"]),
+        "kv_dtype": record["kv_dtype"],
+        "block_size": int(record["block_size"]),
+        "logits": _encode_array(record["logits"]),
+        "layers": {str(i): {n: _encode_array(a)
+                            for n, a in layer.items()}
+                   for i, layer in record["layers"].items()},
+    }
+
+
+def decode_export(obj):
+    """Parse the JSON envelope back into the numpy record
+    ``submit_imported`` consumes.  Raises ``ValueError`` on a
+    malformed payload (client error, not a replica fault)."""
+    try:
+        return {
+            "handle": str(obj["handle"]),
+            "prompt": [int(t) for t in obj["prompt"]],
+            "length": int(obj["length"]),
+            "kv_dtype": str(obj["kv_dtype"]),
+            "block_size": int(obj["block_size"]),
+            "logits": _decode_array(obj["logits"]),
+            "layers": {int(i): {n: _decode_array(a)
+                                for n, a in layer.items()}
+                       for i, layer in obj["layers"].items()},
+        }
+    except (KeyError, TypeError, AttributeError) as e:
+        raise ValueError("malformed kv export payload: %r" % (e,))

@@ -174,14 +174,23 @@ def paged_decode_step(forwards, cache, toks, pos, tables, temps,
     [B, 1], ``pos``/``temps``/``topks``/``seeds``/``counts`` [B],
     ``tables`` [B, T] physical block ids (T·block_size must cover
     ``max(pos) + 1``).  Returns the [B] next tokens; the caller maps
-    packed rows back to its slots."""
+    packed rows back to its slots.
+
+    A cache built with a tensor-parallel context (``cache.tp_`` —
+    serving/tp.py) runs the step SPMD over the tp mesh: params ride
+    pre-sharded Megatron-style, the pools head-wise, and the
+    executable cache keys on the mesh size so tp on/off never share
+    a trace."""
     from veles_tpu import dtypes
-    params = _device_params(forwards)
+    ctx = getattr(cache, "tp_", None)
+    params = ctx.device_params(forwards) if ctx is not None \
+        else _device_params(forwards)
     tables = jnp.asarray(tables, jnp.int32)
     b, t = tables.shape
     cache_key = (_arch_sig(forwards), b, t, cache.block_size,
                  cache.capacity_blocks,
                  getattr(cache, "kv_dtype", "fp32"),
+                 ctx.size if ctx is not None else 1,
                  str(dtypes.compute_dtype()),
                  str(dtypes.matmul_precision()))
     fn = _paged_step_cached(cache_key,
@@ -265,7 +274,9 @@ def verify_step_paged(forwards, cache, toks, pos, lens, tables,
     spec-off stream bit-for-bit for greedy AND per-seed sampling."""
     from veles_tpu import dtypes
     from veles_tpu.config import root
-    params = _device_params(forwards)
+    ctx = getattr(cache, "tp_", None)
+    params = ctx.device_params(forwards) if ctx is not None \
+        else _device_params(forwards)
     tables = jnp.asarray(tables, jnp.int32)
     toks = jnp.asarray(toks, jnp.int32)
     b, t = tables.shape
@@ -273,11 +284,13 @@ def verify_step_paged(forwards, cache, toks, pos, lens, tables,
     # kv_dtype and the fused-verify knob both change the traced
     # verify body (TransformerBlock.apply_verify_paged reads them at
     # trace time) — they must key the executable or a toggle would
-    # silently reuse the stale trace
+    # silently reuse the stale trace; the tp mesh size keys it too
+    # (sharded params/pools compile a different SPMD program)
     kv_dtype = getattr(cache, "kv_dtype", "fp32")
     fused = bool(root.common.serving.get("fused_verify", False))
     cache_key = (_arch_sig(forwards), b, k1, t, cache.block_size,
                  cache.capacity_blocks, kv_dtype, fused,
+                 ctx.size if ctx is not None else 1,
                  str(dtypes.compute_dtype()),
                  str(dtypes.matmul_precision()))
     fn = _verify_step_cached(cache_key,

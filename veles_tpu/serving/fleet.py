@@ -125,10 +125,25 @@ class Fleet(Logger):
     point)."""
 
     def __init__(self, spawn, n, router=None, monitor_interval=0.25,
-                 spawn_retries=5, spawn_delay=0.2, spawn_cap=5.0):
+                 spawn_retries=5, spawn_delay=0.2, spawn_cap=5.0,
+                 roles=None):
         super(Fleet, self).__init__()
         self.spawn = spawn
         self.n = int(n)
+        #: disaggregated fleets: per-index serving role — ``roles``
+        #: is a sequence cycled over the replica indices (e.g.
+        #: ("prefill", "decode", "decode")); when set, ``spawn`` is
+        #: called as ``spawn(index, role)`` so a respawned replica
+        #: keeps its pool membership across generations.  None keeps
+        #: the legacy ``spawn(index)`` homogeneous-fleet contract.
+        self.roles = tuple(roles) if roles else None
+        if self.roles:
+            bad = [r for r in self.roles
+                   if r not in ("prefill", "decode", "both")]
+            if bad:
+                raise ValueError(
+                    "roles must be prefill/decode/both, got %s"
+                    % bad)
         self.router = router
         self.monitor_interval = float(monitor_interval)
         self.spawn_retries = int(spawn_retries)
@@ -195,7 +210,11 @@ class Fleet(Logger):
             try:
                 if faults.fire("fleet.replica.spawn", key=str(index)):
                     raise RuntimeError("injected spawn drop")
-                handle = self.spawn(index)
+                if self.roles:
+                    handle = self.spawn(
+                        index, self.roles[index % len(self.roles)])
+                else:
+                    handle = self.spawn(index)
                 break
             except Exception as e:
                 if attempt >= self.spawn_retries:

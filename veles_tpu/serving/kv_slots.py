@@ -145,6 +145,31 @@ def _gather_blocks_q8_jit():
     return track_jit("serving.kv_quant_gather_blocks", jax.jit(pair))
 
 
+@functools.lru_cache(maxsize=1)
+def _export_blocks_jit():
+    # disaggregated prefill→decode handoff, the OUT half: gather a
+    # slot's blocks RAW out of two same-indexed pool arrays (K/V
+    # pair, or the scale pair — the function is dtype/shape generic,
+    # so int8 pools and their f32 scales ride the same executable
+    # family and the exported bytes are exactly the resident bytes,
+    # no dequant round trip)
+    def pair(a, b, ids):
+        return a[ids], b[ids]
+    return track_jit("serving.kv_export_blocks", jax.jit(pair))
+
+
+@functools.lru_cache(maxsize=1)
+def _import_blocks_jit():
+    # the IN half: scatter previously exported raw blocks into a
+    # decode replica's own table blocks — same generic pairing, so
+    # int8 blocks land unrequantized (bit-identical to the exporting
+    # pool) and their scales follow through the same call
+    def pair(a, b, src_a, src_b, ids):
+        return (a.at[ids].set(src_a.astype(a.dtype)),
+                b.at[ids].set(src_b.astype(b.dtype)))
+    return track_jit("serving.kv_import_blocks", jax.jit(pair))
+
+
 def _insert_layer(layer, src, fn, *args):
     """Insert one layer's staging K/V via the paired jitted call,
     falling back per-name for exotic cache pytrees."""
@@ -254,7 +279,7 @@ class PagedKVCache:
     ``ops/paged_attention.py``."""
 
     def __init__(self, forwards, max_slots, window, block_size=16,
-                 kv_blocks=None, kv_dtype="fp32"):
+                 kv_blocks=None, kv_dtype="fp32", tp=None):
         from veles_tpu import dtypes
         self.max_slots = int(max_slots)
         self.window = int(window)
@@ -296,6 +321,14 @@ class PagedKVCache:
                 if hasattr(u, "init_cache")}
         if not self.pools:
             raise ValueError("chain has no cacheable blocks")
+        #: tensor-parallel serving context (serving/tp.py) — pools
+        #: shard HEAD-WISE over the mesh (each chip stores
+        #: [num_blocks, block_size, d/tp]; scales replicate), so the
+        #: per-chip HBM a kv_blocks budget costs drops by the mesh
+        #: factor; the compiled steps read the ctx off the cache
+        self.tp_ = tp
+        if tp is not None:
+            self.pools = tp.shard_pools(self.pools)
         self._free_slots = list(range(self.max_slots - 1, -1, -1))
         self._free_blocks = list(range(num - 1, 0, -1))
         #: host-side tables [max_slots, blocks_per_slot]; entries past
@@ -328,18 +361,23 @@ class PagedKVCache:
         return self.capacity_blocks - len(self._free_blocks)
 
     def bytes_per_token(self):
-        """HBM bytes ONE cached token costs across every layer's
-        pools — the denominator of "streams per HBM dollar" (int8
-        pays ``2·d + 8`` per layer where the compute dtype pays
+        """PER-CHIP HBM bytes ONE cached token costs across every
+        layer's pools — the denominator of "streams per HBM dollar"
+        (int8 pays ``2·d + 8`` per layer where the compute dtype pays
         ``2·d·itemsize``; reported in ``/serving/metrics`` and
-        Prometheus as ``kv_bytes_per_token``)."""
+        Prometheus as ``kv_bytes_per_token``).  Under tensor-parallel
+        serving the K/V contribution divides by the mesh factor —
+        each chip stores ``d/tp`` of every row — while the replicated
+        scales still cost every chip their full byte."""
+        shards = self.tp_.size if self.tp_ is not None else 1
         total = 0
         for layer in self.pools.values():
             for name, arr in layer.items():
                 if name.endswith("_scale"):   # one scale per row
                     total += arr.dtype.itemsize
                 else:
-                    total += arr.shape[-1] * arr.dtype.itemsize
+                    total += arr.shape[-1] * arr.dtype.itemsize \
+                        // shards
         return int(total)
 
     def blocks_needed(self, total_tokens):
@@ -502,6 +540,76 @@ class PagedKVCache:
                 self.pools[i] = _insert_layer(layer, src,
                                               _insert_blocks,
                                               ids, start)
+
+    def export_blocks(self, ids):
+        """Gather blocks ``ids`` RAW out of every layer's pools for a
+        disaggregated prefill→decode handoff: returns
+        ``{layer: {"k", "v"[, "k_scale", "v_scale"]}}`` host numpy
+        arrays, K/V shaped ``[len(ids), block_size, d]`` in the
+        pool's storage dtype (int8 stays int8 — its scales travel in
+        the same record, so the importing replica reproduces the
+        resident bytes exactly, no dequant→requant noise)."""
+        ids = jnp.asarray(numpy.asarray(ids, numpy.int32))
+        fn = _export_blocks_jit()
+        out = {}
+        for i, layer in self.pools.items():
+            if self.kv_dtype == "int8":
+                k, v = fn(layer["k"], layer["v"], ids)
+                sk, sv = fn(layer["k_scale"], layer["v_scale"], ids)
+                got = {"k": k, "v": v, "k_scale": sk, "v_scale": sv}
+            elif set(layer) == {"k", "v"}:
+                k, v = fn(layer["k"], layer["v"], ids)
+                got = {"k": k, "v": v}
+            else:  # exotic cache pytrees: per-name self-pairing
+                got = {}
+                for name in layer:
+                    got[name], _ = fn(layer[name], layer[name], ids)
+            out[i] = {n: numpy.asarray(a) for n, a in got.items()}
+        return out
+
+    def import_blocks(self, ids, layers):
+        """Scatter a :meth:`export_blocks` record into THIS cache's
+        blocks ``ids`` (a decode-specialist adopting a prefill
+        replica's finished KV): raw block contents land unconverted —
+        the importing table's blocks end up byte-identical to the
+        exporter's, scales included — so the decode loop attends over
+        exactly the K/V the colocated path would have."""
+        ids_j = jnp.asarray(numpy.asarray(ids, numpy.int32))
+        n = int(len(ids))
+        fn = _import_blocks_jit()
+        for i, layer in self.pools.items():
+            src = layers[i]
+            ref = src["k"] if "k" in src else next(iter(src.values()))
+            if ref.shape[0] != n or ref.shape[1] != self.block_size:
+                raise ValueError(
+                    "imported layer %s blocks %s do not fit %d x "
+                    "block_size %d" % (i, ref.shape[:2], n,
+                                       self.block_size))
+            if self.kv_dtype == "int8":
+                if "k_scale" not in src:
+                    raise ValueError(
+                        "int8 import needs k_scale/v_scale riding "
+                        "the exported blocks")
+                k, v = fn(layer["k"], layer["v"],
+                          jnp.asarray(src["k"]), jnp.asarray(src["v"]),
+                          ids_j)
+                sk, sv = fn(layer["k_scale"], layer["v_scale"],
+                            jnp.asarray(src["k_scale"]),
+                            jnp.asarray(src["v_scale"]), ids_j)
+                self.pools[i] = {"k": k, "v": v, "k_scale": sk,
+                                 "v_scale": sv}
+            elif set(layer) == {"k", "v"}:
+                k, v = fn(layer["k"], layer["v"],
+                          jnp.asarray(src["k"]), jnp.asarray(src["v"]),
+                          ids_j)
+                self.pools[i] = {"k": k, "v": v}
+            else:
+                got = {}
+                for name in layer:
+                    got[name], _ = fn(layer[name], layer[name],
+                                      jnp.asarray(src[name]),
+                                      jnp.asarray(src[name]), ids_j)
+                self.pools[i] = got
 
     def load_staging(self, row_caches, ids):
         """Copy resident blocks ``ids`` (a matched prompt prefix)

@@ -10,6 +10,7 @@ every observation is mirrored into the process-wide registry
 ``GET /metrics`` as the cumulative ``veles_serving_*`` series.
 """
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -227,12 +228,16 @@ def _registry_series():
             "veles_serving_kv_dtype",
             "KV pool storage dtype in use (1 on the active dtype's "
             "series — fp32 is the parity baseline, int8 the "
-            "quantized ~2x-streams layout)", labelnames=("dtype",)),
+            "quantized ~2x-streams layout); labeled per replica so "
+            "a mixed fleet's schedulers stop stomping one series",
+            labelnames=("dtype", "replica")),
         "kv_bytes_per_token": metrics.gauge(
             "veles_serving_kv_bytes_per_token",
-            "HBM bytes one cached token costs across all layers' "
-            "pools (scales included) — the streams-per-HBM-dollar "
-            "denominator"),
+            "per-chip HBM bytes one cached token costs across all "
+            "layers' pools (scales included; tensor-parallel pools "
+            "divide by the mesh factor) — the streams-per-HBM-"
+            "dollar denominator, labeled per replica",
+            labelnames=("replica",)),
         "prefill_chunks": metrics.counter(
             "veles_serving_prefill_chunk_total",
             "prompt chunks prefilled (chunked-prefill path)"),
@@ -357,6 +362,11 @@ def _router_series():
             "veles_router_shed_total",
             "requests shed at the router (503 + Retry-After: no "
             "eligible replica)"),
+        "disagg": metrics.counter(
+            "veles_router_disagg_handoffs_total",
+            "/generate requests served disaggregated: prefill on a "
+            "prefill-specialist, KV export handed to a decode "
+            "replica"),
         "breaker_state": metrics.gauge(
             "veles_router_breaker_state",
             "per-replica circuit breaker: 0 closed, 1 half-open, "
@@ -399,6 +409,7 @@ class RouterMetrics:
         self.hedges = 0
         self.hedge_wins = 0
         self.shed = 0
+        self.disagg_handoffs = 0
         self.restarts = 0
         self.drains = 0
         self.streams = 0
@@ -441,6 +452,11 @@ class RouterMetrics:
             self.shed += 1
         self._global["shed"].inc()
         events.record("router.shed", "single", cls="Router")
+
+    def record_disagg(self):
+        with self._lock:
+            self.disagg_handoffs += 1
+        self._global["disagg"].inc()
 
     def record_breaker(self, replica, state):
         self._global["breaker_state"].labels(
@@ -495,9 +511,19 @@ class RouterMetrics:
 
 
 class ServingMetrics:
-    """Thread-safe serving counters + recent-window latency stats."""
+    """Thread-safe serving counters + recent-window latency stats.
 
-    def __init__(self, recent=256):
+    ``replica`` names this instance's series on the per-replica
+    labeled gauges (``veles_serving_kv_dtype`` /
+    ``kv_bytes_per_token``) — the scheduler passes its fleet
+    identity; the default is a process-unique stand-in so even
+    anonymous schedulers never share a label."""
+
+    _seq = itertools.count(1)
+
+    def __init__(self, recent=256, replica=None):
+        self.replica = str(replica) if replica \
+            else "serving%d" % next(self._seq)
         self._lock = threading.Lock()
         self.submitted = 0
         self.completed = 0
@@ -682,11 +708,17 @@ class ServingMetrics:
         """Advertise the KV pool layout (once, at cache build): the
         active dtype's labeled series reads 1, the other 0 — a
         dashboard can tell at a glance which fleet replicas run
-        quantized pools and what a cached token costs them."""
+        quantized pools and what a cached token costs them.  Both
+        gauges carry this instance's ``replica`` label, so a
+        multi-replica fleet (or a test building several schedulers
+        in one process) no longer last-writer-wins one shared
+        series."""
         for d in ("fp32", "int8"):
-            self._global["kv_dtype"].labels(dtype=d).set(
+            self._global["kv_dtype"].labels(
+                dtype=d, replica=self.replica).set(
                 1 if d == kv_dtype else 0)
-        self._global["kv_bytes_per_token"].set(int(bytes_per_token))
+        self._global["kv_bytes_per_token"].labels(
+            replica=self.replica).set(int(bytes_per_token))
 
     def record_step(self, active, slots):
         with self._lock:

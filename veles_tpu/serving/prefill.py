@@ -116,7 +116,7 @@ def clear_chunk_cache():
 
 
 def prefill_chunk(forwards, chunk, offset, chunk_lens, caches,
-                  key_width=None):
+                  key_width=None, tp=None):
     """Prefill ONE chunk — ``chunk`` [batch, C] int32 tokens at
     sequence positions [offset, offset+C) — into existing staging
     ``caches`` (``{chain index: {"k", "v"} [batch, W, d]}``; W a
@@ -134,12 +134,19 @@ def prefill_chunk(forwards, chunk, offset, chunk_lens, caches,
     [batch, vocab] (f32) sit at each row's position
     ``offset + chunk_lens[n] - 1`` — the first-token logits once the
     final chunk lands.  Running the chunks in order reproduces the
-    one-shot :func:`prefill` cache rows and logits (tested)."""
+    one-shot :func:`prefill` cache rows and logits (tested).
+
+    ``tp`` (a :class:`serving.tp.ServingTP`, default None) runs the
+    chunk SPMD over the tensor-parallel mesh with Megatron-sharded
+    params — the staging caches ride uncommitted and land wherever
+    GSPMD places them; the later block insert re-places them against
+    the head-sharded pools."""
     from veles_tpu import dtypes
     if not chunked_supported(forwards):
         raise ValueError("chain cannot prefill in chunks (see "
                          "chunked_supported)")
-    params = _device_params(forwards)
+    params = tp.device_params(forwards) if tp is not None \
+        else _device_params(forwards)
     chunk = jnp.asarray(chunk, jnp.int32)
     b, c = chunk.shape
     widths = {tuple(a.shape[1] for a in layer.values())
@@ -161,6 +168,7 @@ def prefill_chunk(forwards, chunk, offset, chunk_lens, caches,
     if lens_np.min() < 1 or lens_np.max() > c:
         raise ValueError("chunk_lens must be in [1, %d]" % c)
     cache_key = (_arch_sig(forwards), b, c, w, kw,
+                 tp.size if tp is not None else 1,
                  str(dtypes.compute_dtype()),
                  str(dtypes.matmul_precision()))
     fn = _chunk_cached(cache_key,
@@ -205,7 +213,8 @@ def clear_prefill_cache():
     _prefill_cached.cache_clear()
 
 
-def prefill(forwards, prompt, prompt_lens=None, window=None):
+def prefill(forwards, prompt, prompt_lens=None, window=None,
+            tp=None):
     """Prefill ``prompt`` [batch, P] (int32, front-aligned rows) in
     ONE compiled pass.
 
@@ -219,7 +228,8 @@ def prefill(forwards, prompt, prompt_lens=None, window=None):
     array arbitrarily past each length); it rides the executable as a
     traced argument.  ``window`` (default P) sizes the returned cache
     buffers — a request decoding into a slot cache prefills straight
-    at the slot width."""
+    at the slot width.  ``tp`` (serving/tp.py context) runs the pass
+    SPMD over the tensor-parallel mesh."""
     from veles_tpu import dtypes
     for u in forwards:
         if hasattr(u, "init_cache") \
@@ -227,7 +237,8 @@ def prefill(forwards, prompt, prompt_lens=None, window=None):
             raise ValueError(
                 "batched prefill: %s has no apply_prefill"
                 % type(u).__name__)
-    params = _device_params(forwards)
+    params = tp.device_params(forwards) if tp is not None \
+        else _device_params(forwards)
     prompt = jnp.asarray(prompt, jnp.int32)
     b, p = prompt.shape
     window = int(window or p)
@@ -246,6 +257,7 @@ def prefill(forwards, prompt, prompt_lens=None, window=None):
                 % p)
         lens = jnp.asarray(lens_np)
     cache_key = (_arch_sig(forwards), b, p, window,
+                 tp.size if tp is not None else 1,
                  str(dtypes.compute_dtype()),
                  str(dtypes.matmul_precision()))
     fn = _prefill_cached(cache_key,

@@ -115,12 +115,13 @@ class _Replica(object):
                  "status", "draining", "marked_draining",
                  "health_failures", "breaker", "failures",
                  "opened_at", "probing", "saturated_until",
-                 "last_health", "last_metrics", "requests")
+                 "last_health", "last_metrics", "requests", "role")
 
     def __init__(self, replica_id, host, port):
         self.id = str(replica_id)
         self.host = host
         self.port = int(port)
+        self.role = "both"        # /healthz advertises the real one
         self.outstanding = 0      # in-flight forwards (routing load)
         self.healthy = False      # until the first probe passes
         self.status = "unknown"
@@ -140,6 +141,7 @@ class _Replica(object):
         return {
             "id": self.id, "host": self.host, "port": self.port,
             "healthy": self.healthy, "status": self.status,
+            "role": self.role,
             "draining": self.draining, "breaker": self.breaker,
             "outstanding": self.outstanding,
             "requests": self.requests,
@@ -390,16 +392,28 @@ class Router(Logger):
             return False  # single probe at a time
         return True
 
-    def _pickable(self, now, exclude=()):
-        return [r for r in self._replicas.values()
-                if r.id not in exclude and self._eligible(r, now)]
+    @staticmethod
+    def _serves(rep, phase):
+        """Role gate for one dispatch phase: DECODE-phase traffic
+        (client /generate and the /v1 facade) never lands on a
+        prefill specialist — it would answer 409 — and PREFILL-phase
+        traffic (the disaggregated first hop) never lands on a
+        decode specialist."""
+        if phase == "prefill":
+            return rep.role in ("prefill", "both")
+        return rep.role in ("decode", "both")
 
-    def _pick(self, affinity, now, exclude=()):
+    def _pickable(self, now, exclude=(), phase="decode"):
+        return [r for r in self._replicas.values()
+                if r.id not in exclude and self._serves(r, phase)
+                and self._eligible(r, now)]
+
+    def _pick(self, affinity, now, exclude=(), phase="decode"):
         """Choose the attempt's replica: a half-open breaker's probe
         first (recovery must not wait for idle), then the affinity
         target, then least-outstanding (ties by id for
         determinism)."""
-        candidates = self._pickable(now, exclude)
+        candidates = self._pickable(now, exclude, phase)
         if not candidates:
             return None
         half = [r for r in candidates if r.breaker == "half_open"]
@@ -1024,6 +1038,7 @@ class Router(Logger):
             return
         rep.health_failures = 0
         rep.last_health = info
+        rep.role = str(info.get("role") or "both")
         rep.status = str(info.get("status", "unknown"))
         rep.draining = rep.marked_draining \
             or rep.status == "draining" \
@@ -1106,7 +1121,107 @@ class Router(Logger):
     FORWARD_POSTS = ("/generate", "/v1/completions",
                      "/v1/embeddings", "/v1/classify")
 
+    def _disagg_active(self, now):
+        """Disaggregated dispatch engages only when SPECIALISTS of
+        both phases exist and are eligible — a fleet of "both"
+        replicas keeps the plain colocated path (zero behavior
+        change for every pre-role deployment)."""
+        reps = self._replicas.values()
+        return any(r.role == "prefill" and self._eligible(r, now)
+                   for r in reps) \
+            and any(r.role == "decode" and self._eligible(r, now)
+                    for r in reps)
+
+    async def _maybe_disagg(self, raw, headers, trace):
+        """Disaggregated /generate: prefill on a prefill-specialist
+        → fetch its KV export → hand the blocks to an
+        affinity-picked decode replica for the token loop.  Returns
+        the final reply tuple, or None to fall back to the plain
+        colocated forward (multi-row/stream/beam bodies, no
+        specialists up, or any hop failing — the decode pool can
+        always serve the request cold)."""
+        now = time.monotonic()
+        if not self._disagg_active(now):
+            return None
+        try:
+            body = json.loads(raw.decode() or "{}")
+            prompt = body.get("prompt")
+        except Exception:
+            return None      # the replica will 400 it
+        if not isinstance(prompt, list) or not prompt \
+                or body.get("stream") or body.get("beam") \
+                or int(body.get("steps") or 0) < 1:
+            return None
+        squeeze = not isinstance(prompt[0], list)
+        rows = [prompt] if squeeze else prompt
+        if len(rows) != 1:
+            return None      # batch bodies stay colocated
+        deadline = now + self.request_timeout
+        _, affinity, _, cls = self._inspect(raw, headers)
+        specialists = [r for r in self._pickable(now,
+                                                 phase="prefill")
+                       if r.role == "prefill"]
+        if not specialists:
+            return None      # no SPECIALIST free — serve colocated
+        pre = min(specialists, key=lambda r: (r.outstanding, r.id))
+        pf_body = json.dumps({"prompt": rows[0],
+                              "priority": body.get("priority")})
+        out = await self._attempt(
+            pre, pf_body.encode(), headers, deadline - now,
+            path="/serving/prefill", trace=trace)
+        if not out.deliverable or out.status != 200:
+            return None
+        try:
+            handle = json.loads(out.body.decode())["handle"]
+        except Exception:
+            return None
+        out = await self._attempt(
+            pre, None, headers, deadline - time.monotonic(),
+            path="/serving/kv_export/%s" % handle, method="GET",
+            trace=trace)
+        if not out.deliverable or out.status != 200:
+            return None
+        try:
+            export = json.loads(out.body.decode())
+        except Exception:
+            return None
+        dec = self._pick(affinity, time.monotonic(),
+                         exclude=(pre.id,))
+        if dec is None:
+            return None
+        imp_body = json.dumps({
+            "export": export, "steps": body.get("steps"),
+            "temperature": body.get("temperature"),
+            "top_k": body.get("top_k"), "seed": body.get("seed"),
+            "stop": body.get("stop"),
+            "priority": body.get("priority")})
+        out = await self._attempt(
+            dec, imp_body.encode(), headers,
+            deadline - time.monotonic(), path="/serving/kv_import",
+            trace=trace)
+        if not out.deliverable or out.status != 200:
+            return None
+        try:
+            toks = json.loads(out.body.decode())["tokens"]
+        except Exception:
+            return None
+        self.stats.record_disagg()
+        self.stats.record_request((time.monotonic() - now) * 1e3,
+                                  cls=cls)
+        rheaders = {"Content-Type": "application/json",
+                    "X-Veles-Router-Disagg": "%s>%s" % (pre.id,
+                                                        dec.id),
+                    "X-Veles-Replica": dec.id}
+        if trace is not None:
+            rheaders["X-Veles-Trace"] = trace
+        return 200, rheaders, json.dumps(
+            {"tokens": toks if squeeze else [toks]}).encode()
+
     async def _route(self, method, path, headers, body, trace=None):
+        if method == "POST" and path == "/generate":
+            reply = await self._maybe_disagg(body, headers, trace)
+            if reply is not None:
+                return reply
         if method == "POST" and path in self.FORWARD_POSTS:
             return await self._forward_request(path, body, headers,
                                                trace=trace)

@@ -133,6 +133,27 @@ scatter-then-gather two-pass — allclose, not bit-identical, so the
 parity baseline keeps it off; int8 pools always verify fused),
 ``prefix_cache`` / ``prefix_evict`` (the radix cache above).
 
+Scale-out (both off by default): **tensor-parallel serving**
+(``tp`` / ``root.common.serving.tp``; :mod:`veles_tpu.serving.tp`)
+shards every jitted step — chunked prefill, the paged decode step,
+the spec verify step and the ``serving.kv_*`` block movers — over a
+``{"tp": N}`` mesh with Megatron column/row weight splits and
+HEAD-WISE paged pools (each chip stores ``[blocks, bs, d/tp]``, int8
+scales replicated), so the per-chip HBM of a ``kv_blocks`` budget
+drops by the mesh factor and a model too wide for one chip still
+serves; block tables, admission, the radix trie, drafting and this
+loop stay replicated host logic.  **Disaggregated prefill/decode**
+(``role`` / ``root.common.serving.role``): a ``"prefill"``-role
+scheduler accepts only :meth:`submit_prefill` — it chunk-prefills,
+gathers the finished blocks raw (scales riding along) and parks the
+record for ``GET /serving/kv_export/<handle>``; a ``"decode"``-role
+scheduler adopts such records via :meth:`submit_imported` — blocks
+scatter straight into its own table and the first token samples from
+the exported last-position logits, so the stream is identical to the
+colocated path (fp32 bit-exact; int8 blocks import unrequantized —
+byte-identical resident state).  ``"both"`` (default) keeps the
+single-replica colocated shape; the router routes by role.
+
 Observability: every request carries a **trace id**
 (``submit(trace=...)``; minted when absent, propagated from the
 ``X-Veles-Trace`` header by the REST layer and router) and the
@@ -149,6 +170,7 @@ burn rates (``root.common.slo.*``) ride ``stats.slo``.
 
 import collections
 import concurrent.futures
+import itertools
 import os
 import threading
 import time
@@ -183,6 +205,10 @@ _SHED_FRAC = (0.5, 1.0, 1.5)
 #: client should back off longest — its work is what the overload
 #: sacrifices first)
 _RETRY_AFTER = (4, 2, 1)
+
+#: process-unique default replica ids for metric labels (one per
+#: scheduler built without an explicit fleet identity)
+_SCHED_SEQ = itertools.count(1)
 
 
 def resolve_priority(value):
@@ -239,6 +265,21 @@ class RequestCancelledError(SchedulerError):
     slot and KV blocks were released at the next boundary."""
 
 
+class RoleMismatchError(SchedulerError):
+    """The request phase does not match this replica's role (a
+    decode submit on a prefill specialist or vice versa) — HTTP 409:
+    the router should have dispatched it to the right pool."""
+    http_status = 409
+
+
+#: how long an unclaimed KV export survives (seconds) and how many
+#: records one prefill replica parks at once — the handoff is
+#: immediate in a healthy fleet; these bound a crashed decode pool's
+#: leak
+EXPORT_TTL = 120.0
+EXPORT_CAP = 64
+
+
 def _bucket(n, floor, cap):
     """Pad widths/counts to power-of-two buckets so the compiled
     executable count stays O(log) across arbitrary clients."""
@@ -259,7 +300,8 @@ class _Request(object):
                  "generated", "cancelled", "preempts", "t_submit",
                  "t_admit", "t_first", "pf_seq", "pf_caches",
                  "pf_off", "pf_width", "pf_chunk", "pf_matched",
-                 "prefix_handle", "priority", "sink", "trace")
+                 "prefix_handle", "priority", "sink", "trace",
+                 "export_only", "kv_import")
 
     def __init__(self, prompt, steps, temperature, top_k, stop_token,
                  seed, deadline, priority=1, sink=None, trace=None):
@@ -291,6 +333,8 @@ class _Request(object):
         self.pf_chunk = 0
         self.pf_matched = 0      # warm prefix blocks heading the slot
         self.prefix_handle = None  # pinned radix-cache match
+        self.export_only = False  # prefill-role: stop after export
+        self.kv_import = None     # decode-role: adopted export record
 
     def fail(self, error):
         """Set the future's exception unless a racing path (watchdog,
@@ -322,7 +366,8 @@ class InferenceScheduler(Logger):
                  kv_dtype=None, prefill_chunk=None, warm_buckets=None,
                  request_timeout=None, watchdog=None,
                  shed_block_factor=None, spec=None, spec_k=None,
-                 prefix_cache=None, prefix_evict=None):
+                 prefix_cache=None, prefix_evict=None, tp=None,
+                 role=None, replica_id=None):
         super(InferenceScheduler, self).__init__()
         if not serving_supported(forwards):
             raise ValueError(
@@ -431,7 +476,52 @@ class InferenceScheduler(Logger):
         self.prefix_evict = bool(
             _serving_conf("prefix_evict", True)
             if prefix_evict is None else prefix_evict)
-        self.stats = ServingMetrics()
+        #: tensor-parallel mesh size (0 = off): shards the jitted
+        #: steps over a {"tp": N} mesh — Megatron weight splits +
+        #: head-wise paged pools, per-chip kv_blocks HBM / N
+        #: (serving/tp.py; module docstring).  Needs the paged cache,
+        #: N devices, and a chain whose blocks declare tp layouts.
+        tp = int(_serving_conf("tp", 0) if tp is None else tp or 0)
+        if tp == 1:
+            tp = 0
+        self.tp_ = None
+        if tp:
+            from veles_tpu.serving.tp import ServingTP, tp_supported
+            import jax
+            if self.kv != "paged":
+                self.info("tp needs the paged cache; serving "
+                          "unsharded")
+                tp = 0
+            elif len(jax.devices()) < tp:
+                self.info("tp=%d needs %d devices, found %d; serving "
+                          "unsharded", tp, tp, len(jax.devices()))
+                tp = 0
+            elif not tp_supported(forwards, tp):
+                self.info("chain does not divide over tp=%d (heads/"
+                          "d_model/hidden divisibility, or a MoE/"
+                          "int8-weight block); serving unsharded", tp)
+                tp = 0
+            else:
+                self.tp_ = ServingTP(tp)
+        self.tp = tp
+        #: disaggregation role (module docstring): "prefill" accepts
+        #: only submit_prefill and parks KV exports; "decode" adopts
+        #: them via submit_imported; "both" is the colocated default
+        role = str(role or _serving_conf("role", "both")).lower()
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                "role must be 'prefill', 'decode' or 'both'")
+        if role == "prefill" and self.kv != "paged":
+            raise ValueError("role='prefill' needs the paged cache "
+                             "(block export is block-granular)")
+        self.role = role
+        #: identity for the per-replica metric labels (satellite of
+        #: the last-scheduler-wins gauge fix): the fleet's replica id
+        #: when the REST layer passes one, else a process-unique name
+        self.replica_id = str(replica_id) if replica_id \
+            else "sched%d" % next(_SCHED_SEQ)
+        self.stats = ServingMetrics(replica=self.replica_id)
+        self._exports = {}           # handle -> export record (lock)
         #: per-request tracing (telemetry/reqtrace.py), read ONCE at
         #: construction — the per-boundary gate must be an attribute
         #: test, not a config-tree walk
@@ -527,6 +617,10 @@ class InferenceScheduler(Logger):
         :class:`QueueFullError` when admission control rejects (queue
         depth, block-pressure shed, or :class:`DrainingError` once a
         drain began)."""
+        if self.role == "prefill":
+            raise RoleMismatchError(
+                "prefill-role replica serves POST /serving/prefill "
+                "only — decode requests belong on the decode pool")
         prio = resolve_priority(priority)
         prompt = [int(t) for t in prompt]
         steps = int(steps)
@@ -565,6 +659,18 @@ class InferenceScheduler(Logger):
             time.monotonic() + ttl if ttl > 0 else None,
             priority=prio, sink=ts._push if ts is not None else None,
             trace=trace)
+        self._admission_enqueue(req)
+        if ts is not None:
+            ts._bind(self, req.future)
+            return ts
+        return req.future
+
+    def _admission_enqueue(self, req):
+        """Admission control + enqueue for one built request — the
+        shared tail of :meth:`submit`, :meth:`submit_prefill` and
+        :meth:`submit_imported` (drain/queue-cap/block-pressure
+        checks under the wake lock)."""
+        prio = req.priority
         need = self._blocks_for(req)
         cls = CLASS_NAMES[prio]
         with self._wake:
@@ -593,7 +699,7 @@ class InferenceScheduler(Logger):
                 # work while high-class admission still has headroom
                 # — and a shed low client backs off longer
                 self.stats.record_shed(self._queued_blocks, cls=cls,
-                                       trace=trace)
+                                       trace=req.trace)
                 err = QueueFullError(
                     "overloaded: %d KV blocks committed in-queue "
                     "(pool %d, %s-class shed at factor %.1f)"
@@ -605,6 +711,130 @@ class InferenceScheduler(Logger):
             self._enqueue_locked(req)
             self._queued_blocks += need
             self._wake.notify()
+
+    def submit_prefill(self, prompt, seed=None, timeout=None,
+                       priority=None, trace=None):
+        """Queue one prompt for PREFILL-ONLY service (the
+        disaggregated fleet's prefill half; roles "prefill"/"both"):
+        the prompt rides the normal admission + chunked-prefill path,
+        but instead of decoding, the finished KV blocks are gathered
+        RAW (scales included under int8) together with the
+        last-position logits and parked under a handle for ``GET
+        /serving/kv_export/<handle>``.  The returned future resolves
+        to ``{"handle", "prompt_tokens", "blocks"}``.  No sampler
+        parameters here — sampling is the decode replica's business
+        (it draws from the exported logits with ITS
+        temperature/seed, which is what keeps the handed-off stream
+        identical to the colocated one)."""
+        if self.role == "decode":
+            raise RoleMismatchError(
+                "decode-role replica imports KV (POST "
+                "/serving/kv_import) — prefill belongs on the "
+                "prefill pool")
+        if self.kv != "paged":
+            raise ValueError("prefill export needs the paged cache")
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        if len(prompt) > self.window:
+            raise ValueError(
+                "prompt of %d tokens exceeds the serving window (%d)"
+                % (len(prompt), self.window))
+        prio = resolve_priority(priority)
+        ttl = float(timeout or self.request_timeout
+                    or self.queue_timeout or 0)
+        trace = reqtrace.ensure_trace_id(trace)
+        if seed is None:
+            seed = int.from_bytes(os.urandom(4), "little")
+        req = _Request(
+            prompt, 1, 0.0, 0, None, int(seed) & 0xFFFFFFFF,
+            time.monotonic() + ttl if ttl > 0 else None,
+            priority=prio, trace=trace)
+        req.export_only = True
+        self._admission_enqueue(req)
+        return req.future
+
+    def kv_export(self, handle):
+        """Claim one parked export record (one-shot — the fetch
+        consumes it), or None when the handle is unknown/expired.
+        The record is the host-side numpy form;
+        ``serving/disagg.encode_export`` is the wire envelope."""
+        now = time.monotonic()
+        with self._lock:
+            for h in [h for h, r in self._exports.items()
+                      if now - r["t"] > EXPORT_TTL]:
+                del self._exports[h]
+            return self._exports.pop(str(handle), None)
+
+    def submit_imported(self, export, steps, temperature=0.0,
+                        top_k=0, seed=None, stop_token=None,
+                        timeout=None, priority=None, stream=False,
+                        trace=None):
+        """Adopt a prefill replica's export record (the decoded form
+        of ``GET /serving/kv_export/<handle>``; roles
+        "decode"/"both") and decode ``steps`` tokens: admission
+        claims the full prompt+steps block budget, the exported
+        blocks scatter straight into the slot's table (no prefill
+        pass at all — the decode replica's TTFT is one block
+        scatter), and the first token samples from the exported
+        logits with the caller's sampler settings — the stream is
+        identical to a colocated ``submit`` of the same prompt
+        (fp32 bit-exact; int8 byte-identical resident KV).  Raises
+        ``ValueError`` on a record that doesn't match this replica's
+        pool layout (kv_dtype / block_size / window)."""
+        if self.role == "prefill":
+            raise RoleMismatchError(
+                "prefill-role replica exports KV — imports belong "
+                "on the decode pool")
+        if self.kv != "paged":
+            raise ValueError("kv import needs the paged cache")
+        prompt = [int(t) for t in export.get("prompt", ())]
+        steps = int(steps)
+        if not prompt or int(export.get("length", -1)) != len(prompt):
+            raise ValueError("export record prompt/length mismatch")
+        if steps < 1:
+            raise ValueError("steps must be >= 1")
+        if str(export.get("kv_dtype")) != self.kv_dtype:
+            raise ValueError(
+                "export kv_dtype %r != this replica's %r — "
+                "disaggregated pools must share a storage dtype"
+                % (export.get("kv_dtype"), self.kv_dtype))
+        if int(export.get("block_size", 0)) != self.block_size:
+            raise ValueError(
+                "export block_size %s != this replica's %d"
+                % (export.get("block_size"), self.block_size))
+        if len(prompt) + steps > self.window:
+            raise ValueError(
+                "prompt_len + steps = %d exceeds the serving window "
+                "(%d)" % (len(prompt) + steps, self.window))
+        need = -(-(len(prompt) + steps) // self.block_size)
+        if need > self.kv_blocks:
+            raise ValueError(
+                "request needs %d KV blocks > pool capacity %d "
+                "(kv_blocks)" % (need, self.kv_blocks))
+        temperature = float(temperature or 0.0)
+        top_k = int(top_k or 0)
+        if top_k and not temperature:
+            raise ValueError(
+                "top_k only applies to sampling — set temperature > 0")
+        if seed is None:
+            seed = int.from_bytes(os.urandom(4), "little")
+        prio = resolve_priority(priority)
+        ttl = float(timeout or self.request_timeout
+                    or self.queue_timeout or 0)
+        trace = reqtrace.ensure_trace_id(trace)
+        ts = TokenStream(prompt) if stream else None
+        if ts is not None:
+            ts.trace = trace
+        req = _Request(
+            prompt, steps, temperature, top_k,
+            int(stop_token) if stop_token is not None else None,
+            int(seed) & 0xFFFFFFFF,
+            time.monotonic() + ttl if ttl > 0 else None,
+            priority=prio, sink=ts._push if ts is not None else None,
+            trace=trace)
+        req.kv_import = export
+        self._admission_enqueue(req)
         if ts is not None:
             ts._bind(self, req.future)
             return ts
@@ -651,11 +881,20 @@ class InferenceScheduler(Logger):
         victim.fail(err)
         return True
 
+    def _budget_tokens(self, req):
+        """The token span a request's block budget must cover: prompt
+        + decode steps, or just the prompt for a prefill-export
+        request (it never decodes here — the decode replica claims
+        the steps' blocks on ITS pool)."""
+        if req.export_only:
+            return len(req.prompt)
+        return len(req.prompt) + req.steps
+
     def _blocks_for(self, req):
         """The paged block budget a request commits (0 when dense)."""
         if self.kv != "paged":
             return 0
-        return -(-(len(req.prompt) + req.steps) // self.block_size)
+        return -(-self._budget_tokens(req) // self.block_size)
 
     def cancel(self, future, reason="cancelled by client"):
         """Cancel the request behind ``future`` (client disconnected
@@ -818,7 +1057,11 @@ class InferenceScheduler(Logger):
     def _kv_snapshot(self):
         out = {"kv_mode": self.kv,
                "prefill_chunk": self.prefill_chunk,
-               "prefilling": len(self._prefilling)}
+               "prefilling": len(self._prefilling),
+               "tp": self.tp,
+               "role": self.role,
+               "replica": self.replica_id,
+               "kv_exports_pending": len(self._exports)}
         cache = self.cache_
         if self.kv == "paged":
             out["kv_dtype"] = self.kv_dtype
@@ -941,6 +1184,7 @@ class InferenceScheduler(Logger):
             self._active.clear()
             self._admitting = []
             self._aux.clear()
+            self._exports.clear()
             self._queued_blocks = 0
         for _, _, fut in aux:
             if not fut.done():
@@ -971,7 +1215,8 @@ class InferenceScheduler(Logger):
                                 self.window,
                                 block_size=self.block_size,
                                 kv_blocks=self.kv_blocks,
-                                kv_dtype=self.kv_dtype)
+                                kv_dtype=self.kv_dtype,
+                                tp=self.tp_)
         return SlotKVCache(self.forwards, self.max_slots, self.window)
 
     def _warm_paged(self, cache):
@@ -1105,7 +1350,7 @@ class InferenceScheduler(Logger):
         ``ceil(cold_tokens / block_size)`` plus decode headroom — so
         cache hits raise the concurrent-stream ceiling; evictable
         refcount-0 resident blocks count as headroom too."""
-        total = len(req.prompt) + req.steps
+        total = self._budget_tokens(req)
         if self.kv != "paged":
             return cache.can_admit(total)
         if not cache.free_slots:
@@ -1113,9 +1358,11 @@ class InferenceScheduler(Logger):
         need = cache.blocks_needed(total)
         head = cache.free_blocks
         if self.prefix_ is not None:
-            seq = list(req.prompt) + list(req.generated)
-            need -= self.prefix_.peek(
-                seq, max_blocks=(len(seq) - 1) // cache.block_size)
+            if req.kv_import is None:   # imports never match warm
+                seq = list(req.prompt) + list(req.generated)
+                need -= self.prefix_.peek(
+                    seq,
+                    max_blocks=(len(seq) - 1) // cache.block_size)
             if self.prefix_evict:
                 head += self.prefix_.evictable_blocks()
         return need <= head
@@ -1126,12 +1373,15 @@ class InferenceScheduler(Logger):
         the first-token logits must come from somewhere), evict
         cold residents if the free list is short, then alloc with
         the matched blocks heading the table."""
-        total = len(req.prompt) + req.steps
+        total = self._budget_tokens(req)
         if self.kv != "paged":
             req.slot = cache.alloc(total)
             return req.slot is not None
         handle = None
-        if self.prefix_ is not None:
+        # an IMPORT scatters into its leading table blocks — they
+        # must be privately owned, never prefix-cache residents, so
+        # imports skip the warm match entirely
+        if self.prefix_ is not None and req.kv_import is None:
             seq = list(req.prompt) + list(req.generated)
             handle = self.prefix_.match(
                 seq, max_blocks=(len(seq) - 1) // cache.block_size)
@@ -1354,6 +1604,14 @@ class InferenceScheduler(Logger):
         prompt + the kept generated prefix, so the re-prefill rebuilds
         exactly the K/V its decode steps had written before eviction."""
         req.t_admit = time.monotonic()
+        if req.kv_import is not None and not req.preempts:
+            # disaggregated handoff: the exported blocks ARE the
+            # prefill — scatter them in and go straight to decode.
+            # A preempt-resume of an imported request falls through
+            # to the normal re-prefill below instead (its blocks
+            # were freed; the chain recomputes the identical K/V)
+            self._admit_import(req, cache)
+            return
         seq = list(req.prompt) + list(req.generated)
         if req.preempts and req.generated:
             self.stats.record_resume(len(seq))
@@ -1440,7 +1698,7 @@ class InferenceScheduler(Logger):
             faults.fire("serving.scheduler.prefill")
             row_caches, last = prefill(
                 self.forwards, padded, prompt_lens=[p_len],
-                window=width)
+                window=width, tp=self.tp_)
         except Exception as e:
             self._retire(req, cache, error=e)
             return
@@ -1471,7 +1729,7 @@ class InferenceScheduler(Logger):
             faults.fire("serving.scheduler.prefill")
             req.pf_caches, last = prefill_chunk(
                 self.forwards, padded, off, [clen], req.pf_caches,
-                key_width=kw)
+                key_width=kw, tp=self.tp_)
         except Exception as e:
             with self._lock:
                 if req in self._prefilling:
@@ -1508,8 +1766,21 @@ class InferenceScheduler(Logger):
         except Exception as e:
             self._retire(req, cache, error=e)
             return
+        if req.export_only:
+            # prefill-role terminus: the blocks now hold the whole
+            # prompt's K/V — gather them raw + the first-token
+            # logits, park the record, and hand the blocks back
+            self._retire_export(req, cache, last)
+            return
         req.pf_caches = None
         req.pf_seq = None
+        self._activate(req, cache, last)
+
+    def _activate(self, req, cache, last):
+        """Emit the first token from the last-position logits (draw
+        ``len(generated)`` of the request's stream) and join the
+        active decode set — the shared tail of a finished prefill
+        and an adopted KV import."""
         tok = int(numpy.asarray(first_tokens(
             last, [req.temperature], [req.top_k], [req.seed],
             counts=[len(req.generated)]))[0])
@@ -1528,6 +1799,93 @@ class InferenceScheduler(Logger):
         with self._lock:
             self._active[req.slot] = req
         self._maybe_finish(req, cache)
+
+    def _admit_import(self, req, cache):
+        """Adopt a KV export record (disaggregated decode half): the
+        exported blocks scatter RAW into the slot's leading table
+        blocks — byte-identical resident state to the exporting
+        replica, no prefill pass — and the first token samples from
+        the exported logits with this request's sampler settings
+        (draw 0 of its stream, the exact fold the colocated path
+        uses)."""
+        imp = req.kv_import
+        try:
+            faults.fire("serving.scheduler.kv_import")
+            n = cache.blocks_needed(imp["length"])
+            ids = [int(b) for b in cache.tables[req.slot, :n]]
+            cache.import_blocks(ids, imp["layers"])
+        except Exception as e:
+            self._retire(req, cache, error=e)
+            return
+        if self._tron:
+            reqtrace.record(
+                req.trace, "queue",
+                duration=req.t_admit - req.t_submit,
+                cls=CLASS_NAMES[req.priority], resume=False)
+            reqtrace.record(
+                req.trace, "kv_import", slot=req.slot,
+                tokens=int(imp["length"]), blocks=len(ids))
+        last = numpy.asarray(imp["logits"],
+                             numpy.float32).reshape(1, -1)
+        self._activate(req, cache, last)
+
+    def _retire_export(self, req, cache, last):
+        """Finish a prefill-export request: gather the slot's blocks
+        raw (scales riding along under int8) plus the last-position
+        logits into a handle-addressed record, then release the slot
+        — donating the prompt's blocks to the prefix cache like any
+        finished request, so repeat prompts prefill warm on this
+        replica too."""
+        p_len = len(req.pf_seq)
+        try:
+            faults.fire("serving.scheduler.kv_export")
+            n = cache.blocks_needed(p_len)
+            ids = [int(b) for b in cache.tables[req.slot, :n]]
+            from veles_tpu.serving.disagg import mint_handle
+            handle = mint_handle()
+            record = {
+                "handle": handle,
+                "prompt": list(req.prompt),
+                "length": p_len,
+                "kv_dtype": self.kv_dtype,
+                "block_size": self.block_size,
+                "logits": numpy.asarray(last,
+                                        numpy.float32)[0].copy(),
+                "layers": cache.export_blocks(ids),
+                "t": time.monotonic(),
+            }
+        except Exception as e:
+            self._retire(req, cache, error=e)
+            return
+        req.pf_caches = None
+        req.pf_seq = None
+        with self._lock:
+            self._active.pop(req.slot, None)
+        self._release_slot(req, cache, finished=True)
+        self._sync_kv_gauges(cache)
+        now = time.monotonic()
+        with self._lock:
+            stale = [h for h, r in self._exports.items()
+                     if now - r["t"] > EXPORT_TTL]
+            for h in stale:
+                del self._exports[h]
+            while len(self._exports) >= EXPORT_CAP:
+                # oldest unclaimed record pays for the cap
+                oldest = min(self._exports,
+                             key=lambda h: self._exports[h]["t"])
+                del self._exports[oldest]
+            self._exports[handle] = record
+        if self._tron:
+            reqtrace.record(
+                req.trace, "kv_export", tokens=p_len, blocks=n,
+                total_s=round(now - req.t_submit, 6))
+        if not req.future.done():
+            try:
+                req.future.set_result({
+                    "handle": handle, "prompt_tokens": p_len,
+                    "blocks": n})
+            except concurrent.futures.InvalidStateError:
+                pass
 
     def _step(self, cache):
         """Advance every active request one token through the shared

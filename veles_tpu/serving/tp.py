@@ -1,0 +1,153 @@
+"""Tensor-parallel serving context — shard the jitted decode steps
+over a ``tp`` mesh axis so a model (weights AND paged K/V pools)
+bigger than one chip's HBM still serves.
+
+Megatron-LM-style layer sharding (Shoeybi et al., 2019) mapped onto
+the serving engine: each unit that wants to shard DECLARES its own
+layout through ``tp_param_spec(name, tp)`` (see
+``models/transformer.py`` — wq/wk/wv and the FFN up-projection are
+column-parallel, wo and the FFN down-projection row-parallel, so the
+only cross-chip traffic per layer is the two output reductions XLA
+inserts), and the paged K/V block pools shard **head-wise** — each
+chip stores ``[num_blocks, block_size, d/tp]`` of every pool, the
+per-row int8 dequant scales riding along replicated (their amax
+reduces over the sharded feature axis, which is exact, so quantized
+values are bit-identical to the unsharded pools).  Everything
+host-side — block tables, admission, the radix trie, spec drafting,
+the scheduler loop — stays replicated logic; ONLY the jitted steps
+shard, which is why the integration is a context object threaded
+through the compiled-step factories (the executable caches key on
+``tp`` so toggling never reuses a stale trace).
+
+The context rides :class:`~veles_tpu.serving.kv_slots.PagedKVCache`
+(``cache.tp_``) into ``serving/engine.py`` and is passed explicitly
+to ``serving/prefill.py`` — the full set of jitted serving entry
+points (``apply_prefill_chunk``, ``apply_step_paged``,
+``verify_step_paged`` and the ``serving.kv_*`` block movers) then
+runs SPMD over the mesh with no per-step host logic changes.
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from veles_tpu.parallel.mesh import build_mesh
+
+
+def tp_supported(forwards, size):
+    """True when every cacheable block in the chain declares a
+    tensor-parallel layout that divides over ``size`` shards
+    (``tp_shardable`` — heads, model dim and FFN hidden all
+    divisible; MoE and int8-weight decode blocks opt out).  The
+    scheduler falls back to unsharded serving otherwise."""
+    if size < 2:
+        return False
+    has = False
+    for u in forwards:
+        if hasattr(u, "init_cache"):
+            has = True
+            fn = getattr(u, "tp_shardable", None)
+            if fn is None or not fn(size):
+                return False
+    return has
+
+
+class ServingTP:
+    """One serving replica's tensor-parallel mesh + placement cache.
+
+    ``size`` chips off the front of ``devices`` (default
+    ``jax.devices()``) form a ``{"tp": size}`` mesh
+    (``parallel/mesh.py`` axis conventions).  ``device_params``
+    shards the chain's frozen weights by each unit's declared spec
+    ONCE and caches the placement (serving weights never change, so
+    repeated decode steps must not re-ship them);
+    ``shard_pools`` places a paged layer's K/V pools head-wise and
+    its scale arrays replicated."""
+
+    def __init__(self, size, devices=None):
+        self.size = int(size)
+        if self.size < 2:
+            raise ValueError("tp needs size >= 2 (got %d)" % size)
+        devs = list(devices if devices is not None
+                    else jax.devices())
+        if len(devs) < self.size:
+            raise ValueError(
+                "tp=%d needs %d devices, found %d"
+                % (self.size, self.size, len(devs)))
+        self.mesh = build_mesh({"tp": self.size}, devs[:self.size])
+        self._params = None
+        self._params_for = None
+
+    def sharding(self, spec):
+        return NamedSharding(self.mesh, spec)
+
+    def device_params(self, forwards):
+        """The chain's parameters placed on the mesh: sharded where
+        the unit declares a ``tp_param_spec``, replicated elsewhere.
+        Computed once per chain (the ctx belongs to one scheduler,
+        whose weights are frozen) — the sharded counterpart of
+        ``models/generate._device_params``."""
+        key = id(forwards)
+        if self._params is not None and self._params_for == key:
+            return self._params
+        out = {}
+        for i, u in enumerate(forwards):
+            spec_fn = getattr(u, "tp_param_spec", None)
+            layer = {}
+            for name, arr in u.param_arrays().items():
+                spec = spec_fn(name, self.size) \
+                    if spec_fn is not None else None
+                # reshard the CURRENT device value (devmem) — the
+                # host .mem buffer can be stale after training until
+                # a map_read, and serving must see what the solver
+                # actually wrote
+                layer[name] = jax.device_put(
+                    arr.devmem,
+                    self.sharding(spec if spec is not None else P()))
+            out[i] = layer
+        self._params = out
+        self._params_for = key
+        return out
+
+    def shard_pools(self, pools):
+        """Place one cache's per-layer pool dicts on the mesh: K/V
+        buffers ``[num_blocks, block_size, d]`` shard head-wise over
+        the feature axis (each chip holds ``d/tp`` of every block);
+        ``*_scale`` arrays (and any axis that doesn't divide)
+        replicate — scales are indexed [block, row] like the pools,
+        and a replicated copy is what keeps every later block move
+        (insert/gather/export) shard-layout-free."""
+        out = {}
+        for i, layer in pools.items():
+            got = {}
+            for name, a in layer.items():
+                if name.endswith("_scale") or a.ndim != 3 \
+                        or a.shape[-1] % self.size:
+                    got[name] = jax.device_put(a, self.sharding(P()))
+                else:
+                    got[name] = jax.device_put(
+                        a, self.sharding(P(None, None, "tp")))
+            out[i] = got
+        return out
+
+
+def per_chip_bytes(tree):
+    """The WORST per-device resident bytes of the jax arrays in a
+    (possibly nested) dict tree — the honest "does this model fit one
+    chip" measure: sharded arrays count ``nbytes / tp`` per chip,
+    replicated arrays count in full on every chip.  This is the
+    number ``bench.py tp`` holds fixed while growing d_model."""
+    acc = {}
+
+    def visit(x):
+        if isinstance(x, dict):
+            for v in x.values():
+                visit(v)
+        elif hasattr(x, "addressable_shards"):
+            for sh in x.addressable_shards:
+                acc[sh.device.id] = acc.get(sh.device.id, 0) \
+                    + sh.data.nbytes
+        elif hasattr(x, "nbytes"):   # plain single-device array
+            acc[0] = acc.get(0, 0) + x.nbytes
+
+    visit(tree)
+    return max(acc.values()) if acc else 0
