@@ -1771,6 +1771,68 @@ def bench_streaming(dev):
     return out
 
 
+def bench_alerts(dev):
+    """Fleet-observability numbers (``veles_tpu/telemetry/alerts.py``
+    + the PR 14 goodput accounting):
+
+    - ``alert_eval_overhead_us`` — mean wall time of ONE alert-engine
+      tick over the full shipped rule set against the live registry
+      (the recurring cost every serving process pays at
+      ``root.common.alerts.interval``);
+    - ``alert_eval_rules`` — how many rules that tick evaluated;
+    - ``serving_goodput_tokens_per_sec`` / ``serving_bucket_padding_
+      efficiency`` — the two new gauges measured off a short real
+      serving soak (mixed request sizes, so the pow2 buckets are
+      exercised with genuine padding)."""
+    from veles_tpu.serving import InferenceScheduler
+    from veles_tpu.telemetry.alerts import AlertEngine
+
+    cpu = dev.jax_device.platform == "cpu"
+    if cpu:
+        d_model, layers, heads, vocab, window = 64, 2, 2, 256, 128
+        steps, clients = 8, 4
+    else:
+        d_model, layers, heads, vocab, window = 1024, 8, 8, 32768, 512
+        steps, clients = 64, 8
+    fw = _serving_chain(dev, d_model, layers, heads, vocab, window,
+                        "bench-alerts")
+    prompt = numpy.random.default_rng(0).integers(
+        0, vocab, (16,)).tolist()
+    sch = InferenceScheduler(fw, max_slots=4, window=window,
+                             max_queue=2 * clients,
+                             queue_timeout=600.0,
+                             warm_buckets=False,
+                             replica_id="bench-alerts").start()
+    try:
+        sch.submit(prompt, steps).result(600)   # compile + settle
+        futs = [sch.submit(prompt[: 4 + 3 * (i % 4)], steps, seed=i)
+                for i in range(clients)]
+        for f in futs:
+            f.result(600)
+        snap = sch.metrics()
+        # tick cost over the REAL registry the soak just populated
+        engine = AlertEngine(name="bench", interval=3600)
+        engine.tick()   # settle lazy family creation / prev deltas
+        n, t0 = 200, time.perf_counter()
+        for _ in range(n):
+            engine.tick()
+        per_tick_us = (time.perf_counter() - t0) / n * 1e6
+        return {
+            "alert_eval_overhead_us": round(per_tick_us, 1),
+            "alert_eval_rules": len(engine.rules),
+            "serving_goodput_tokens_per_sec":
+                snap["goodput_tokens_per_sec"],
+            "serving_bucket_padding_efficiency":
+                snap["bucket_padding_efficiency"],
+            "alerts_config": {
+                "d_model": d_model, "layers": layers,
+                "steps": steps, "clients": clients,
+                "ticks_timed": n},
+        }
+    finally:
+        sch.close()
+
+
 def bench_input_pipeline(dev, steps=40, depth=2):
     """Asynchronous input pipeline (loader/prefetch.py): a synthetic
     SLOW streaming loader — ``fill_minibatch`` sleeps ``decode_ms``
@@ -2181,10 +2243,20 @@ def main_tp():
         "other entries carried")
 
 
+def main_alerts():
+    """``python bench.py alerts`` — the alert-engine overhead +
+    goodput/bucket-efficiency bench alone."""
+    return _main_standalone(
+        bench_alerts, "alerts_bench_source",
+        "PR14 standalone alerting/goodput bench run; other entries "
+        "carried")
+
+
 if __name__ == "__main__":
     sys.exit(main_router() if "router" in sys.argv[1:]
              else main_spec() if "spec" in sys.argv[1:]
              else main_streaming() if "streaming" in sys.argv[1:]
              else main_kv_quant() if "kv_quant" in sys.argv[1:]
              else main_tp() if "tp" in sys.argv[1:]
+             else main_alerts() if "alerts" in sys.argv[1:]
              else main())

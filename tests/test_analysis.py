@@ -371,6 +371,56 @@ def g(name):
     assert "C402" not in codes_of(scan(tmp_path, files))
 
 
+# -- M-series ----------------------------------------------------------------
+
+def test_m501_off_convention_family_name(tmp_path):
+    bad = """\
+from veles_tpu.telemetry import metrics
+
+a = metrics.counter("BadName_total", "x")
+b = metrics.gauge("veles_camelCase", "x")
+ok = metrics.histogram("veles_good_ms", "x")
+"""
+    f = [x for x in scan(tmp_path, {"m.py": bad})
+         if x.code == "M501"]
+    assert {x.detail for x in f} == {"BadName_total",
+                                     "veles_camelCase"}
+    # instance-local constructions and non-registry receivers are
+    # out of scope
+    clean = """\
+import numpy
+from veles_tpu.telemetry import Histogram
+
+h = Histogram("ttft_ms")
+c, e = numpy.histogram([1, 2])
+"""
+    assert "M501" not in codes_of(scan(tmp_path, {"m.py": clean}))
+
+
+def test_m502_inconsistent_label_sets(tmp_path):
+    bad = """\
+from veles_tpu.telemetry import metrics
+
+a = metrics.counter("veles_x_total", "x",
+                    labelnames=("replica", "to"))
+b = metrics.counter("veles_x_total", "x", labelnames=("replica",))
+"""
+    f = [x for x in scan(tmp_path, {"m.py": bad})
+         if x.code == "M502"]
+    assert len(f) == 2 and all(x.detail == "veles_x_total"
+                               for x in f)
+    # agreeing sites (order-insensitive) are quiet
+    ok = """\
+from veles_tpu.telemetry import metrics
+
+a = metrics.counter("veles_x_total", "x",
+                    labelnames=("to", "replica"))
+b = metrics.counter("veles_x_total", "x",
+                    labelnames=("replica", "to"))
+"""
+    assert "M502" not in codes_of(scan(tmp_path, {"m.py": ok}))
+
+
 # -- baseline ----------------------------------------------------------------
 
 def test_baseline_suppresses_and_goes_stale(tmp_path):
@@ -420,7 +470,8 @@ def test_package_scans_clean_under_strict_and_fast():
 
 def test_every_code_has_a_registered_pass():
     assert {"D101", "D102", "D103", "T201", "T202", "T203", "T204",
-            "L301", "L302", "C401", "C402"} == set(ALL_CODES)
+            "L301", "L302", "C401", "C402",
+            "M501", "M502"} == set(ALL_CODES)
 
 
 def test_cli_json_smoke_and_no_jax_import():

@@ -229,6 +229,25 @@ root.common.update({
     # crash flight recorder (telemetry/flight_recorder.py): bundle
     # lands in `dir` (default: the snapshot dir) on crash/SIGUSR1
     "flightrec": {"enabled": True, "dir": None, "dump_on_exit": False},
+    # alerting engine (telemetry/alerts.py): a low-frequency ticker
+    # evaluates declarative rules over the metrics registry with a
+    # pending -> firing -> resolved state machine and for_seconds
+    # hold-downs.  `defaults` ships the built-in rule set (SLO burn
+    # fast+slow, breaker open, health halt, replica unreachable, KV
+    # pressure, watchdog stall, prefix-hit collapse, padding waste);
+    # `rules` appends user rules as dicts — {"name", "expr", "for",
+    # "severity"} with expr = "[func(]family[{k=v}][)] OP number"
+    # (see docs/observability.md for the grammar).  webhook_url gets
+    # a JSON POST per fire/resolve (best-effort sink, fault point
+    # `alerts.webhook`); router and serving replicas each run one
+    # engine when enabled, served at GET /alerts
+    "alerts": {
+        "enabled": True,
+        "interval": 1.0,
+        "defaults": True,
+        "rules": (),
+        "webhook_url": None,
+    },
     # per-request distributed tracing (telemetry/reqtrace.py): trace
     # ids minted at the edge (or accepted via X-Veles-Trace),
     # propagated router -> replica -> scheduler, phase spans appended

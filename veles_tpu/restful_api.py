@@ -261,6 +261,9 @@ class RESTfulAPI(Unit):
         self._thread_ = None
         self._legacy_lock_ = threading.Lock()
         self.scheduler_ = None
+        #: replica-tier alert engine (telemetry/alerts.py), created
+        #: at initialize() when root.common.alerts.enabled
+        self.alerts_ = None
         #: POST /drain latched: /healthz answers 503 "draining" and
         #: the scheduler (if any) stops admitting
         self._draining_ = False
@@ -446,6 +449,14 @@ class RESTfulAPI(Unit):
                     # clients enumerate before they complete)
                     from veles_tpu.serving import openai_api
                     self._reply_json(openai_api.models_reply())
+                    return
+                if route == "/alerts":
+                    # the replica-tier alert engine: firing/pending
+                    # instances + the loaded rule set
+                    if api.alerts_ is None:
+                        self._reply_json({"enabled": False})
+                        return
+                    self._reply_json(api.alerts_.snapshot())
                     return
                 if route == "/metrics":
                     # Prometheus text exposition of the process-wide
@@ -1247,6 +1258,12 @@ class RESTfulAPI(Unit):
             target=self._server_.serve_forever, daemon=True,
             name="restful-api")
         self._thread_.start()
+        from veles_tpu.config import root as _root
+        if self.alerts_ is None \
+                and _root.common.alerts.get("enabled", True):
+            from veles_tpu.telemetry.alerts import AlertEngine
+            self.alerts_ = AlertEngine(
+                name=self.replica_id or "replica").start()
         self.info("REST API on http://%s:%d/api", self.host, self.port)
 
     def run(self):
@@ -1263,6 +1280,9 @@ class RESTfulAPI(Unit):
         self.loader.pending_futures_ = []
 
     def stop(self):
+        alerts, self.alerts_ = self.alerts_, None
+        if alerts is not None:
+            alerts.stop()
         if self.scheduler_ is not None:
             self.scheduler_.close()
             self.scheduler_ = None

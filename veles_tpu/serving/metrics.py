@@ -335,6 +335,34 @@ def _registry_series():
             "veles_serving_class_ttft_ms",
             "submit-to-first-token latency by priority class (ms)",
             labelnames=("cls",), buckets=MS_BUCKETS),
+        # goodput accounting (PR 14): the decode loop already padded
+        # every step to a pow2 occupancy bucket — these gauges make
+        # "busy but wasting its batches" a visible, alertable fact
+        "goodput": metrics.gauge(
+            "veles_serving_goodput_tokens_per_sec",
+            "tokens emitted per wall second over the recent "
+            "decode-step window — throughput the CLIENTS received, "
+            "as opposed to slot-steps burned; labeled per replica",
+            labelnames=("replica",)),
+        "pad_eff": metrics.gauge(
+            "veles_serving_bucket_padding_efficiency",
+            "real vs padded batch positions over the recent "
+            "decode-step window (sum(active)/sum(bucket)); 1.0 means "
+            "every padded row carried a request, low values mean the "
+            "pow2 buckets are mostly padding; labeled per replica",
+            labelnames=("replica",)),
+        "kv_pressure": metrics.gauge(
+            "veles_serving_kv_pressure",
+            "paged-KV pool occupancy fraction used/(used+free) — "
+            "the admission-pressure number the kv_block_pressure "
+            "alert rule watches; labeled per replica",
+            labelnames=("replica",)),
+        "prefix_rate": metrics.gauge(
+            "veles_serving_prefix_hit_rate_recent",
+            "radix prefix-cache hit rate over the recent lookup "
+            "window (reads 1.0 until enough lookups arrive, so the "
+            "collapse alert never fires on idle); labeled per "
+            "replica", labelnames=("replica",)),
     }
 
 
@@ -371,6 +399,12 @@ def _router_series():
             "veles_router_breaker_state",
             "per-replica circuit breaker: 0 closed, 1 half-open, "
             "2 open", labelnames=("replica",)),
+        "replica_up": metrics.gauge(
+            "veles_router_replica_up",
+            "1 while the router's health poll reaches the replica, "
+            "0 once it is unreachable/out of rotation — the "
+            "replica_unreachable alert rule watches this",
+            labelnames=("replica",)),
         "breaker_transitions": metrics.counter(
             "veles_router_breaker_transitions_total",
             "circuit-breaker state entries, by replica and new state",
@@ -466,6 +500,19 @@ class RouterMetrics:
         events.record("router.breaker", "single", cls="Router",
                       replica=str(replica), to=state)
 
+    def record_replica_up(self, replica, up):
+        """Health-poll outcome: 1 reachable, 0 unreachable (the
+        alert engine's replica_unreachable series)."""
+        self._global["replica_up"].labels(
+            replica=str(replica)).set(1 if up else 0)
+
+    def forget_replica(self, replica):
+        """Drop a deregistered replica's labeled series so a removed
+        replica neither exports stale state forever nor keeps a
+        resolved unreachable-alert series alive."""
+        for name in ("replica_up", "breaker_state"):
+            self._global[name].remove(str(replica))
+
     def record_stream(self, replica):
         with self._lock:
             self.streams += 1
@@ -549,6 +596,11 @@ class ServingMetrics:
         self._queued = Histogram("queued_ms", buckets=MS_BUCKETS,
                                  reservoir=recent)
         self._completions = deque(maxlen=recent)  # (t, tokens)
+        #: recent decode-step window feeding the goodput/padding
+        #: gauges: (t, tokens emitted, active rows, bucket rows)
+        self._steps = deque(maxlen=recent)
+        #: recent prefix lookups (True = hit) for the windowed rate
+        self._prefix_recent = deque(maxlen=64)
         # per-priority-class counters + TTFT windows, created on the
         # first request of each class (most deployments see one)
         self._classes = {}
@@ -665,6 +717,11 @@ class ServingMetrics:
         self._global["spec_accepted"].inc(accepted)
         self._global["spec_rollback"].inc(drafted - accepted)
 
+    #: minimum recent lookups before the windowed hit rate is
+    #: trusted — below it the gauge reads 1.0 (healthy) so the
+    #: prefix_hit_collapse alert never fires on idle/startup traffic
+    _PREFIX_MIN_LOOKUPS = 16
+
     def record_prefix_lookup(self, matched_blocks, block_size):
         """One admission's radix-cache lookup: a hit when >= 1
         leading block was resident."""
@@ -674,6 +731,13 @@ class ServingMetrics:
                 int(matched_blocks) * int(block_size))
         else:
             self._global["prefix_misses"].inc()
+        with self._lock:
+            self._prefix_recent.append(matched_blocks > 0)
+            window = list(self._prefix_recent)
+        rate = (sum(window) / len(window)
+                if len(window) >= self._PREFIX_MIN_LOOKUPS else 1.0)
+        self._global["prefix_rate"].labels(
+            replica=self.replica).set(round(rate, 4))
 
     def record_prefix_evict(self, blocks):
         self._global["prefix_evictions"].inc(int(blocks))
@@ -703,6 +767,9 @@ class ServingMetrics:
     def set_kv_blocks(self, used, free):
         self._global["kv_blocks_used"].set(int(used))
         self._global["kv_blocks_free"].set(int(free))
+        total = int(used) + int(free)
+        self._global["kv_pressure"].labels(replica=self.replica).set(
+            round(int(used) / total, 4) if total else 0.0)
 
     def set_kv_dtype(self, kv_dtype, bytes_per_token):
         """Advertise the KV pool layout (once, at cache build): the
@@ -720,12 +787,54 @@ class ServingMetrics:
         self._global["kv_bytes_per_token"].labels(
             replica=self.replica).set(int(bytes_per_token))
 
-    def record_step(self, active, slots):
+    def record_step(self, active, slots, tokens=None,
+                    duration_s=None):
+        """One batched decode/verify boundary: ``active`` real rows
+        rode a padded ``slots``-row bucket; ``tokens`` is what the
+        step actually emitted (spec verify can emit up to k+1 per
+        slot, a fully-rejected slot emits 0) and feeds the goodput
+        gauge; ``duration_s`` is accepted for symmetry with the
+        tracing hook (the goodput window uses wall-clock arrival
+        times, so a stalled loop DROPS the gauge instead of freezing
+        it at the last healthy rate)."""
+        now = time.monotonic()
         with self._lock:
             self.slot_busy_steps += int(active)
             self.slot_total_steps += int(slots)
+            if tokens is not None:
+                self._steps.append((now, int(tokens), int(active),
+                                    int(slots)))
+                window = list(self._steps)
+            else:
+                window = None
         self._global["busy_steps"].inc(int(active))
         self._global["total_steps"].inc(int(slots))
+        if not window:
+            return
+        pad = sum(s for _, _, _, s in window)
+        eff = sum(a for _, _, a, _ in window) / pad if pad else 0.0
+        self._global["pad_eff"].labels(replica=self.replica).set(
+            round(eff, 4))
+        span = window[-1][0] - window[0][0]
+        if len(window) >= 2 and span > 0:
+            tps = sum(t for _, t, _, _ in window) / span
+            self._global["goodput"].labels(
+                replica=self.replica).set(round(tps, 2))
+
+    def goodput_snapshot(self):
+        """(tokens_per_sec, padding_efficiency) over the recent step
+        window — the /serving/metrics + bench read."""
+        with self._lock:
+            window = list(self._steps)
+        if not window:
+            return None, None
+        pad = sum(s for _, _, _, s in window)
+        eff = round(sum(a for _, _, a, _ in window) / pad, 4) \
+            if pad else None
+        span = window[-1][0] - window[0][0]
+        tps = round(sum(t for _, t, _, _ in window) / span, 2) \
+            if len(window) >= 2 and span > 0 else None
+        return tps, eff
 
     def record_complete(self, req_tokens, duration_s, ttft_ms,
                         queued_ms, cls="normal", trace=None):
@@ -812,5 +921,8 @@ class ServingMetrics:
         out["queued_ms_p50"] = self._queued.percentile(0.50)
         tps = self.recent_tokens_per_sec()
         out["tokens_per_sec_recent"] = round(tps, 1) if tps else None
+        goodput, pad_eff = self.goodput_snapshot()
+        out["goodput_tokens_per_sec"] = goodput
+        out["bucket_padding_efficiency"] = pad_eff
         out["slo"] = self.slo.snapshot()
         return out

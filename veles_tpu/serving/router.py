@@ -115,7 +115,8 @@ class _Replica(object):
                  "status", "draining", "marked_draining",
                  "health_failures", "breaker", "failures",
                  "opened_at", "probing", "saturated_until",
-                 "last_health", "last_metrics", "requests", "role")
+                 "last_health", "last_metrics", "requests", "role",
+                 "last_scrape", "scrape_failed")
 
     def __init__(self, replica_id, host, port):
         self.id = str(replica_id)
@@ -135,6 +136,8 @@ class _Replica(object):
         self.saturated_until = 0.0  # 503 Retry-After backoff window
         self.last_health = None
         self.last_metrics = None
+        self.last_scrape = None   # latest /metrics exposition text
+        self.scrape_failed = False
         self.requests = 0
 
     def view(self):
@@ -142,12 +145,24 @@ class _Replica(object):
             "id": self.id, "host": self.host, "port": self.port,
             "healthy": self.healthy, "status": self.status,
             "role": self.role,
+            "tp": (self.last_health or {}).get("tp"),
             "draining": self.draining, "breaker": self.breaker,
             "outstanding": self.outstanding,
             "requests": self.requests,
             "consecutive_failures": self.failures,
             "queue_depth": (self.last_metrics or {}).get(
                 "queue_depth"),
+            "kv_blocks_used": (self.last_metrics or {}).get(
+                "kv_blocks_used"),
+            "kv_blocks_free": (self.last_metrics or {}).get(
+                "kv_blocks_free"),
+            # goodput accounting: real throughput + how much of each
+            # padded batch carried requests (PR 14 dashboard columns)
+            "goodput_tokens_per_sec": (self.last_metrics or {}).get(
+                "goodput_tokens_per_sec"),
+            "bucket_padding_efficiency": (
+                self.last_metrics or {}).get(
+                "bucket_padding_efficiency"),
             # the observable payoff of prefix/session affinity: a
             # well-aimed router keeps this high on repeat traffic
             "prefix_hit_rate": (self.last_metrics or {}).get(
@@ -240,6 +255,9 @@ class Router(Logger):
             _router_conf("shed_retry_after", 2)
             if shed_retry_after is None else shed_retry_after)
         self.stats = RouterMetrics()
+        #: the router-tier alert engine (telemetry/alerts.py),
+        #: created at start() when root.common.alerts.enabled
+        self.alerts = None
         #: request tracing (telemetry/reqtrace.py), read once — the
         #: per-attempt gate is an attribute test
         self._tron = reqtrace.enabled()
@@ -274,6 +292,13 @@ class Router(Logger):
         self._ready.set()
         # flight-recorder / debug surface (weakly held)
         reqtrace.register("router", self)
+        from veles_tpu.config import root
+        if root.common.alerts.get("enabled", True):
+            from veles_tpu.telemetry.alerts import AlertEngine
+            # no providers: GET /alerts is answered ON the router
+            # loop, and a provider marshalling back into that loop
+            # (replica_state) would deadlock the handler
+            self.alerts = AlertEngine(name="router").start()
         self.info("router on http://%s:%d -> %d replica(s)",
                   self.host, self.port, len(self._seed_replicas))
         return self
@@ -285,6 +310,8 @@ class Router(Logger):
         self._health_task = asyncio.ensure_future(self._health_loop())
 
     def stop(self):
+        if self.alerts is not None:
+            self.alerts.stop()
         with self._lock:
             loop, self._loop = self._loop, None
             thread, self._thread = self._thread, None
@@ -338,7 +365,12 @@ class Router(Logger):
         return self._call(self._remove(str(replica_id)))
 
     async def _remove(self, rid):
-        return self._replicas.pop(rid, None) is not None
+        gone = self._replicas.pop(rid, None) is not None
+        if gone:
+            # drop the labeled series so a deregistered replica's
+            # replica_up=0 cannot keep an unreachable alert firing
+            self.stats.forget_replica(rid)
+        return gone
 
     def replica_state(self):
         """Monitoring snapshot: per-replica view + router counters."""
@@ -1035,8 +1067,10 @@ class Router(Logger):
                               "rotation", rep.id)
                 rep.healthy = False
                 rep.status = "unreachable"
+                self.stats.record_replica_up(rep.id, False)
             return
         rep.health_failures = 0
+        self.stats.record_replica_up(rep.id, True)
         rep.last_health = info
         rep.role = str(info.get("role") or "both")
         rep.status = str(info.get("status", "unknown"))
@@ -1055,6 +1089,21 @@ class Router(Logger):
             raise
         except Exception:
             pass
+        # federation scrape piggybacks the same poll: the replica's
+        # Prometheus text rides into GET /metrics/fleet's merge
+        try:
+            status, _, sbody = await asyncio.wait_for(
+                self._http(rep, "GET", "/metrics", None),
+                self.health_timeout)
+            if status == 200:
+                rep.last_scrape = sbody.decode("utf-8", "replace")
+                rep.scrape_failed = False
+            else:
+                rep.scrape_failed = True
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            rep.scrape_failed = True
 
     # -- plumbing: async HTTP client + server ----------------------------
 
@@ -1255,6 +1304,46 @@ class Router(Logger):
             return (200, {"Content-Type":
                           "text/plain; version=0.0.4; charset=utf-8"},
                     registry.render_prometheus().encode())
+        if method == "GET" and path == "/metrics/fleet":
+            # federated scrape: every replica's last-polled /metrics
+            # text merged (counters/histograms summed, gauges
+            # re-labeled per replica) + the veles_fleet_* rollups
+            from veles_tpu.telemetry import federation
+            scrapes, errors = [], []
+            for rep in self._replicas.values():
+                if rep.last_scrape and not rep.scrape_failed:
+                    scrapes.append((rep.id, federation
+                                    .parse_prometheus(
+                                        rep.last_scrape)))
+                else:
+                    errors.append(rep.id)
+            families = federation.fleet_families(scrapes,
+                                                 errors=errors)
+            return (200, {"Content-Type":
+                          "text/plain; version=0.0.4; charset=utf-8"},
+                    federation.render_families_text(families)
+                    .encode())
+        if method == "GET" and path == "/alerts":
+            snap = self.alerts.snapshot() if self.alerts is not None \
+                else {"enabled": False}
+            return (200, {"Content-Type": "application/json"},
+                    json.dumps(snap, default=str).encode())
+        if method == "GET" and path == "/dashboard":
+            from veles_tpu.telemetry.dashboard import \
+                render_dashboard_html
+            state = await self._state()
+            page = render_dashboard_html(
+                "veles fleet — %s:%d" % (self.host, self.port),
+                replicas=state["replicas"],
+                slo=state["router"].get("slo"),
+                alerts=self.alerts.snapshot()
+                if self.alerts is not None else None,
+                inflight=self._inflight_rows(),
+                note="%d replica(s), %d eligible" % (
+                    len(self._replicas), state["eligible"]))
+            return (200,
+                    {"Content-Type": "text/html; charset=utf-8"},
+                    page.encode())
         return self._error(404, "no route %s %s" % (method, path))
 
     async def _serve_conn(self, reader, writer):

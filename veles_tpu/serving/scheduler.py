@@ -1968,7 +1968,8 @@ class InferenceScheduler(Logger):
             self.forwards, cache, toks, pos, tables, temps, topks,
             seeds, counts))
         dt = time.perf_counter() - t0
-        self.stats.record_step(n, b)
+        # plain decode: every active slot emits exactly one token
+        self.stats.record_step(n, b, tokens=n, duration_s=dt)
         for j, slot in enumerate(slots):
             req = active[slot]
             self._emit(req, int(nxt[j]))
@@ -2029,7 +2030,6 @@ class InferenceScheduler(Logger):
             self.forwards, cache, toks, pos, lens, tables, temps,
             topks, seeds, counts))
         dt = time.perf_counter() - t0
-        self.stats.record_step(n, b)
         emitted = {}
         for j, slot in enumerate(slots):
             req = active[slot]
@@ -2047,6 +2047,10 @@ class InferenceScheduler(Logger):
             emitted[req.trace] = emitted.get(req.trace, 0) \
                 + len(req.generated) - before
             self._maybe_finish(req, cache)
+        # recorded AFTER acceptance so goodput counts what the verify
+        # actually emitted (a fully-rejected batch is 0 good tokens)
+        self.stats.record_step(n, b, tokens=sum(emitted.values()),
+                               duration_s=dt)
         if self._tron:
             reqtrace.record_step(emitted, duration=dt, mode="verify",
                                  slots=n, bucket=b, k=k)
@@ -2068,7 +2072,8 @@ class InferenceScheduler(Logger):
             self.forwards, cache, toks, pos, temps, topks, seeds,
             counts))
         dt = time.perf_counter() - t0
-        self.stats.record_step(len(active), s)
+        self.stats.record_step(len(active), s, tokens=len(active),
+                               duration_s=dt)
         for slot, req in active.items():
             self._emit(req, int(nxt[slot]))
             self._maybe_finish(req, cache)

@@ -13,15 +13,19 @@ tracking, and two export surfaces:
 See ``docs/observability.md`` for the metric names and span schema.
 """
 
+from veles_tpu.telemetry.alerts import (  # noqa: F401
+    AlertEngine, AlertRule, default_rules, firing_table)
 from veles_tpu.telemetry.compile_tracker import (  # noqa: F401
     compile_summary, cost_summary, maybe_profiler_trace, track_jit)
+from veles_tpu.telemetry.federation import (  # noqa: F401
+    fleet_families, merge_scrapes, parse_prometheus)
 from veles_tpu.telemetry.flight_recorder import (  # noqa: F401
     FlightRecorder, recorder)
 from veles_tpu.telemetry.health import (  # noqa: F401
     HealthMonitor, health_config, monitor)
 from veles_tpu.telemetry.registry import (  # noqa: F401
     Counter, DEFAULT_BUCKETS, Gauge, Histogram, MS_BUCKETS,
-    MetricsRegistry, metrics, nearest_rank)
+    MetricsRegistry, metrics, nearest_rank, render_families_text)
 from veles_tpu.telemetry.reqtrace import (  # noqa: F401
     TRACE_HEADER, clean_trace_id, ensure_trace_id, new_trace_id)
 from veles_tpu.telemetry.spans import (  # noqa: F401

@@ -221,6 +221,13 @@ class FlightRecorder:
         except Exception:
             pass
         try:
+            # firing alerts from every live engine: the bundle says
+            # what was ALREADY wrong before the crash/hang
+            from veles_tpu.telemetry import alerts
+            info["alerts"] = alerts.firing_table()
+        except Exception:
+            pass
+        try:
             from veles_tpu.logger import events
             info["events"] = list(events.ring)[-self.max_events:]
         except Exception:

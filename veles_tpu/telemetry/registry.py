@@ -93,6 +93,12 @@ class Counter:
         with self._lock:
             return self._value
 
+    def samples(self):
+        """Structured samples: (suffix, extra labels, value) — the
+        in-process read federation/dashboard/alerts consume without
+        round-tripping through the text format."""
+        return [("", {}, self.value)]
+
     def expose(self, labels=""):
         yield "%s%s %s" % (self.name, labels,
                            _format_value(self.value))
@@ -136,6 +142,9 @@ class Gauge:
                 return float("nan")
         with self._lock:
             return self._value
+
+    def samples(self):
+        return [("", {}, self.value)]
 
     def expose(self, labels=""):
         yield "%s%s %s" % (self.name, labels,
@@ -233,6 +242,22 @@ class Histogram:
             "p99": nearest_rank(window, 0.99),
         }
 
+    def samples(self):
+        """Structured exposition samples, cumulative buckets included
+        (``le`` rides as an extra label, mirroring the text form)."""
+        with self._lock:
+            counts = list(self._bucket_counts)
+            count, total = self._count, self._sum
+        out = []
+        acc = 0
+        for b, c in zip(self.buckets, counts):
+            acc += c
+            out.append(("_bucket", {"le": _format_value(b)}, acc))
+        out.append(("_bucket", {"le": "+Inf"}, acc + counts[-1]))
+        out.append(("_sum", {}, total))
+        out.append(("_count", {}, count))
+        return out
+
     def expose(self, labels=""):
         with self._lock:
             counts = list(self._bucket_counts)
@@ -287,6 +312,23 @@ class _Family:
     def children(self):
         with self._lock:
             return dict(self._children)
+
+    def remove(self, *labelvalues):
+        """Drop one child series (e.g. a deregistered replica's
+        labeled gauge) so stale labels stop exporting forever."""
+        labelvalues = tuple(str(v) for v in labelvalues)
+        with self._lock:
+            self._children.pop(labelvalues, None)
+
+    def samples(self):
+        out = []
+        for labelvalues, child in sorted(self.children().items()):
+            base = dict(zip(self.labelnames, labelvalues))
+            for suffix, extra, value in child.samples():
+                labels = dict(base)
+                labels.update(extra)
+                out.append((suffix, labels, value))
+        return out
 
     def expose(self):
         for labelvalues, child in sorted(self.children().items()):
@@ -346,20 +388,22 @@ class MetricsRegistry:
         with self._lock:
             return sorted(self._metrics.items())
 
-    def render_prometheus(self):
-        """The registry as Prometheus text exposition format v0.0.4."""
-        lines = []
+    def collect_families(self):
+        """Structured exposition: one dict per family —
+        ``{name, type, help, samples: [(suffix, labels, value)]}`` —
+        the in-process read the fleet federation merger, the alert
+        engine and the dashboards consume directly, instead of
+        rendering to the text format and parsing it back."""
+        out = []
         for name, m in self.collect():
-            if m.help:
-                lines.append("# HELP %s %s" % (
-                    name, m.help.replace("\\", "\\\\")
-                    .replace("\n", "\\n")))
-            lines.append("# TYPE %s %s" % (name, m.TYPE))
-            if isinstance(m, _Family):
-                lines.extend(m.expose())
-            else:
-                lines.extend(m.expose(""))
-        return "\n".join(lines) + "\n"
+            out.append({"name": name, "type": m.TYPE,
+                        "help": m.help, "samples": m.samples()})
+        return out
+
+    def render_prometheus(self):
+        """The registry as Prometheus text exposition format v0.0.4
+        (the one text renderer, over :meth:`collect_families`)."""
+        return render_families_text(self.collect_families())
 
     def snapshot(self):
         """Plain nested dict of every series (histograms as their
@@ -379,6 +423,31 @@ class MetricsRegistry:
             else:
                 out[name] = m.value
         return out
+
+
+def render_families_text(families):
+    """Render structured families (the :meth:`MetricsRegistry.
+    collect_families` / federation-merge shape) as Prometheus text
+    exposition v0.0.4 — the single text renderer behind every
+    ``GET /metrics`` surface and the router's ``/metrics/fleet``."""
+    lines = []
+    for fam in families:
+        name = fam["name"]
+        if fam.get("help"):
+            lines.append("# HELP %s %s" % (
+                name, fam["help"].replace("\\", "\\\\")
+                .replace("\n", "\\n")))
+        lines.append("# TYPE %s %s" % (name, fam["type"]))
+        for suffix, labels, value in fam["samples"]:
+            label_str = _label_str(tuple(labels), tuple(
+                labels.values())) if labels else ""
+            if suffix in ("_bucket", "_count"):
+                lines.append("%s%s%s %d" % (name, suffix, label_str,
+                                            value))
+            else:
+                lines.append("%s%s%s %s" % (name, suffix, label_str,
+                                            _format_value(value)))
+    return "\n".join(lines) + "\n"
 
 
 #: the process-wide registry (the ``GET /metrics`` surface)

@@ -40,7 +40,10 @@ _PAGE = """<!DOCTYPE html>
  th { background: #eee; }
  .dead { color: #999; }
 </style></head>
-<body><h2>veles_tpu runs</h2>%TABLE%</body></html>
+<body><h2>veles_tpu runs</h2>
+<p><a href="/dashboard">dashboard</a> <a href="/alerts">alerts</a>
+ <a href="/metrics">metrics</a> <a href="/debug/state">debug</a></p>
+%TABLE%</body></html>
 """
 
 
@@ -262,11 +265,49 @@ class WebStatusServer(Logger):
 
         class Metrics(tornado.web.RequestHandler):
             def get(self):
+                # the structured-collect path: one text renderer
+                # (render_families_text) behind every /metrics tier
                 from veles_tpu.telemetry import metrics as registry
+                from veles_tpu.telemetry.registry import \
+                    render_families_text
                 self.set_header(
                     "Content-Type",
                     "text/plain; version=0.0.4; charset=utf-8")
-                self.write(registry.render_prometheus())
+                self.write(render_families_text(
+                    registry.collect_families()))
+
+        class Alerts(tornado.web.RequestHandler):
+            def get(self):
+                # every live engine in this process (replica tiers,
+                # an in-process router, standalone engines)
+                from veles_tpu.telemetry import alerts
+                self.write(json.dumps(
+                    {"engines": [e.snapshot()
+                                 for e in alerts.live_engines()],
+                     "firing": alerts.firing_table()},
+                    default=str))
+                self.set_header("Content-Type", "application/json")
+
+        class Dashboard(tornado.web.RequestHandler):
+            def get(self):
+                from veles_tpu.telemetry import alerts, reqtrace
+                from veles_tpu.telemetry.dashboard import \
+                    render_dashboard_html
+                engines = alerts.live_engines()
+                merged = {"firing": alerts.firing_table(),
+                          "pending": [row for e in engines
+                                      for row in e.snapshot()
+                                      .get("pending", ())]}
+                self.set_header("Content-Type",
+                                "text/html; charset=utf-8")
+                self.write(render_dashboard_html(
+                    "veles_tpu process dashboard",
+                    replicas=(), slo=None, alerts=merged,
+                    inflight=reqtrace.inflight_table(),
+                    note="process-local view: alerts + in-flight "
+                         "requests of every engine/scheduler in "
+                         "this process (the fleet table lives on "
+                         "the router's /dashboard)"))
 
         class Healthz(tornado.web.RequestHandler):
             def get(self):
@@ -316,6 +357,7 @@ class WebStatusServer(Logger):
         self.app = tornado.web.Application([
             (r"/update", Update), (r"/", Page), (r"/api/runs", Api),
             (r"/metrics", Metrics), (r"/healthz", Healthz),
+            (r"/alerts", Alerts), (r"/dashboard", Dashboard),
             (r"/debug/state", DebugState),
             (r"/graph/(.+)", Graph), (r"/events/(.+)", Events)])
         self._loop = None
