@@ -1833,6 +1833,248 @@ def bench_alerts(dev):
         sch.close()
 
 
+def bench_failover(dev):
+    """No-request-left-behind numbers (PR 15):
+
+    - ``failover_stream_resume_ms`` — the client-visible
+      kill-to-next-token gap: p50/p95 of the time between the last
+      token frame a dying pinned replica delivered and the first
+      frame of the resumed leg spliced in from the peer
+      (``router.stream.replica_death`` armed per stream);
+    - ``failover_zero_failure_soak`` — bool: the mini phase-matrix
+      (handler death, mid-prefill death, export-pending fetch loss,
+      mid-import death, mid-stream death) under a disagg-capable
+      both/prefill/decode fleet completed with ZERO client-visible
+      failures and every greedy reply identical to the fault-free
+      reference;
+    - ``fleet_rebalance_mttr_s`` — kill the only decode specialist
+      with its respawns pinned failing: wall time from the kill to
+      the first client request served again (the monitor's active
+      re-role restoring decode coverage).
+    """
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from veles_tpu import faults
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.loader.interactive import InteractiveLoader  # noqa: F401
+    from veles_tpu.memory import Array
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.restful_api import RESTfulAPI, RestfulLoader
+    from veles_tpu.serving import Fleet, LocalReplica, Router
+
+    cpu = dev.jax_device.platform == "cpu"
+    if cpu:
+        d_model, layers, heads, vocab, window = 64, 2, 2, 256, 128
+        steps, prompt_len, streams = 8, 12, 8
+    else:
+        d_model, layers, heads, vocab, window = 1024, 8, 8, 32768, \
+            1024
+        steps, prompt_len, streams = 64, 128, 16
+    prompt = numpy.random.default_rng(0).integers(
+        0, vocab, (prompt_len,)).tolist()
+    made = [0]
+
+    def spawn_replica(role=None):
+        made[0] += 1
+        from veles_tpu import prng
+        prng.get("default").seed(1234)   # one model, many replicas
+        wf = AcceleratedWorkflow(
+            None, name="bench-failover-%d" % made[0])
+        spec = [{"type": "embedding", "vocab": vocab,
+                 "dim": d_model}]
+        spec += [{"type": "transformer_block", "heads": heads,
+                  "causal": True} for _ in range(layers)]
+        spec += [{"type": "token_logits", "vocab": vocab}]
+        fw = make_forwards(
+            wf, Array(numpy.zeros((1, window), numpy.int32)), spec)
+        for u in fw:
+            u.initialize(device=dev)
+        loader = RestfulLoader(wf, sample_shape=(window,),
+                               minibatch_size=1, max_wait=10.0)
+        loader.initialize(device=dev)
+        api = RESTfulAPI(wf, loader=loader, forwards=fw,
+                         name="bench-failover-api-%d" % made[0],
+                         max_slots=2, max_queue=64,
+                         request_timeout=600.0,
+                         serving_warm_buckets=False,
+                         serving_block_size=4,
+                         serving_prefill_chunk=4,
+                         serving_role=role)
+        api.output = fw[-1].output
+        api.initialize()
+        return LocalReplica(api, loader)
+
+    def post(url, payload, timeout=600):
+        req = urllib.request.Request(
+            url + "/generate", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        return json.load(urllib.request.urlopen(req,
+                                                timeout=timeout))
+
+    def stream_frame_times(url, payload):
+        """Token-frame arrival timestamps of one SSE stream."""
+        req = urllib.request.Request(
+            url + "/generate",
+            data=json.dumps(dict(payload, stream=True)).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = urllib.request.urlopen(req, timeout=600)
+        times, data = [], None
+        try:
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                line = line.rstrip(b"\r\n")
+                if line.startswith(b"data: "):
+                    data = line[6:]
+                    continue
+                if line or data is None:
+                    continue
+                frame, data = data, None
+                if frame == b"[DONE]":
+                    break
+                if b'"token"' in frame:
+                    times.append(time.perf_counter())
+        finally:
+            resp.close()
+        return times
+
+    # -- stream resume latency over a 2-replica fleet -------------------
+    reps = [spawn_replica() for _ in range(2)]
+    router = Router(health_interval=0.2, health_timeout=5.0,
+                    request_timeout=600.0, retries=4,
+                    retry_delay=0.02, retry_cap=0.2).start()
+    gaps = []
+    try:
+        for i, rep in enumerate(reps):
+            router.add_replica(rep.host, rep.port,
+                               replica_id="bf%d" % i)
+        post(router.url, {"prompt": prompt, "steps": steps})  # warm
+        for k in range(streams):
+            faults.inject("router.stream.replica_death", "drop",
+                          after=2, times=1)
+            times = stream_frame_times(
+                router.url, {"prompt": prompt, "steps": steps,
+                             "seed": k})
+            faults.clear("router.stream.replica_death")
+            if len(times) >= 3:
+                # frame 2 is the last pre-death frame, frame 3 the
+                # first spliced one — their gap is what the client
+                # actually waits through a replica death
+                gaps.append((times[2] - times[1]) * 1e3)
+    finally:
+        faults.clear()
+        router.stop()
+        for rep in reps:
+            rep.stop()
+    gaps.sort()
+    resume_ms = {
+        "p50": round(gaps[len(gaps) // 2], 2) if gaps else None,
+        "p95": round(gaps[int(0.95 * (len(gaps) - 1))], 2)
+        if gaps else None,
+        "streams": len(gaps),
+    }
+
+    # -- the mini phase-matrix soak (zero client failures) --------------
+    both = spawn_replica()
+    pre = spawn_replica("prefill")
+    dec = spawn_replica("decode")
+    router = Router(health_interval=0.1, health_timeout=5.0,
+                    request_timeout=600.0, retries=4,
+                    retry_delay=0.02, retry_cap=0.2).start()
+    soak_ok = True
+    try:
+        router.add_replica(both.host, both.port, replica_id="both")
+        router.add_replica(pre.host, pre.port, replica_id="pre")
+        router.add_replica(dec.host, dec.port, replica_id="dec")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            state = {r["id"]: r for r in
+                     router.replica_state()["replicas"]}
+            if state.get("pre", {}).get("role") == "prefill" \
+                    and state.get("dec", {}).get("healthy"):
+                break
+            time.sleep(0.05)
+        body = {"prompt": prompt, "steps": steps, "seed": 0}
+        want = post(router.url, body)["tokens"]
+        for point, action in (
+                ("restful.generate", "http_error"),
+                ("serving.scheduler.prefill", "exception"),
+                ("disagg.export.fetch", "drop"),
+                ("serving.scheduler.kv_import", "exception"),
+                ("router.stream.replica_death", "drop")):
+            faults.inject(point, action,
+                          arg=500 if action == "http_error"
+                          else None, times=1)
+            try:
+                if point == "router.stream.replica_death":
+                    n = len(stream_frame_times(router.url, body))
+                    soak_ok = soak_ok and n == steps
+                else:
+                    got = post(router.url, body)["tokens"]
+                    soak_ok = soak_ok and got == want
+            except Exception:
+                soak_ok = False
+            faults.clear(point)
+        for handle in (both, pre, dec):
+            handle.api.scheduler_.check_kv()
+    except Exception:
+        soak_ok = False
+    finally:
+        faults.clear()
+        router.stop()
+        for handle in (both, pre, dec):
+            handle.stop()
+
+    # -- rebalance MTTR -------------------------------------------------
+    router = Router(health_interval=0.1, health_timeout=5.0,
+                    request_timeout=600.0, retries=4,
+                    retry_delay=0.02, retry_cap=0.2).start()
+    fleet = Fleet(lambda i, role: spawn_replica(role), 3,
+                  router=router, monitor_interval=0.1,
+                  spawn_retries=1, spawn_delay=0.01,
+                  roles=("prefill", "prefill", "decode")).start()
+    mttr = None
+    try:
+        body = {"prompt": prompt, "steps": steps, "seed": 0}
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                post(router.url, body, timeout=60)
+                break
+            except Exception:
+                time.sleep(0.1)
+        faults.inject("fleet.replica.spawn", "exception", key="2")
+        t_kill = time.monotonic()
+        fleet.handles()[2].stop()
+        give_up = time.monotonic() + 120
+        while time.monotonic() < give_up:
+            try:
+                post(router.url, body, timeout=60)
+                mttr = round(time.monotonic() - t_kill, 3)
+                break
+            except urllib.error.HTTPError:
+                time.sleep(0.05)
+            except Exception:
+                time.sleep(0.05)
+    finally:
+        faults.clear()
+        fleet.stop()
+        router.stop()
+
+    return {
+        "failover_stream_resume_ms": resume_ms,
+        "failover_zero_failure_soak": bool(soak_ok),
+        "fleet_rebalance_mttr_s": mttr,
+        "failover_config": {
+            "d_model": d_model, "layers": layers, "heads": heads,
+            "vocab": vocab, "window": window, "steps": steps,
+            "prompt": prompt_len, "streams": streams},
+    }
+
+
 def bench_input_pipeline(dev, steps=40, depth=2):
     """Asynchronous input pipeline (loader/prefetch.py): a synthetic
     SLOW streaming loader — ``fill_minibatch`` sleeps ``decode_ms``
@@ -2252,6 +2494,15 @@ def main_alerts():
         "carried")
 
 
+def main_failover():
+    """``python bench.py failover`` — mid-stream failover latency,
+    the zero-failure phase-matrix soak and rebalance MTTR alone."""
+    return _main_standalone(
+        bench_failover, "failover_bench_source",
+        "PR15 standalone failover/rebalance bench run; other "
+        "entries carried")
+
+
 if __name__ == "__main__":
     sys.exit(main_router() if "router" in sys.argv[1:]
              else main_spec() if "spec" in sys.argv[1:]
@@ -2259,4 +2510,5 @@ if __name__ == "__main__":
              else main_kv_quant() if "kv_quant" in sys.argv[1:]
              else main_tp() if "tp" in sys.argv[1:]
              else main_alerts() if "alerts" in sys.argv[1:]
+             else main_failover() if "failover" in sys.argv[1:]
              else main())

@@ -421,6 +421,62 @@ b = metrics.counter("veles_x_total", "x",
     assert "M502" not in codes_of(scan(tmp_path, {"m.py": ok}))
 
 
+# -- F-series ----------------------------------------------------------------
+
+def test_f601_undocumented_fire_point(tmp_path):
+    """A literal fire point missing from the docs/robustness.md
+    fault-point table fires F601 (both the direct call and the
+    run_in_executor indirection); documented points are quiet."""
+    src = """\
+import asyncio
+from veles_tpu import faults
+
+def tick(loop):
+    faults.fire("serving.widget.step", key="w0")
+    loop.run_in_executor(None, faults.fire,
+                         "router.widget.health", "r1")
+    faults.fire("documented.point")
+"""
+    doc = "| `documented.point` | somewhere |\n"
+    f = [x for x in scan(tmp_path, {"m.py": src,
+                                    "docs/robustness.md": doc})
+         if x.code == "F601"]
+    assert {x.detail for x in f} == {"serving.widget.step",
+                                     "router.widget.health"}
+    # a fully documented tree is quiet
+    doc_all = doc + "| `serving.widget.step` | x |\n" \
+        "| `router.widget.health` | y |\n"
+    assert "F601" not in codes_of(scan(
+        tmp_path, {"m.py": src, "docs/robustness.md": doc_all}))
+
+
+def test_f602_dynamic_fire_point(tmp_path):
+    """A computed point name (f-string, %-format, variable) fires
+    F602 — the dynamic part belongs in key=, the point must stay a
+    greppable fnmatch-stable literal."""
+    bad = """\
+from veles_tpu import faults
+
+def hit(rid):
+    faults.fire(f"router.forward.{rid}")
+    faults.fire("router.%s" % rid)
+    name = "router.forward"
+    faults.fire(name)
+"""
+    f = [x for x in scan(tmp_path, {"m.py": bad})
+         if x.code == "F602"]
+    assert len(f) == 3
+    ok = """\
+from veles_tpu import faults
+
+def hit(rid):
+    faults.fire("router.forward", key=rid)
+"""
+    doc = "`router.forward`\n"
+    assert "F602" not in codes_of(scan(
+        tmp_path, {"m.py": ok, "docs/robustness.md": doc}))
+
+
 # -- baseline ----------------------------------------------------------------
 
 def test_baseline_suppresses_and_goes_stale(tmp_path):
@@ -471,7 +527,7 @@ def test_package_scans_clean_under_strict_and_fast():
 def test_every_code_has_a_registered_pass():
     assert {"D101", "D102", "D103", "T201", "T202", "T203", "T204",
             "L301", "L302", "C401", "C402",
-            "M501", "M502"} == set(ALL_CODES)
+            "M501", "M502", "F601", "F602"} == set(ALL_CODES)
 
 
 def test_cli_json_smoke_and_no_jax_import():

@@ -5,13 +5,15 @@ docs and ``--list-codes`` pick it up from there."""
 
 from veles_tpu.analysis.passes.config_keys import ConfigKeysPass
 from veles_tpu.analysis.passes.donation import DonationPass
+from veles_tpu.analysis.passes.fault_points import FaultPointsPass
 from veles_tpu.analysis.passes.locks import LocksPass
 from veles_tpu.analysis.passes.metrics_hygiene import \
     MetricsHygienePass
 from veles_tpu.analysis.passes.purity import PurityPass
 
 ALL_PASSES = (DonationPass(), PurityPass(), LocksPass(),
-              ConfigKeysPass(), MetricsHygienePass())
+              ConfigKeysPass(), MetricsHygienePass(),
+              FaultPointsPass())
 
 ALL_CODES = {}
 for _p in ALL_PASSES:

@@ -330,6 +330,14 @@ root.common.update({
         "prefix_cache": True,
         "prefix_evict": True,
     },
+    # replica supervision (serving/fleet.py): rebalance lets a
+    # disaggregated fleet re-role replicas when a whole role pool
+    # loses its last live member — a respawn fills the empty pool
+    # instead of its own (when its own keeps a member), and the
+    # monitor restarts a surplus replica into a pool no respawn is
+    # filling.  Off, a dead pool stays dead until a human re-roles
+    # the fleet (the pre-rebalance behavior).
+    "fleet": {"rebalance": True},
     # fault injection (veles_tpu/faults/): spec string parsed on first
     # fire(), same grammar as the VELES_FAULTS env var —
     # "point=action[:arg][@after][xtimes][~key];..." (empty = unarmed)

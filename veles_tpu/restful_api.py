@@ -223,7 +223,8 @@ class RESTfulAPI(Unit):
                             stop_token=stop_token)
 
     def _generate_scheduled(self, rows, steps, temperature, top_k,
-                            seed, stop, priority=None, trace=None):
+                            seed, stop, priority=None, trace=None,
+                            resume_tokens=None):
         """Decode a /generate body through the continuous-batching
         scheduler: every prompt row is its own request (ragged batches
         interleave in the slots like independent clients).  Returns
@@ -243,7 +244,8 @@ class RESTfulAPI(Unit):
                     row, steps, temperature=temperature, top_k=top_k,
                     seed=None if seed is None else int(seed) + i,
                     stop_token=stop, timeout=self.request_timeout,
-                    priority=priority, trace=trace))
+                    priority=priority, trace=trace,
+                    resume_tokens=resume_tokens))
             # the scheduler enforces the deadline itself (408 with
             # partial-token count); the result wait is only a backstop
             # against a wedged loop with the watchdog disabled
@@ -380,9 +382,21 @@ class RESTfulAPI(Unit):
                         self.send_error(404, "no serving scheduler")
                         return
                     from veles_tpu.serving.disagg import encode_export
-                    rec = api.scheduler_.kv_export(
-                        route.rsplit("/", 1)[1])
+                    handle = route.rsplit("/", 1)[1]
+                    rec = api.scheduler_.kv_export(handle)
                     if rec is None:
+                        if api.scheduler_.kv_export_status(handle) \
+                                == "fetched":
+                            # a double-fetch RACE (two routers, a
+                            # retry crossing the original) answers a
+                            # structured 409, not a crash or a
+                            # misleading 404: the record was served
+                            # exactly once and the loser must re-run
+                            # prefill, not retry the fetch
+                            self._reply_error(
+                                409, "kv export handle already "
+                                "fetched (one-shot)")
+                            return
                         self.send_error(
                             404, "unknown or expired kv export "
                             "handle")
@@ -608,13 +622,21 @@ class RESTfulAPI(Unit):
                         else type(err).__name__)
 
             def _stream_generate(self, row, steps, temperature,
-                                 top_k, seed, stop, priority):
+                                 top_k, seed, stop, priority,
+                                 resume=None):
                 """SSE for POST /generate {"stream": true}: one
                 ``{"token": t}`` frame per accepted token (spec
                 bursts arrive back to back), a terminal frame with
                 the FULL token list (concatenation check: identical
-                to the batch reply) + usage, then [DONE]."""
+                to the batch reply) + usage, then [DONE].  With
+                ``resume`` (the failover lane) only the NEWLY drawn
+                tokens stream — the terminal frame still carries the
+                complete prompt + resumed + new list, so a router
+                splicing the continuation into an interrupted stream
+                delivers a terminal frame byte-identical to the
+                uninterrupted run's."""
                 from veles_tpu.serving.scheduler import SchedulerError
+                resume = resume or []
                 try:
                     ts = api.scheduler_.submit(
                         row, steps, temperature=temperature,
@@ -623,7 +645,8 @@ class RESTfulAPI(Unit):
                         stop_token=stop,
                         timeout=api.request_timeout,
                         priority=priority, stream=True,
-                        trace=self._trace())
+                        trace=self._trace(),
+                        resume_tokens=resume)
                 except ValueError as e:
                     self.send_error(400, _status_text(e))
                     return
@@ -641,14 +664,15 @@ class RESTfulAPI(Unit):
                             "message": _status_text(err),
                             "trace_id": ts.trace,
                             "tokens_generated": len(ts.tokens)}}
+                    done = resume + ts.tokens
                     return {"done": True,
-                            "tokens": ts.prompt + ts.tokens,
+                            "tokens": ts.prompt + done,
                             "trace_id": ts.trace,
                             "usage": {
                                 "prompt_tokens": len(ts.prompt),
-                                "completion_tokens": len(ts.tokens),
+                                "completion_tokens": len(done),
                                 "total_tokens": len(ts.prompt)
-                                + len(ts.tokens)}}
+                                + len(done)}}
 
                 self._relay_sse(ts, lambda t: {"token": t}, final)
 
@@ -1107,6 +1131,43 @@ class RESTfulAPI(Unit):
                             except ValueError as e:
                                 self.send_error(400, _status_text(e))
                                 return
+                        resume = body.get("resume_tokens")
+                        if resume is not None:
+                            # the mid-stream-failover resume lane: a
+                            # router re-submits an interrupted
+                            # request with the tokens it already
+                            # forwarded; the scheduler re-prefills
+                            # prompt + prefix and continues at draw
+                            # counter len(resume).  Loopback/admin
+                            # only — an open resume lane would let
+                            # any client bill continuations against
+                            # arbitrary fabricated prefixes
+                            if not self._admin_ok():
+                                self.send_error(
+                                    403, "resume_tokens is the "
+                                    "loopback/admin failover lane")
+                                return
+                            try:
+                                resume = [int(t) for t in resume]
+                            except (TypeError, ValueError):
+                                self.send_error(
+                                    400, "resume_tokens must be a "
+                                    "flat list of token ids")
+                                return
+                            rerr = api._validate_rows([resume]) \
+                                if resume else None
+                            if rerr:
+                                self.send_error(400, rerr)
+                                return
+                            if beam or len(rows) != 1 \
+                                    or api.scheduler_ is None \
+                                    or steps < 1:
+                                self.send_error(
+                                    400, "resume_tokens needs the "
+                                    "serving scheduler, a single "
+                                    "prompt row, steps >= 1 and no "
+                                    "beam")
+                                return
                         if body.get("stream"):
                             # SSE token streaming rides the serving
                             # scheduler only (the legacy lockstep
@@ -1129,7 +1190,8 @@ class RESTfulAPI(Unit):
                                 return
                             self._stream_generate(
                                 rows[0], steps, temperature, top_k,
-                                body.get("seed"), stop, priority)
+                                body.get("seed"), stop, priority,
+                                resume=resume)
                             return
                         if beam:
                             if temperature or top_k:
@@ -1176,7 +1238,8 @@ class RESTfulAPI(Unit):
                                     rows, steps, temperature, top_k,
                                     body.get("seed"), stop,
                                     priority=priority,
-                                    trace=self._trace())
+                                    trace=self._trace(),
+                                    resume_tokens=resume)
                             except ValueError as e:
                                 self.send_error(400, _status_text(e))
                                 return

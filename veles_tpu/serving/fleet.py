@@ -21,6 +21,26 @@ Spawn attempts pass through the ``fleet.replica.spawn`` fault point
 (keyed by replica index) — an armed ``exception`` makes respawn fail
 and exercises the capped-backoff retry; ``hang`` delays recovery.
 
+**Role rebalancing** (disaggregated fleets, policy knob
+``root.common.fleet.rebalance``, default on): a fleet of
+specialists must never lose a whole ROLE pool to one death.  Two
+mechanisms cooperate, both counted in
+``veles_fleet_rebalances_total{role}``:
+
+- every (re)spawn decides its role through :meth:`Fleet._assign_role`
+  — the index's own pool membership by default, but when another
+  desired role's pool has ZERO live members (and the index's own
+  pool keeps one), the respawn fills the empty pool instead (fault
+  point ``fleet.role.assign``, keyed by index; ``drop`` pins the
+  original role);
+- the monitor runs :meth:`Fleet.rebalance` each tick: when a
+  desired pool stays empty and no respawn is filling it (the dead
+  index's spawns keep failing), the youngest replica of a pool with
+  >= 2 live members is restarted INTO the empty role (fault point
+  ``fleet.role.rebalance``; ``drop`` skips the pass).  Rebalancing
+  restores role COVERAGE, not proportions — a 2:1 fleet that ends
+  1:2 after an episode is alive, which is the contract.
+
 Rolling restart (:meth:`Fleet.rolling_restart`), one replica at a
 time, zero failed client requests end to end:
 
@@ -108,6 +128,16 @@ class SubprocessReplica(object):
             self.proc.wait(10)
 
 
+def _rebalance_metric():
+    from veles_tpu.telemetry import metrics
+    return metrics.counter(
+        "veles_fleet_rebalances_total",
+        "replica role re-assignments (a respawn filling an empty "
+        "role pool, or the monitor restarting a surplus replica "
+        "into one), by the role assigned TO",
+        labelnames=("role",))
+
+
 def free_port(host="127.0.0.1"):
     """Ask the OS for an ephemeral port (subprocess replicas need the
     port chosen BEFORE exec)."""
@@ -126,7 +156,7 @@ class Fleet(Logger):
 
     def __init__(self, spawn, n, router=None, monitor_interval=0.25,
                  spawn_retries=5, spawn_delay=0.2, spawn_cap=5.0,
-                 roles=None):
+                 roles=None, rebalance=None):
         super(Fleet, self).__init__()
         self.spawn = spawn
         self.n = int(n)
@@ -144,6 +174,12 @@ class Fleet(Logger):
                 raise ValueError(
                     "roles must be prefill/decode/both, got %s"
                     % bad)
+        if rebalance is None:
+            from veles_tpu.config import root
+            rebalance = root.common.fleet.get("rebalance", True)
+        #: role-rebalancing policy (module docstring): off, a dead
+        #: pool stays dead until a human re-roles the fleet
+        self.rebalance_enabled = bool(rebalance) and bool(self.roles)
         self.router = router
         self.monitor_interval = float(monitor_interval)
         self.spawn_retries = int(spawn_retries)
@@ -152,6 +188,7 @@ class Fleet(Logger):
         self._replicas = {}     # index -> handle (None: spawn owed)
         self._ids = {}          # index -> router replica id
         self._generation = {}   # index -> spawn count
+        self._role_of = {}      # index -> CURRENT role (rebalanced)
         self._busy = set()      # indices mid-rolling-restart
         self._lock = threading.Lock()
         self._stopping = threading.Event()
@@ -199,20 +236,126 @@ class Fleet(Logger):
         with self._lock:
             return self._ids.get(index)
 
+    def role_of(self, index):
+        """The role replica ``index`` currently serves (None for a
+        homogeneous fleet) — tracks rebalancing re-assignments."""
+        if not self.roles:
+            return None
+        with self._lock:
+            return self._role_of.get(
+                index, self.roles[index % len(self.roles)])
+
     # -- spawning --------------------------------------------------------
+
+    def _live_role_counts(self, exclude=None):
+        """Live members per role (``_role_of`` over alive handles),
+        skipping ``exclude`` — the pool-health view both rebalance
+        mechanisms decide from.  Takes the lock."""
+        with self._lock:
+            live = [self._role_of.get(
+                        i, self.roles[i % len(self.roles)])
+                    for i, h in self._replicas.items()
+                    if i != exclude and h is not None and h.alive()]
+        counts = {}
+        for r in live:
+            counts[r] = counts.get(r, 0) + 1
+        return counts
+
+    def _assign_role(self, index):
+        """The role replica ``index`` (re)spawns with: its own pool
+        by default; an EMPTY desired pool instead, when this index's
+        own pool keeps a live member without it (the passive half of
+        rebalancing — a respawn is a free chance to fix coverage)."""
+        base = self._role_of.get(
+            index, self.roles[index % len(self.roles)])
+        if not self.rebalance_enabled:
+            return base
+        with self._lock:
+            if self._generation.get(index, 0) == 0:
+                # FIRST spawn: later indices have not spawned yet,
+                # so every pool but the earliest looks empty — only
+                # a RE-spawn may fill a pool emptied by death
+                return base
+        if faults.fire("fleet.role.assign", key=str(index)):
+            return base      # armed drop pins the original role
+        counts = self._live_role_counts(exclude=index)
+        if counts.get(base, 0) == 0:
+            return base      # respawning as base fills its own hole
+        empty = sorted(r for r in set(self.roles)
+                       if counts.get(r, 0) == 0)
+        if not empty:
+            return base
+        role = empty[0]
+        _rebalance_metric().labels(role=role).inc()
+        self.warning("rebalance: replica %d re-roles %s -> %s (the "
+                     "%s pool had no live member)", index, base,
+                     role, role)
+        return role
+
+    def rebalance(self):
+        """One ACTIVE rebalance pass (monitor-driven; also callable
+        by an operator): when a desired role pool has zero live
+        members and no dead index is about to fill it, restart the
+        highest-index replica of a pool holding >= 2 live members
+        into the empty role.  Returns the re-roled index, or None
+        when coverage is already complete (or the pass was dropped
+        at the ``fleet.role.rebalance`` point)."""
+        if not self.rebalance_enabled:
+            return None
+        if faults.fire("fleet.role.rebalance"):
+            return None
+        counts = self._live_role_counts()
+        empty = sorted(r for r in set(self.roles)
+                       if counts.get(r, 0) == 0)
+        if not empty:
+            return None
+        with self._lock:
+            surplus = [
+                i for i, h in self._replicas.items()
+                if h is not None and h.alive()
+                and i not in self._busy
+                and counts.get(self._role_of.get(
+                    i, self.roles[i % len(self.roles)]), 0) >= 2]
+            if not surplus:
+                return None
+            victim = max(surplus)
+            self._busy.add(victim)
+        role = empty[0]
+        try:
+            with self._lock:
+                old = self._ids.get(victim)
+                handle = self._replicas.get(victim)
+            self.warning("rebalance: restarting replica %d (%s) as "
+                         "%s — the %s pool lost its last member",
+                         victim, old, role, role)
+            if self.router is not None and old is not None:
+                try:
+                    self.router.remove_replica(old)
+                except Exception:
+                    pass
+            if handle is not None:
+                handle.stop()
+            with self._lock:
+                self._role_of[victim] = role
+            _rebalance_metric().labels(role=role).inc()
+            self._spawn_one(victim)
+        finally:
+            with self._lock:
+                self._busy.discard(victim)
+        return victim
 
     def _spawn_one(self, index):
         """Spawn replica ``index`` (next generation) and register it
         with the router; retries with capped exponential backoff when
         the spawn itself fails (the ``fleet.replica.spawn`` point)."""
         handle = None
+        role = self._assign_role(index) if self.roles else None
         for attempt in range(1, self.spawn_retries + 1):
             try:
                 if faults.fire("fleet.replica.spawn", key=str(index)):
                     raise RuntimeError("injected spawn drop")
                 if self.roles:
-                    handle = self.spawn(
-                        index, self.roles[index % len(self.roles)])
+                    handle = self.spawn(index, role)
                 else:
                     handle = self.spawn(index)
                 break
@@ -234,6 +377,8 @@ class Fleet(Logger):
             self._generation[index] = gen + 1
             self._replicas[index] = handle
             self._ids[index] = rid
+            if role is not None:
+                self._role_of[index] = role
         if self.router is not None:
             self.router.add_replica(handle.host, handle.port,
                                     replica_id=rid)
@@ -271,6 +416,13 @@ class Fleet(Logger):
                     # tries again (the index stays dead in the map)
                     with self._lock:
                         self._replicas[index] = None
+            if self.rebalance_enabled and not self._stopping.is_set():
+                # coverage check AFTER the respawn pass: only a pool
+                # no respawn could fill triggers the active restart
+                try:
+                    self.rebalance()
+                except Exception as e:
+                    self.warning("rebalance pass failed: %r", e)
 
     # -- rolling restart -------------------------------------------------
 

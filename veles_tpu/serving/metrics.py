@@ -363,6 +363,26 @@ def _registry_series():
             "window (reads 1.0 until enough lookups arrive, so the "
             "collapse alert never fires on idle); labeled per "
             "replica", labelnames=("replica",)),
+        # disaggregated-handoff export lifecycle: a healthy fleet
+        # fetches every parked record within the TTL — pending
+        # should hover near 0 and expired should never grow (the
+        # kv_export_expiry alert rule watches the latter: growth
+        # means the decode pool is not fetching)
+        "kv_export_pending": metrics.gauge(
+            "veles_serving_kv_export_pending",
+            "prefill-export records parked and not yet fetched "
+            "(one-shot handles awaiting the decode pool); labeled "
+            "per replica", labelnames=("replica",)),
+        "kv_export_expired": metrics.counter(
+            "veles_serving_kv_export_expired_total",
+            "export records the TTL sweeper garbage-collected "
+            "unfetched — each one a decode pool that never came "
+            "for its handoff; labeled per replica",
+            labelnames=("replica",)),
+        "kv_export_fetched": metrics.counter(
+            "veles_serving_kv_export_fetched_total",
+            "export records claimed by their one-shot fetch; "
+            "labeled per replica", labelnames=("replica",)),
     }
 
 
@@ -424,9 +444,17 @@ def _router_series():
             labelnames=("replica",)),
         "streams": metrics.counter(
             "veles_router_streams_total",
-            "streaming (SSE) requests PINNED to a replica — no "
-            "retry or hedge once the first byte forwarded",
-            labelnames=("replica",)),
+            "streaming (SSE) requests PINNED to a replica — counted "
+            "once per client stream (a mid-stream failover's resumed "
+            "leg does NOT re-count)", labelnames=("replica",)),
+        "stream_failovers": metrics.counter(
+            "veles_router_stream_failovers_total",
+            "mid-stream failover attempts after a pinned replica "
+            "died or stalled, by outcome (resumed: the continuation "
+            "spliced into the open SSE connection; failed: no "
+            "eligible replica or the resume itself errored; "
+            "abandoned: the client disconnected during the resume)",
+            labelnames=("outcome",)),
     }
 
 
@@ -447,6 +475,7 @@ class RouterMetrics:
         self.restarts = 0
         self.drains = 0
         self.streams = 0
+        self.stream_failovers = {}   # outcome -> count
         self._request_ms = Histogram("router_request_ms",
                                      buckets=MS_BUCKETS,
                                      reservoir=recent)
@@ -518,6 +547,23 @@ class RouterMetrics:
             self.streams += 1
         self._global["streams"].labels(replica=str(replica)).inc()
 
+    def record_stream_failover(self, outcome):
+        """One mid-stream failover attempt: ``resumed`` (the
+        continuation spliced into the open SSE connection),
+        ``failed`` (no eligible replica / resume errored — the
+        client sees a terminal error frame) or ``abandoned`` (the
+        client disconnected while the resume was in flight).  The
+        resumed leg is deliberately NOT a second
+        ``veles_router_streams_total`` pin — one client stream, one
+        count."""
+        with self._lock:
+            self.stream_failovers[outcome] = \
+                self.stream_failovers.get(outcome, 0) + 1
+        self._global["stream_failovers"].labels(
+            outcome=str(outcome)).inc()
+        events.record("router.stream_failover", "single",
+                      cls="Router", outcome=str(outcome))
+
     def record_request(self, ms, cls="normal"):
         self._request_ms.observe(ms)
         self._global["request_ms"].observe(ms)
@@ -547,6 +593,7 @@ class RouterMetrics:
                 "hedge_wins": self.hedge_wins,
                 "shed": self.shed,
                 "streams_pinned": self.streams,
+                "stream_failovers": dict(self.stream_failovers),
                 "replica_restarts": self.restarts,
                 "replica_drains": self.drains,
             }
@@ -586,6 +633,8 @@ class ServingMetrics:
         self.preempts = 0
         self.preempt_resumes = 0
         self.watchdog_trips = 0
+        self.kv_exports_expired = 0     # TTL-swept unfetched records
+        self.kv_exports_fetched = 0     # one-shot claims served
         self.spec_drafted_tokens = 0    # proposer output, cumulative
         self.spec_accepted_tokens = 0   # drafts kept at verify
         self.spec_rollback_tokens = 0   # drafts rejected at verify
@@ -703,6 +752,28 @@ class ServingMetrics:
         self._global["drains"].inc()
         events.record("serving.drain", "single",
                       cls="InferenceScheduler")
+
+    def set_kv_exports_pending(self, pending):
+        self._global["kv_export_pending"].labels(
+            replica=self.replica).set(int(pending))
+
+    def record_kv_export_expired(self, n, trace=None):
+        """The TTL sweeper GC'd ``n`` unfetched export records —
+        growth here means the decode pool never came for its
+        handoffs (the kv_export_expiry alert rule)."""
+        n = int(n)
+        with self._lock:
+            self.kv_exports_expired += n
+        self._global["kv_export_expired"].labels(
+            replica=self.replica).inc(n)
+        events.record("serving.kv_export_expired", "single",
+                      cls="InferenceScheduler", records=n)
+
+    def record_kv_export_fetched(self):
+        with self._lock:
+            self.kv_exports_fetched += 1
+        self._global["kv_export_fetched"].labels(
+            replica=self.replica).inc()
 
     def record_spec(self, drafted, accepted):
         """One slot's verify outcome: ``drafted`` tokens proposed,
@@ -895,6 +966,8 @@ class ServingMetrics:
                 "preempts": self.preempts,
                 "preempt_resumes": self.preempt_resumes,
                 "watchdog_trips": self.watchdog_trips,
+                "kv_exports_expired": self.kv_exports_expired,
+                "kv_exports_fetched": self.kv_exports_fetched,
                 "spec_drafted_tokens": self.spec_drafted_tokens,
                 "spec_accepted_tokens": self.spec_accepted_tokens,
                 "spec_rollback_tokens": self.spec_rollback_tokens,

@@ -50,16 +50,23 @@ registry) into fleet behavior:
 
 - **streaming + the OpenAI facade** — ``POST /generate`` /
   ``/v1/completions`` bodies with ``"stream": true`` proxy as SSE
-  **chunk by chunk**: retries and hedging apply only until the
-  replica's response status line arrives — the FIRST forwarded byte
-  PINS the replica (tokens already delivered cannot be unsent, so
-  there is no transparent mid-stream failover; see
-  docs/robustness.md), and a client that disconnects mid-stream
-  tears down the upstream connection, which cancels the request on
-  the replica and frees its KV blocks.  ``/v1/completions``,
-  ``/v1/embeddings``, ``/v1/classify`` and ``GET /v1/models``
-  forward with the same affinity/retry/breaker machinery as
-  ``/generate``.
+  **frame by frame**.  Replayable ``/generate`` streams (single
+  row, greedy or seed-pinned) get **transparent mid-stream
+  failover**: the router records the body and every token frame it
+  forwarded, and when the pinned replica dies or errors mid-stream
+  it resubmits through the replica ``resume_tokens`` lane — the
+  continuation re-prefills prompt + prefix, samples at draw counter
+  ``len(forwarded)`` and splices into the open connection
+  bit-identical to an uninterrupted run, with zero client-visible
+  error frames (``veles_router_stream_failovers_total{outcome}``).
+  Non-replayable streams (multi-row, unseeded sampling, the /v1
+  facade) keep the pin-and-truncate contract; hedging never arms
+  for streams.  A client that disconnects mid-stream tears down the
+  upstream connection — the active leg AND any resume in flight —
+  which cancels the request on the replica and frees its KV blocks.
+  ``/v1/completions``, ``/v1/embeddings``, ``/v1/classify`` and
+  ``GET /v1/models`` forward with the same affinity/retry/breaker
+  machinery as ``/generate``.
 
 - **request tracing + SLOs** — every request gets a trace id at the
   edge (``X-Veles-Trace``, accepted-or-minted, echoed on EVERY reply
@@ -804,18 +811,30 @@ class Router(Logger):
 
     async def _stream_proxy(self, path, headers, raw, writer,
                             trace=None):
-        """Proxy one streaming (SSE) request chunk by chunk.
+        """Proxy one streaming (SSE) request frame by frame.
 
-        Retries, backoff and replica selection apply only UNTIL a
+        Retries, backoff and replica selection apply freely UNTIL a
         replica's response status line arrives; the first forwarded
-        byte PINS the replica — tokens already delivered to the
-        client cannot be unsent, so there is no mid-stream failover
-        and no hedging (two replicas decoding one stream would bill
-        twice for idempotent output).  A mid-stream client disconnect
-        closes the upstream connection, which makes the replica's SSE
-        writer fail and CANCEL the request (slot + KV blocks free at
-        the next decode boundary).  Error replies (shed 503s, 4xx)
-        stay ordinary JSON — only a success opens the event stream."""
+        byte pins the client's response headers.  For REPLAYABLE
+        ``/generate`` streams (single row, greedy or seed-pinned —
+        the idempotent set) the pin is no longer final: the router
+        records the request's replay state (body + every token frame
+        it forwarded) and when the pinned replica dies or errors
+        mid-stream it RESUBMITS the request to another eligible
+        replica through the ``resume_tokens`` lane — the replica
+        re-prefills prompt + forwarded prefix and continues sampling
+        at draw counter ``len(forwarded)``, so the spliced
+        continuation is bit-identical to an uninterrupted run
+        (fp32; the PR 7 preempt→resume contract) and the client sees
+        zero error frames.  Non-replayable streams (multi-row,
+        unseeded sampling, the /v1 facade) keep the old pin-and-
+        truncate contract.  Hedging never arms for streams.  A
+        mid-stream client disconnect closes the upstream connection
+        — including a resume leg in flight — which makes the
+        replica's SSE writer fail and CANCEL the request (slot + KV
+        blocks free at the next decode boundary).  Error replies
+        (shed 503s, 4xx) stay ordinary JSON — only a success opens
+        the event stream."""
         t0 = time.monotonic()
         deadline = t0 + self.request_timeout
         _, affinity, _, cls = self._inspect(raw, headers)
@@ -845,138 +864,239 @@ class Router(Logger):
                               duration=time.monotonic() - t0,
                               attempts=info["attempts"])
 
+    #: SSE frame terminator — the replica's sse_event wire format
+    #: (``data: <json>\n\n``); the failover parser splits on it
+    _SSE_SEP = b"\n\n"
+
+    def _stream_replay_state(self, path, raw):
+        """Replay state for mid-stream failover, or None when the
+        stream is not resumable: only single-row ``/generate``
+        bodies that are IDEMPOTENT (greedy, or seed-pinned sampling
+        — any replica regenerates the same tokens) and not already a
+        resume leg qualify.  ``generated`` accumulates every token
+        frame the router has forwarded; a resume resubmits the body
+        with exactly that prefix."""
+        if path != "/generate":
+            return None
+        try:
+            body = json.loads(raw.decode() or "{}")
+        except Exception:
+            return None
+        prompt = body.get("prompt")
+        if not isinstance(prompt, list) or not prompt \
+                or isinstance(prompt[0], list) \
+                or body.get("beam") or body.get("resume_tokens"):
+            return None
+        if float(body.get("temperature") or 0.0) \
+                and body.get("seed") is None:
+            return None      # unseeded sampling cannot be replayed
+        try:
+            if int(body.get("steps") or 0) < 1:
+                return None
+        except (TypeError, ValueError):
+            return None
+        return {"body": body, "generated": []}
+
+    async def _resume_begin(self, rep, state, fwd, timeout):
+        """Open one resume leg: the replay body + the forwarded
+        prefix through the replica's loopback/admin
+        ``resume_tokens`` lane (the admin bearer rides along for
+        remote replicas).  Returns the ``_http_begin`` handle."""
+        body = dict(state["body"])
+        body["stream"] = True
+        body["resume_tokens"] = list(state["generated"])
+        headers = dict(fwd)
+        from veles_tpu.config import root
+        token = root.common.api.get("admin_token", None)
+        if token:
+            headers["Authorization"] = "Bearer %s" % token
+        return await asyncio.wait_for(
+            self._http_begin(rep, "POST", "/generate",
+                             json.dumps(body).encode(), headers),
+            timeout)
+
+    async def _relay_one_frame(self, rep, frame, writer, state):
+        """Forward one complete SSE frame to the client, tracking
+        replay state.  Returns None to keep relaying, ``"done"``
+        after the terminal [DONE], ``"died"`` when the frame is an
+        error frame (failover material — NOT forwarded) or the armed
+        ``router.stream.replica_death`` point killed the replica
+        under this frame, ``"client_gone"`` when the client hung
+        up."""
+        data = frame.strip()
+        if data.startswith(b"data:"):
+            data = data[5:].strip()
+        payload = None
+        if data != b"[DONE]":
+            try:
+                payload = json.loads(data.decode())
+            except Exception:
+                payload = None
+        is_token = isinstance(payload, dict) and "token" in payload
+        if isinstance(payload, dict) and "error" in payload:
+            # a mid-stream scheduler failure (watchdog, close, the
+            # replica dying politely) — resume elsewhere instead of
+            # delivering the error frame
+            return "died"
+        if is_token:
+            # the chaos hook: an armed drop/exception here IS the
+            # pinned replica dying before this frame reached the
+            # client — the token is not counted as forwarded, so the
+            # resume regenerates it
+            try:
+                dropped = await asyncio.get_running_loop() \
+                    .run_in_executor(None, faults.fire,
+                                     "router.stream.replica_death",
+                                     rep.id)
+            except faults.InjectedFault:
+                return "died"
+            if dropped:
+                return "died"
+        try:
+            writer.write(frame)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            return "client_gone"
+        if is_token and state is not None:
+            state["generated"].append(int(payload["token"]))
+        return "done" if data == b"[DONE]" else None
+
+    async def _relay_sse_frames(self, rep, upstream, writer, state,
+                                deadline):
+        """Relay one pinned upstream's SSE stream frame by frame.
+        Returns ``"done"`` (terminal [DONE] delivered), ``"died"``
+        (upstream EOF/error/error-frame before [DONE] — failover
+        material), ``"client_gone"`` or ``"deadline"``.  A trailing
+        partial frame is never forwarded, so the replay state counts
+        exactly the frames the client received."""
+        buf = b""
+        while True:
+            try:
+                chunk = await asyncio.wait_for(
+                    upstream.read(4096),
+                    max(0.05, deadline - time.monotonic()))
+            except asyncio.TimeoutError:
+                return "deadline"
+            except (ConnectionError, asyncio.IncompleteReadError,
+                    OSError):
+                return "died"
+            if not chunk:
+                return "died"   # EOF without [DONE]: replica died
+            buf += chunk
+            while self._SSE_SEP in buf:
+                frame, buf = buf.split(self._SSE_SEP, 1)
+                verdict = await self._relay_one_frame(
+                    rep, frame + self._SSE_SEP, writer, state)
+                if verdict is not None:
+                    return verdict
+
+    async def _relay_blind(self, upstream, writer, deadline):
+        """The legacy pin-and-truncate relay for non-resumable
+        streams (and non-200 bodies): bytes through as they arrive
+        until EOF, client disconnect or the deadline."""
+        try:
+            while True:
+                chunk = await asyncio.wait_for(
+                    upstream.read(4096),
+                    max(1.0, deadline - time.monotonic()))
+                if not chunk:
+                    break
+                writer.write(chunk)
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError,
+                asyncio.TimeoutError):
+            # client gone or replica stalled past the deadline: drop
+            # the upstream connection — the replica's SSE writer
+            # fails and cancels the request, freeing slot + blocks
+            pass
+
     async def _stream_attempts(self, path, raw, writer, trace, t0,
                                deadline, affinity, cls, fwd, info):
+        state = self._stream_replay_state(path, raw)
         attempts = 0
         last_status, last_body = None, b""
-        while attempts < self.retries:
-            now = time.monotonic()
-            if now >= deadline:
-                break
-            rep = self._pick(affinity, now)
-            if rep is None:
-                break
-            attempts += 1
-            info["attempts"] = attempts
-            info["replica"] = rep.id
-            if attempts > 1:
-                self.stats.record_retry()
-            span = None
-            if self._tron and trace is not None:
-                span = next_span_id()
-                events.record("router.attempt", "begin",
-                              cls="Router", span=span, trace=trace,
-                              attempt=attempts, replica=rep.id,
-                              stream=True)
-            t_att = time.monotonic()
-            rep.outstanding += 1
-            rep.requests += 1
-            upstream = up_writer = None
-            try:
-                try:
-                    dropped = await asyncio.get_running_loop() \
-                        .run_in_executor(None, faults.fire,
-                                         "router.forward", rep.id)
-                    if dropped:
-                        raise ConnectionError("injected forward drop")
-                    upstream, up_writer, status, rheaders = \
-                        await asyncio.wait_for(
-                            self._http_begin(rep, "POST", path, raw,
-                                             fwd),
-                            deadline - now)
-                except faults.InjectedHTTPError as e:
-                    status = e.status
-                    rheaders = {"content-type": "application/json"}
-                    last_body = json.dumps(
-                        {"error": {"code": status,
-                                   "message": str(e),
-                                   "injected": True,
-                                   "trace_id": trace}}).encode()
-                    upstream = None
-                except asyncio.CancelledError:
-                    raise
-                except Exception:
-                    self._breaker_failure(rep)
-                    self.stats.record_forward(rep.id, False)
-                    last_status, last_body = 502, b""
+        pinned = False       # the client's SSE headers are out
+        exclude = set()      # replicas that died under THIS stream
+        try:
+            while attempts < self.retries:
+                now = time.monotonic()
+                if now >= deadline:
+                    break
+                rep = self._pick(affinity, now,
+                                 exclude=tuple(exclude))
+                if rep is None:
+                    break
+                attempts += 1
+                info["attempts"] = attempts
+                info["replica"] = rep.id
+                if attempts > 1 and not pinned:
+                    self.stats.record_retry()
+                kind, arg = await self._stream_one_attempt(
+                    path, raw, writer, trace, deadline, fwd, rep,
+                    attempts, pinned, state)
+                if kind == "retry":
+                    if arg is not None:
+                        last_status, last_body = arg
+                    if pinned:
+                        # a failed RESUME leg: this replica cannot
+                        # continue the stream right now
+                        exclude.add(rep.id)
                     continue
-                if status >= 500 and status != 503:
-                    self._breaker_failure(rep)
-                    self.stats.record_forward(rep.id, False)
-                    last_status = status
-                    if upstream is not None:
-                        try:
-                            last_body = await asyncio.wait_for(
-                                upstream.read(65536), 5.0)
-                        except Exception:
-                            last_body = b""
-                    continue
-                # the replica spoke: liveness proven (503 included)
-                self._breaker_success(rep)
-                self.stats.record_forward(rep.id, True)
-                if status == 503:
-                    try:
-                        after = float(rheaders.get("retry-after", 1))
-                    except ValueError:
-                        after = 1.0
-                    rep.saturated_until = now + min(after, 5.0)
-                # PIN: relay the reply — headers first, then bytes as
-                # they arrive (SSE frames for a 200, the structured
-                # JSON error body otherwise)
-                self.stats.record_stream(rep.id)
-                out = ["HTTP/1.1 %d %s" % (status, "OK"
-                                           if status == 200 else "X"),
-                       "Connection: close",
-                       "Content-Type: %s" % rheaders.get(
-                           "content-type", "application/json"),
-                       "X-Veles-Router-Attempts: %d" % attempts,
-                       "X-Veles-Replica: %s" % rheaders.get(
-                           "x-veles-replica", rep.id)]
-                if trace is not None:
-                    out.append("X-Veles-Trace: %s" % trace)
-                if "content-length" in rheaders:
-                    out.append("Content-Length: %s"
-                               % rheaders["content-length"])
-                if "retry-after" in rheaders:
-                    out.append("Retry-After: %s"
-                               % rheaders["retry-after"])
-                writer.write(("\r\n".join(out) + "\r\n\r\n")
-                             .encode())
-                try:
-                    if upstream is None:   # injected reply, no socket
-                        writer.write(last_body)
-                        await writer.drain()
-                        return
-                    while True:
-                        chunk = await asyncio.wait_for(
-                            upstream.read(4096),
-                            max(1.0, deadline - time.monotonic()))
-                        if not chunk:
-                            break
-                        writer.write(chunk)
-                        await writer.drain()
-                except (ConnectionError, asyncio.IncompleteReadError,
-                        asyncio.TimeoutError):
-                    # client gone or replica stalled past the
-                    # deadline: drop the upstream connection — the
-                    # replica's SSE writer fails and cancels the
-                    # request, freeing its slot and blocks
-                    pass
-                finally:
+                if kind == "sent":
+                    # non-resumable relay (or error body) delivered
                     self.stats.record_request(
                         (time.monotonic() - t0) * 1e3, cls=cls)
-                return
-            finally:
-                rep.outstanding -= 1
-                if up_writer is not None:
-                    up_writer.close()
-                if span is not None:
-                    events.record(
-                        "router.attempt", "end", cls="Router",
-                        span=span, trace=trace, attempt=attempts,
-                        replica=rep.id, stream=True,
-                        duration=time.monotonic() - t_att)
-            # (unreachable: every branch above returns or continues)
+                    return
+                if kind == "relay":
+                    # ("resumed" is recorded inside the attempt, at
+                    # the moment a resume leg's 200 arrives — before
+                    # its first spliced frame reaches the client)
+                    pinned = True
+                    if arg == "done":
+                        self.stats.record_request(
+                            (time.monotonic() - t0) * 1e3, cls=cls)
+                        return
+                    if arg == "client_gone":
+                        # the client hung up (possibly mid-failover):
+                        # the attempt's upstream was closed by the
+                        # per-attempt cleanup, cancelling the request
+                        # replica-side — nothing left to resume for
+                        if exclude:
+                            self.stats.record_stream_failover(
+                                "abandoned")
+                        self.stats.record_request(
+                            (time.monotonic() - t0) * 1e3, cls=cls)
+                        return
+                    if arg == "deadline":
+                        break
+                    # arg == "died": the pinned replica is gone —
+                    # the loop resumes on another one
+                    exclude.add(rep.id)
+        except asyncio.CancelledError:
+            raise
+        if pinned:
+            # the stream started but could not complete and no
+            # replica can continue it: end it with ONE structured
+            # error frame + [DONE] instead of a silent truncation
+            if exclude:   # a replica death was involved, not just
+                self.stats.record_stream_failover("failed")  # expiry
+            self.stats.record_request((time.monotonic() - t0) * 1e3,
+                                      cls=cls)
+            err = {"error": {
+                "code": 503,
+                "message": "stream interrupted and no eligible "
+                           "replica could resume it",
+                "trace_id": trace,
+                "tokens_generated": len(state["generated"])
+                if state else None}}
+            try:
+                writer.write(b"data: " + json.dumps(
+                    err, separators=(",", ":")).encode()
+                    + b"\n\ndata: [DONE]\n\n")
+                await writer.drain()
+            except (ConnectionError, OSError):
+                pass
+            return
         # no replica ever produced a status line (or only 5xx) — shed
         self.stats.record_request((time.monotonic() - t0) * 1e3,
                                   cls=cls)
@@ -997,6 +1117,138 @@ class Router(Logger):
         writer.write(("\r\n".join(out) + "\r\n\r\n").encode()
                      + rbody)
         await writer.drain()
+
+    async def _stream_one_attempt(self, path, raw, writer, trace,
+                                  deadline, fwd, rep, attempts,
+                                  pinned, state):
+        """One streaming forward attempt (first leg or resume leg),
+        with the breaker/metrics accounting.  Returns a verdict
+        tuple: ``("retry", (status, body) | None)`` to try another
+        replica, ``("sent", None)`` when a complete non-resumable
+        reply was delivered, or ``("relay", outcome)`` with the
+        frame-relay outcome of a pinned resumable stream."""
+        now = time.monotonic()
+        span = None
+        if self._tron and trace is not None:
+            span = next_span_id()
+            events.record("router.attempt", "begin", cls="Router",
+                          span=span, trace=trace, attempt=attempts,
+                          replica=rep.id, stream=True, resume=pinned)
+        t_att = time.monotonic()
+        rep.outstanding += 1
+        rep.requests += 1
+        upstream = up_writer = None
+        injected_body = None
+        try:
+            try:
+                dropped = await asyncio.get_running_loop() \
+                    .run_in_executor(None, faults.fire,
+                                     "router.forward", rep.id)
+                if dropped:
+                    raise ConnectionError("injected forward drop")
+                if pinned:
+                    upstream, up_writer, status, rheaders = \
+                        await self._resume_begin(
+                            rep, state, fwd, deadline - now)
+                else:
+                    upstream, up_writer, status, rheaders = \
+                        await asyncio.wait_for(
+                            self._http_begin(rep, "POST", path, raw,
+                                             fwd),
+                            deadline - now)
+            except faults.InjectedHTTPError as e:
+                status = e.status
+                rheaders = {"content-type": "application/json"}
+                injected_body = json.dumps(
+                    {"error": {"code": status,
+                               "message": str(e),
+                               "injected": True,
+                               "trace_id": trace}}).encode()
+                upstream = None
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self._breaker_failure(rep)
+                self.stats.record_forward(rep.id, False)
+                return ("retry", (502, b""))
+            if status >= 500 and status != 503:
+                self._breaker_failure(rep)
+                self.stats.record_forward(rep.id, False)
+                body = b""
+                if upstream is not None:
+                    try:
+                        body = await asyncio.wait_for(
+                            upstream.read(65536), 5.0)
+                    except Exception:
+                        body = b""
+                return ("retry", (status, body))
+            # the replica spoke: liveness proven (503 included)
+            self._breaker_success(rep)
+            self.stats.record_forward(rep.id, True)
+            if status == 503:
+                try:
+                    after = float(rheaders.get("retry-after", 1))
+                except ValueError:
+                    after = 1.0
+                rep.saturated_until = now + min(after, 5.0)
+            if pinned:
+                # resume legs can only relay a 200 event stream —
+                # the client's headers are long gone; anything else
+                # is a failed resume attempt
+                if status != 200 or upstream is None:
+                    return ("retry", None)
+                # recorded BEFORE the continuation's first frame, so
+                # the count is visible by the time the client reads
+                # the spliced [DONE]
+                self.stats.record_stream_failover("resumed")
+                outcome = await self._relay_sse_frames(
+                    rep, upstream, writer, state, deadline)
+                return ("relay", outcome)
+            # FIRST reply: pin the client response — headers out,
+            # then frames/bytes as they arrive (SSE for a 200, the
+            # structured JSON error body otherwise).  One client
+            # stream counts ONE pin, resume legs never re-count.
+            self.stats.record_stream(rep.id)
+            out = ["HTTP/1.1 %d %s" % (status, "OK"
+                                       if status == 200 else "X"),
+                   "Connection: close",
+                   "Content-Type: %s" % rheaders.get(
+                       "content-type", "application/json"),
+                   "X-Veles-Router-Attempts: %d" % attempts,
+                   "X-Veles-Replica: %s" % rheaders.get(
+                       "x-veles-replica", rep.id)]
+            if trace is not None:
+                out.append("X-Veles-Trace: %s" % trace)
+            if "content-length" in rheaders:
+                out.append("Content-Length: %s"
+                           % rheaders["content-length"])
+            if "retry-after" in rheaders:
+                out.append("Retry-After: %s"
+                           % rheaders["retry-after"])
+            writer.write(("\r\n".join(out) + "\r\n\r\n").encode())
+            if upstream is None:       # injected reply, no socket
+                try:
+                    writer.write(injected_body or b"")
+                    await writer.drain()
+                except (ConnectionError, OSError):
+                    pass
+                return ("sent", None)
+            if status == 200 and state is not None:
+                outcome = await self._relay_sse_frames(
+                    rep, upstream, writer, state, deadline)
+                return ("relay", outcome)
+            await self._relay_blind(upstream, writer, deadline)
+            return ("sent", None)
+        finally:
+            rep.outstanding -= 1
+            if up_writer is not None:
+                up_writer.close()
+            if span is not None:
+                events.record(
+                    "router.attempt", "end", cls="Router",
+                    span=span, trace=trace, attempt=attempts,
+                    replica=rep.id, stream=True, resume=pinned,
+                    duration=time.monotonic() - t_att)
 
     # -- live in-flight inspection ---------------------------------------
 
@@ -1184,11 +1436,18 @@ class Router(Logger):
     async def _maybe_disagg(self, raw, headers, trace):
         """Disaggregated /generate: prefill on a prefill-specialist
         → fetch its KV export → hand the blocks to an
-        affinity-picked decode replica for the token loop.  Returns
-        the final reply tuple, or None to fall back to the plain
-        colocated forward (multi-row/stream/beam bodies, no
-        specialists up, or any hop failing — the decode pool can
-        always serve the request cold)."""
+        affinity-picked decode replica for the token loop.  Every
+        hop is individually retryable: a prefill specialist dying
+        before its export was fetched re-runs prefill on ANOTHER
+        specialist (the export is one-shot, so the fetch is never
+        retried against a second owner), and a decode replica
+        failing the import gets the SAME export payload retried on a
+        peer.  Returns the final reply tuple, or None to fall back
+        to the plain colocated forward (multi-row/stream/beam
+        bodies, no specialists up, or every hop budget exhausted —
+        the decode pool can always serve the request cold, so a
+        request is NEVER failed while a colocated-capable replica
+        exists)."""
         now = time.monotonic()
         if not self._disagg_active(now):
             return None
@@ -1199,6 +1458,7 @@ class Router(Logger):
             return None      # the replica will 400 it
         if not isinstance(prompt, list) or not prompt \
                 or body.get("stream") or body.get("beam") \
+                or body.get("resume_tokens") \
                 or int(body.get("steps") or 0) < 1:
             return None
         squeeze = not isinstance(prompt[0], list)
@@ -1207,64 +1467,100 @@ class Router(Logger):
             return None      # batch bodies stay colocated
         deadline = now + self.request_timeout
         _, affinity, _, cls = self._inspect(raw, headers)
-        specialists = [r for r in self._pickable(now,
-                                                 phase="prefill")
-                       if r.role == "prefill"]
-        if not specialists:
-            return None      # no SPECIALIST free — serve colocated
-        pre = min(specialists, key=lambda r: (r.outstanding, r.id))
         pf_body = json.dumps({"prompt": rows[0],
-                              "priority": body.get("priority")})
-        out = await self._attempt(
-            pre, pf_body.encode(), headers, deadline - now,
-            path="/serving/prefill", trace=trace)
-        if not out.deliverable or out.status != 200:
-            return None
-        try:
-            handle = json.loads(out.body.decode())["handle"]
-        except Exception:
-            return None
-        out = await self._attempt(
-            pre, None, headers, deadline - time.monotonic(),
-            path="/serving/kv_export/%s" % handle, method="GET",
-            trace=trace)
-        if not out.deliverable or out.status != 200:
-            return None
-        try:
-            export = json.loads(out.body.decode())
-        except Exception:
-            return None
-        dec = self._pick(affinity, time.monotonic(),
-                         exclude=(pre.id,))
-        if dec is None:
+                              "priority": body.get("priority")}) \
+            .encode()
+        export = None
+        pre = None
+        tried_pre = set()
+        for _ in range(2):   # prefill+fetch: up to two specialists
+            if time.monotonic() >= deadline:
+                return None
+            specialists = [
+                r for r in self._pickable(time.monotonic(),
+                                          exclude=tuple(tried_pre),
+                                          phase="prefill")
+                if r.role == "prefill"]
+            if not specialists:
+                return None  # no SPECIALIST free — serve colocated
+            pre = min(specialists,
+                      key=lambda r: (r.outstanding, r.id))
+            tried_pre.add(pre.id)
+            out = await self._attempt(
+                pre, pf_body, headers, deadline - time.monotonic(),
+                path="/serving/prefill", trace=trace)
+            if not out.deliverable or out.status != 200:
+                continue     # prefill failed: try the next owner
+            try:
+                handle = json.loads(out.body.decode())["handle"]
+            except Exception:
+                continue
+            # THE chaos window: the specialist can die between
+            # parking the export and our fetch — an armed drop/
+            # exception here is exactly that death
+            try:
+                dropped = await asyncio.get_running_loop() \
+                    .run_in_executor(None, faults.fire,
+                                     "disagg.export.fetch", pre.id)
+            except faults.InjectedFault:
+                dropped = True
+            if not dropped:
+                out = await self._attempt(
+                    pre, None, headers,
+                    deadline - time.monotonic(),
+                    path="/serving/kv_export/%s" % handle,
+                    method="GET", trace=trace)
+                if out.deliverable and out.status == 200:
+                    try:
+                        export = json.loads(out.body.decode())
+                        break
+                    except Exception:
+                        export = None
+            # the fetch failed (death, injected drop, expiry 404 or
+            # a one-shot 409 race): the record is unrecoverable —
+            # re-run prefill from the prompt on another specialist
+        if export is None:
             return None
         imp_body = json.dumps({
             "export": export, "steps": body.get("steps"),
             "temperature": body.get("temperature"),
             "top_k": body.get("top_k"), "seed": body.get("seed"),
             "stop": body.get("stop"),
-            "priority": body.get("priority")})
-        out = await self._attempt(
-            dec, imp_body.encode(), headers,
-            deadline - time.monotonic(), path="/serving/kv_import",
-            trace=trace)
-        if not out.deliverable or out.status != 200:
-            return None
-        try:
-            toks = json.loads(out.body.decode())["tokens"]
-        except Exception:
-            return None
-        self.stats.record_disagg()
-        self.stats.record_request((time.monotonic() - now) * 1e3,
-                                  cls=cls)
-        rheaders = {"Content-Type": "application/json",
-                    "X-Veles-Router-Disagg": "%s>%s" % (pre.id,
-                                                        dec.id),
-                    "X-Veles-Replica": dec.id}
-        if trace is not None:
-            rheaders["X-Veles-Trace"] = trace
-        return 200, rheaders, json.dumps(
-            {"tokens": toks if squeeze else [toks]}).encode()
+            "priority": body.get("priority")}).encode()
+        tried_dec = {pre.id}
+        for _ in range(2):   # import: up to two decode replicas —
+            #                  the payload is router-held, so a dead
+            #                  importer costs one retry, not a
+            #                  re-prefill
+            if time.monotonic() >= deadline:
+                return None
+            dec = self._pick(affinity, time.monotonic(),
+                             exclude=tuple(tried_dec))
+            if dec is None:
+                return None
+            tried_dec.add(dec.id)
+            out = await self._attempt(
+                dec, imp_body, headers,
+                deadline - time.monotonic(),
+                path="/serving/kv_import", trace=trace)
+            if not out.deliverable or out.status != 200:
+                continue
+            try:
+                toks = json.loads(out.body.decode())["tokens"]
+            except Exception:
+                continue
+            self.stats.record_disagg()
+            self.stats.record_request(
+                (time.monotonic() - now) * 1e3, cls=cls)
+            rheaders = {"Content-Type": "application/json",
+                        "X-Veles-Router-Disagg": "%s>%s"
+                        % (pre.id, dec.id),
+                        "X-Veles-Replica": dec.id}
+            if trace is not None:
+                rheaders["X-Veles-Trace"] = trace
+            return 200, rheaders, json.dumps(
+                {"tokens": toks if squeeze else [toks]}).encode()
+        return None
 
     async def _route(self, method, path, headers, body, trace=None):
         if method == "POST" and path == "/generate":

@@ -522,6 +522,9 @@ class InferenceScheduler(Logger):
             else "sched%d" % next(_SCHED_SEQ)
         self.stats = ServingMetrics(replica=self.replica_id)
         self._exports = {}           # handle -> export record (lock)
+        self._exports_claimed = {}   # handle -> fetch time (lock) —
+        #                              what tells a double-fetch race
+        #                              (409) from a junk handle (404)
         #: per-request tracing (telemetry/reqtrace.py), read ONCE at
         #: construction — the per-boundary gate must be an attribute
         #: test, not a config-tree walk
@@ -592,7 +595,8 @@ class InferenceScheduler(Logger):
 
     def submit(self, prompt, steps, temperature=0.0, top_k=0,
                seed=None, stop_token=None, timeout=None,
-               priority=None, stream=False, trace=None):
+               priority=None, stream=False, trace=None,
+               resume_tokens=None):
         """Queue one sequence for decoding; returns a Future whose
         result is the full token list (prompt + generated, ending at
         the first generated stop token if one fired).  ``timeout``
@@ -600,6 +604,19 @@ class InferenceScheduler(Logger):
         ``request_timeout``; it covers queueing AND decoding — expiry
         mid-decode frees the slot/blocks and fails the future with
         :class:`DeadlineExceededError`).
+
+        ``resume_tokens`` adopts an already-generated prefix — the
+        mid-stream-failover lane: the request admits with
+        ``generated`` pre-populated, re-prefills prompt + prefix
+        through the chunked path (exactly the preempt→resume
+        machinery) and samples its next token at draw counter
+        ``len(resume_tokens)``, so the continued stream is
+        bit-identical to an uninterrupted run of the same
+        prompt/seed/params (fp32; int8 pools continue within the
+        documented quantization-noise contract).  ``steps`` stays
+        the request's TOTAL generation budget — the resumed prefix
+        counts against it — and a stream sink receives only the
+        NEWLY drawn tokens.
 
         ``priority`` ("low"/"normal"/"high" or 0–2, default normal)
         sets the request's QoS class: admission order, shed
@@ -628,6 +645,13 @@ class InferenceScheduler(Logger):
             raise ValueError("prompt must be non-empty")
         if steps < 1:
             raise ValueError("steps must be >= 1")
+        resume = [int(t) for t in resume_tokens] \
+            if resume_tokens else []
+        if len(resume) >= steps:
+            raise ValueError(
+                "resume_tokens already cover the %d-step budget "
+                "(%d resumed) — nothing left to generate"
+                % (steps, len(resume)))
         if len(prompt) + steps > self.window:
             raise ValueError(
                 "prompt_len + steps = %d exceeds the serving window "
@@ -659,6 +683,12 @@ class InferenceScheduler(Logger):
             time.monotonic() + ttl if ttl > 0 else None,
             priority=prio, sink=ts._push if ts is not None else None,
             trace=trace)
+        if resume:
+            # the failover-resume lane rides the preempt→resume
+            # machinery: the adopted prefix re-prefills with the
+            # prompt and the next draw folds counter len(resume) —
+            # the sink sees only tokens drawn HERE
+            req.generated = resume
         self._admission_enqueue(req)
         if ts is not None:
             ts._bind(self, req.future)
@@ -756,15 +786,54 @@ class InferenceScheduler(Logger):
 
     def kv_export(self, handle):
         """Claim one parked export record (one-shot — the fetch
-        consumes it), or None when the handle is unknown/expired.
-        The record is the host-side numpy form;
+        consumes it), or None when the handle is unknown/expired/
+        already fetched (:meth:`kv_export_status` tells those
+        apart).  The record is the host-side numpy form;
         ``serving/disagg.encode_export`` is the wire envelope."""
         now = time.monotonic()
         with self._lock:
-            for h in [h for h, r in self._exports.items()
-                      if now - r["t"] > EXPORT_TTL]:
-                del self._exports[h]
-            return self._exports.pop(str(handle), None)
+            self._sweep_exports_locked(now)
+            rec = self._exports.pop(str(handle), None)
+            if rec is not None:
+                self._exports_claimed[str(handle)] = now
+                self.stats.record_kv_export_fetched()
+                self.stats.set_kv_exports_pending(len(self._exports))
+            return rec
+
+    def kv_export_status(self, handle):
+        """One-shot-fetch disambiguation for the REST layer:
+        ``"pending"`` (parked, fetchable), ``"fetched"`` (already
+        claimed — a second fetch is a 409 race, not a missing
+        record) or ``"unknown"`` (never parked, or expired and
+        swept)."""
+        with self._lock:
+            if str(handle) in self._exports:
+                return "pending"
+            if str(handle) in self._exports_claimed:
+                return "fetched"
+            return "unknown"
+
+    def _sweep_exports_locked(self, now=None):
+        """TTL housekeeping over the parked export records (caller
+        holds the lock): GC expired records, prune the claimed-handle
+        memory, and keep the pending gauge honest.  Returns how many
+        records expired.  Piggybacked on the decode loop (idle
+        replicas sweep on a 1 s condition-wait timeout), so a
+        crashed decode pool's unfetched handoffs stop rotting until
+        the cap."""
+        now = time.monotonic() if now is None else now
+        stale = [h for h, r in self._exports.items()
+                 if now - r["t"] > EXPORT_TTL]
+        for h in stale:
+            del self._exports[h]
+        if stale:
+            self.stats.record_kv_export_expired(len(stale))
+            self.stats.set_kv_exports_pending(len(self._exports))
+        dead = [h for h, t in self._exports_claimed.items()
+                if now - t > 2 * EXPORT_TTL]
+        for h in dead:
+            del self._exports_claimed[h]
+        return len(stale)
 
     def submit_imported(self, export, steps, temperature=0.0,
                         top_k=0, seed=None, stop_token=None,
@@ -1185,6 +1254,7 @@ class InferenceScheduler(Logger):
             self._admitting = []
             self._aux.clear()
             self._exports.clear()
+            self._exports_claimed.clear()
             self._queued_blocks = 0
         for _, _, fut in aux:
             if not fut.done():
@@ -1293,7 +1363,12 @@ class InferenceScheduler(Logger):
                         and not self._aux:
                     if self._draining:
                         self._drained.set()
-                    self._wake.wait()
+                    # parked KV exports keep a 1 s housekeeping tick
+                    # alive so their TTL is enforced even on an idle
+                    # prefill replica (no decode work ever wakes it)
+                    self._wake.wait(1.0 if self._exports else None)
+                    if self._exports:
+                        self._sweep_exports_locked()
                 if self._closed:
                     return
                 # the watchdog measures from here: one iteration =
@@ -1301,6 +1376,8 @@ class InferenceScheduler(Logger):
                 self._working = True
                 self._beat = time.monotonic()
                 self._expire_locked()
+                if self._exports:
+                    self._sweep_exports_locked()
                 admits = []
                 while self._queue and self._can_admit(
                         cache, self._queue[0]):
@@ -1865,16 +1942,20 @@ class InferenceScheduler(Logger):
         self._sync_kv_gauges(cache)
         now = time.monotonic()
         with self._lock:
-            stale = [h for h, r in self._exports.items()
-                     if now - r["t"] > EXPORT_TTL]
-            for h in stale:
-                del self._exports[h]
+            self._sweep_exports_locked(now)
+            capped = 0
             while len(self._exports) >= EXPORT_CAP:
                 # oldest unclaimed record pays for the cap
                 oldest = min(self._exports,
                              key=lambda h: self._exports[h]["t"])
                 del self._exports[oldest]
+                capped += 1
+            if capped:
+                # a cap eviction is an unfetched loss like an
+                # expiry, just paid early — same alertable series
+                self.stats.record_kv_export_expired(capped)
             self._exports[handle] = record
+            self.stats.set_kv_exports_pending(len(self._exports))
         if self._tron:
             reqtrace.record(
                 req.trace, "kv_export", tokens=p_len, blocks=n,

@@ -26,8 +26,10 @@ firing does not mask a second replica's.
 failure shapes: multi-window fast+slow SLO burn (the SRE Workbook
 pairing — both windows must burn before paging, so a blip neither
 pages nor hides a sustained burn), breaker open, health-policy halt,
-replica unreachable, KV block pressure, watchdog stalls, prefix-hit
-collapse, and bucket-padding waste ("busy but wasting its batches").
+replica unreachable, KV block pressure, unfetched KV-export expiry
+(a decode pool that stopped coming for its disaggregated handoffs),
+watchdog stalls, prefix-hit collapse, and bucket-padding waste
+("busy but wasting its batches").
 
 **Sinks** on every fire/resolve: the JSONL event ring
 (``alert.fire`` / ``alert.resolve``), the process log, the
@@ -269,6 +271,15 @@ def default_rules():
             expr="veles_serving_kv_pressure > 0.92",
             description="paged-KV pool >92% occupied — admissions "
                         "start shedding/preempting soon"),
+        AlertRule(
+            "kv_export_expiry", severity="ticket", for_seconds=0.0,
+            expr="increase(veles_serving_kv_export_expired_total)"
+                 " > 0",
+            description="disaggregated KV-export records are "
+                        "expiring unfetched — the decode pool is "
+                        "not coming for its handoffs (dead decode "
+                        "specialists, a partitioned router, or a "
+                        "role pool that emptied)"),
         AlertRule(
             "watchdog_stall", severity="page", for_seconds=0.0,
             expr="increase(veles_serving_watchdog_trips_total) > 0",
