@@ -1,0 +1,649 @@
+"""Fleet control-plane tier (``serving/controller.py`` +
+``tenant/admission.py``): the three FleetController decision loops
+(scale up on burn/queue, drain-then-retire scale down, role-ratio
+re-role, KV shed tuning) over stubbed observation seams AND a real
+fleet, ``Fleet.grow``/``retire``/``restart_as`` actuation, per-tenant
+admission (id resolution, bounded labels, token-bucket 429s, the
+weighted-fair concurrency lane), the dead-replica federation fix and
+the two new alert rules."""
+
+import asyncio
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from veles_tpu.config import root
+from tests.test_router import _get_json, _make_replica, _post
+
+pytestmark = pytest.mark.controller
+
+
+@pytest.fixture
+def f32():
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    yield
+    root.common.precision.compute_dtype = saved
+
+
+@pytest.fixture
+def knobs():
+    """Scratch controller/tenant config, restored afterward — every
+    test arms its own thresholds explicitly."""
+    saved_c = root.common.controller.__content__()
+    saved_t = root.common.tenant.__content__()
+    yield root.common
+    root.common.controller.update(saved_c)
+    root.common.tenant.update(saved_t)
+
+
+def _controller(router, fleet):
+    from veles_tpu.serving.controller import FleetController
+    return FleetController(router, fleet, interval=999)
+
+
+# -- stub seams (the unit half: every decision path, no sockets) --------------
+
+def _view(rid, **kw):
+    base = {"id": rid, "host": "127.0.0.1", "port": 1,
+            "healthy": True, "draining": False, "role": None,
+            "queue_depth": 0, "outstanding": 0, "active_slots": 0,
+            "max_slots": 2, "kv_blocks_used": 0,
+            "kv_blocks_free": 100}
+    base.update(kw)
+    return base
+
+
+class _StubRouter:
+    def __init__(self, views):
+        self.views = views
+        self.alerts = None
+        self.drained = []
+
+    def replica_state(self):
+        return {"replicas": [dict(v) for v in self.views]}
+
+    def drain_replica(self, rid):
+        self.drained.append(rid)
+
+
+class _StubFleet:
+    def __init__(self, roles=None):
+        self.roles = roles
+        self.grown = []
+        self.retired = []
+        self.reroled = []
+        self._indices = {}
+
+    def grow(self, role=None):
+        self.grown.append(role)
+        return 90 + len(self.grown)
+
+    def index_of(self, rid):
+        return self._indices.get(rid, int(rid[1:]))
+
+    def retire(self, index):
+        self.retired.append(index)
+        return "r%d" % index
+
+    def restart_as(self, index, role):
+        self.reroled.append((index, role))
+
+
+class _StubAlerts:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def firing(self):
+        return self.rows
+
+
+def test_controller_refuses_to_arm_unless_enabled(knobs):
+    from veles_tpu.serving.controller import FleetController
+    assert not FleetController.enabled()
+    ctl = _controller(_StubRouter([_view("r0")]), _StubFleet())
+    assert ctl.start()._thread is None
+    knobs.controller.enabled = True
+    assert FleetController.enabled()
+
+
+def test_scale_up_on_queue_depth_with_bounds_and_cooldown(knobs):
+    knobs.controller.update({
+        "queue_high": 2.0, "max_replicas": 2,
+        "scale_up_cooldown": 5.0})
+    router = _StubRouter([_view("r0", queue_depth=6)])
+    fleet = _StubFleet()
+    ctl = _controller(router, fleet)
+    rec = ctl.tick(now=100.0)
+    assert rec["action"] == "scale_up"
+    assert rec["reason"] == "queue_depth"
+    assert fleet.grown == [None]
+    # cooldown holds the second tick even though pressure persists
+    assert ctl.tick(now=102.0) is None
+    # and at max_replicas the loop never grows past the bound
+    router.views.append(_view("r1", queue_depth=6))
+    assert ctl.tick(now=200.0) is None
+    assert fleet.grown == [None]
+    assert ctl.audit()[-1] is rec
+
+
+def test_scale_up_on_slo_burn_pair(knobs):
+    knobs.controller.update({
+        "queue_high": 100.0, "max_replicas": 4,
+        "scale_up_cooldown": 0.0})
+    router = _StubRouter([_view("r0")])
+    router.alerts = _StubAlerts(
+        [{"rule": "slo_burn_page"}, {"rule": "slo_burn_ticket"},
+         {"rule": "breaker_open"}])
+    fleet = _StubFleet()
+    rec = _controller(router, fleet).tick(now=100.0)
+    assert rec["action"] == "scale_up"
+    assert rec["reason"] == "slo_burn"
+    assert rec["burn_rules"] == ["slo_burn_page",
+                                 "slo_burn_ticket"]
+    assert fleet.grown == [None]
+
+
+def test_scale_down_needs_quiet_ticks_then_drains(knobs):
+    knobs.controller.update({
+        "queue_high": 4.0, "min_replicas": 1, "quiet_ticks": 3,
+        "scale_down_cooldown": 0.0, "occupancy_low": 0.5})
+    # r1 carries less outstanding work: it is the victim; the stub
+    # views' port 1 is unreachable, so the drained-poll falls through
+    # to "replica already gone" and retire proceeds
+    router = _StubRouter([
+        _view("r0", outstanding=2, active_slots=1),
+        _view("r1", outstanding=0)])
+    fleet = _StubFleet()
+    ctl = _controller(router, fleet)
+    out = [ctl.tick(now=100.0 + i) for i in range(3)]
+    assert out[0] is None and out[1] is None
+    assert out[2]["action"] == "scale_down"
+    assert out[2]["replica"] == "r1"
+    assert router.drained == ["r1"]
+    assert fleet.retired == [1]
+    # a firing burn rule blocks the quiet counter entirely (with the
+    # fleet already at max_replicas so the burn can't scale up either)
+    knobs.controller.max_replicas = 2
+    router.alerts = _StubAlerts([{"rule": "slo_burn_page"}])
+    ctl2 = _controller(router, _StubFleet())
+    assert all(ctl2.tick(now=200.0 + i) is None for i in range(5))
+    assert ctl2._quiet == 0
+
+
+def test_scale_down_respects_min_replicas(knobs):
+    knobs.controller.update({
+        "quiet_ticks": 1, "min_replicas": 1,
+        "scale_down_cooldown": 0.0, "occupancy_low": 0.5})
+    fleet = _StubFleet()
+    ctl = _controller(_StubRouter([_view("r0")]), fleet)
+    assert all(ctl.tick(now=100.0 + i) is None for i in range(4))
+    assert fleet.retired == []
+
+
+def test_rerole_moves_ratio_within_deadband_guardrails(knobs):
+    knobs.controller.update({
+        "queue_high": 4.0, "role_deadband": 0.25,
+        "scale_up_cooldown": 0.0, "occupancy_low": 0.0})
+    views = [
+        _view("r0", role="prefill"),
+        _view("r1", role="prefill", outstanding=1),
+        _view("r2", role="decode", active_slots=2),
+        _view("r3", role="decode", active_slots=2)]
+    fleet = _StubFleet(roles=("prefill", "prefill", "decode",
+                              "decode"))
+    ctl = _controller(_StubRouter(views), fleet)
+    rec = ctl.tick(now=100.0)
+    # decode saturated (occupancy 1.0) vs idle prefill: the
+    # least-loaded prefill donor (r0) restarts into decode
+    assert rec["action"] == "rerole"
+    assert fleet.reroled == [(0, "decode")]
+    # inside the deadband: no action
+    views[2]["active_slots"] = views[3]["active_slots"] = 0
+    fleet2 = _StubFleet(roles=fleet.roles)
+    assert _controller(_StubRouter(views), fleet2) \
+        .tick(now=200.0) is None
+    assert fleet2.reroled == []
+    # a 1-member donor pool never donates (coverage guardrail)
+    solo = [_view("r0", role="prefill"),
+            _view("r1", role="decode", active_slots=2)]
+    fleet3 = _StubFleet(roles=("prefill", "decode"))
+    assert _controller(_StubRouter(solo), fleet3) \
+        .tick(now=300.0) is None
+    assert fleet3.reroled == []
+
+
+def test_kv_tune_tightens_then_relaxes_never_from_idle(knobs):
+    knobs.controller.update({
+        "queue_high": 100.0, "occupancy_low": 0.0,
+        "quiet_ticks": 99, "scale_up_cooldown": 0.0,
+        "kv_pressure_high": 0.8, "kv_pressure_low": 0.3,
+        "shed_step": 0.5, "shed_min": 1.0, "shed_max": 8.0})
+    views = [_view("r0", kv_blocks_used=90, kv_blocks_free=10)]
+    ctl = _controller(_StubRouter(views), _StubFleet())
+    tuned = []
+    ctl._tune_replica = lambda view, factor: tuned.append(
+        (view["id"], factor)) or True
+    ctl.tick(now=100.0)
+    # high pressure: tighten from the hi/2 default, and the sizing
+    # recommendation rides the audit trail
+    assert tuned == [("r0", 3.5)]
+    actions = [d["action"] for d in ctl.audit()]
+    assert "recommend_kv_blocks" in actions
+    assert "tune_shed" in actions
+    rec = [d for d in ctl.audit()
+           if d["action"] == "recommend_kv_blocks"][0]
+    assert rec["kv_blocks"] == 125
+    # low pressure relaxes the knob it previously tightened
+    views[0].update(kv_blocks_used=10, kv_blocks_free=90)
+    ctl.tick(now=200.0)
+    assert tuned[-1] == ("r0", 4.0)
+    # ...but an idle fleet that was NEVER tightened stays untouched
+    fresh = _controller(_StubRouter(views), _StubFleet())
+    fresh._tune_replica = lambda view, factor: tuned.append(
+        ("fresh", factor)) or True
+    fresh.tick(now=300.0)
+    assert not any(t[0] == "fresh" for t in tuned)
+
+
+# -- the real actuation path (grow / drain+retire / restart_as) ---------------
+
+def test_controller_scales_real_fleet_up_and_down(f32, knobs):
+    """One controller tick grows a REAL replica through
+    ``Fleet.grow`` (spawned, registered, healthy, serving); the calm
+    ticks that follow drain and retire it through the graceful
+    ``drain_replica`` → /healthz poll → ``Fleet.retire`` path, and
+    the monitor never respawns the retired index."""
+    from veles_tpu.serving import Fleet, Router
+    knobs.controller.update({
+        "queue_high": 0.0, "max_replicas": 2, "min_replicas": 1,
+        "scale_up_cooldown": 0.0, "scale_down_cooldown": 0.0,
+        "quiet_ticks": 1, "occupancy_low": 1.0})
+    router = Router(health_interval=0.1, health_timeout=5.0,
+                    request_timeout=60.0, retries=3,
+                    retry_delay=0.02, retry_cap=0.2).start()
+    counter = [0]
+
+    def spawn(index):
+        counter[0] += 1
+        return _make_replica("ctl-r%d-g%d" % (index, counter[0]),
+                             serving_warm_buckets=False,
+                             serving_block_size=4,
+                             serving_prefill_chunk=4)
+
+    fleet = Fleet(spawn, 1, router=router,
+                  monitor_interval=0.1).start()
+    ctl = _controller(router, fleet)
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if [r for r in router.replica_state()["replicas"]
+                    if r["healthy"]]:
+                break
+            time.sleep(0.05)
+        # queue_high 0.0 makes any queue "deep": one tick grows
+        rec = ctl.tick()
+        assert rec["action"] == "scale_up" and rec["index"] == 1
+        assert fleet.index_of(
+            fleet.handles()[1].replica_id) == 1
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            live = [r for r in router.replica_state()["replicas"]
+                    if r["healthy"]]
+            if len(live) == 2:
+                break
+            time.sleep(0.05)
+        assert len(live) == 2
+        _, out = _post(router.url, {"prompt": [3, 1, 4, 1],
+                                    "steps": 4, "seed": 0})
+        assert len(out["tokens"]) == 8
+        # flip to calm and let quiet_ticks=1 retire the idler
+        knobs.controller.queue_high = 100.0
+        down = None
+        deadline = time.monotonic() + 30
+        while down is None and time.monotonic() < deadline:
+            down = ctl.tick()
+            time.sleep(0.05)
+        assert down and down["action"] == "scale_down"
+        assert sorted(fleet.handles()) == [down["index"] ^ 1]
+        # the monitor must NOT resurrect a retired index
+        time.sleep(0.5)
+        assert sorted(fleet.handles()) == [down["index"] ^ 1]
+        _, out2 = _post(router.url, {"prompt": [3, 1, 4, 1],
+                                     "steps": 4, "seed": 0})
+        assert out2["tokens"] == out["tokens"]
+        assert [d["action"] for d in ctl.audit()] \
+            == ["scale_up", "scale_down"]
+        for handle in fleet.handles().values():
+            handle.api.scheduler_.check_kv()
+    finally:
+        fleet.stop()
+        router.stop()
+
+
+def test_rebalance_restores_coverage_only_controller_moves_ratio(
+        f32, knobs, spec_trained_chain):
+    """The division of labor over one trained chain:
+    ``Fleet.rebalance()`` is a COVERAGE pass — on a fleet where every
+    role is populated it must change nothing, however lopsided the
+    ratio — while the controller's re-role path (through
+    ``Fleet.restart_as``) is what moves proportions, and the reshaped
+    fleet still serves the disagg vertical bit-identically."""
+    from veles_tpu.backends import Device
+    from veles_tpu.restful_api import RESTfulAPI, RestfulLoader
+    from veles_tpu.serving import Fleet, LocalReplica, Router
+    fw, pattern = spec_trained_chain
+    wf = fw[0].workflow
+    dev = Device(backend="numpy")
+
+    def spawn(index, role):
+        loader = RestfulLoader(wf, sample_shape=(64,),
+                               minibatch_size=1, max_wait=10.0)
+        loader.initialize(device=dev)
+        api = RESTfulAPI(wf, loader=loader, forwards=fw,
+                         name="ratio-r%d" % index, max_slots=2,
+                         serving_warm_buckets=False,
+                         serving_block_size=4,
+                         serving_prefill_chunk=4,
+                         serving_role=role)
+        api.output = fw[-1].output
+        api.initialize()
+        return LocalReplica(api, loader)
+
+    router = Router(health_interval=0.1, health_timeout=5.0,
+                    request_timeout=60.0, retries=3,
+                    retry_delay=0.02, retry_cap=0.2).start()
+    fleet = Fleet(spawn, 3, router=router, monitor_interval=0.2,
+                  roles=("prefill", "prefill", "decode")).start()
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            roles = sorted(r["role"] or "" for r in
+                           router.replica_state()["replicas"]
+                           if r["healthy"])
+            if roles == ["decode", "prefill", "prefill"]:
+                break
+            time.sleep(0.05)
+        assert roles == ["decode", "prefill", "prefill"]
+        body = {"prompt": (pattern * 2)[:10], "steps": 6, "seed": 0}
+        _, want = _post(router.url, body)
+        # coverage pass on a fully-covered fleet: a strict no-op
+        before = {i: fleet.role_of(i) for i in fleet.handles()}
+        fleet.rebalance()
+        assert {i: fleet.role_of(i)
+                for i in fleet.handles()} == before
+        # the controller's ratio loop: decode pinned saturated vs
+        # idle prefill (observation stubbed, actuation REAL)
+        ctl = _controller(router, fleet)
+        knobs.controller.update({"role_deadband": 0.25,
+                                 "scale_up_cooldown": 0.0,
+                                 "queue_high": 100.0,
+                                 "occupancy_low": 0.0})
+        live = [r for r in router.replica_state()["replicas"]
+                if r["healthy"]]
+        for r in live:
+            if r["role"] == "decode":
+                r["active_slots"], r["max_slots"] = 2, 2
+        obs = {"live": live, "queue_mean": 0.0, "occupancy": 0.5,
+               "kv_pressure": 0.0, "kv_blocks_total": 0}
+        ctl._observe = lambda: obs
+        rec = ctl.tick()
+        assert rec["action"] == "rerole" and rec["role"] == "decode"
+        assert sorted(fleet.role_of(i)
+                      for i in fleet.handles()) \
+            == ["decode", "decode", "prefill"]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            roles = sorted(r["role"] or "" for r in
+                           router.replica_state()["replicas"]
+                           if r["healthy"])
+            if roles == ["decode", "decode", "prefill"]:
+                break
+            time.sleep(0.05)
+        assert roles == ["decode", "decode", "prefill"]
+        _, got = _post(router.url, body)
+        assert got["tokens"] == want["tokens"]
+        for handle in fleet.handles().values():
+            if handle is not None and handle.alive():
+                handle.api.scheduler_.check_kv()
+    finally:
+        fleet.stop()
+        router.stop()
+
+
+# -- per-tenant admission -----------------------------------------------------
+
+def test_resolve_tenant_identity():
+    from veles_tpu.tenant import resolve_tenant
+    # bearer hash: stable, opaque, never the raw credential
+    t = resolve_tenant({"authorization": "Bearer sk-secret-1"})
+    assert t.startswith("t-") and len(t) == 10
+    assert "secret" not in t
+    assert t == resolve_tenant({"authorization":
+                                "Bearer sk-secret-1"})
+    assert t != resolve_tenant({"authorization": "Bearer other"})
+    # the explicit header is honored on loopback only, sanitized
+    hdr = {"x-veles-tenant": "acme!corp//7"}
+    assert resolve_tenant(hdr, loopback=True) == "acme_corp__7"
+    assert resolve_tenant(hdr) == "anon"
+    assert resolve_tenant({}) == "anon"
+
+
+def test_tenant_label_cardinality_bounded(knobs):
+    from veles_tpu.tenant import TenantAdmission
+    knobs.tenant.update({"enabled": True, "label_cardinality": 3})
+    adm = TenantAdmission()
+    assert [adm.label("t%d" % i) for i in range(5)] \
+        == ["t0", "t1", "t2", "other", "other"]
+    assert adm.label("t1") == "t1"     # first-seen stays stable
+
+
+def test_tenant_token_bucket_and_lane_semantics(knobs):
+    from veles_tpu.tenant import TenantAdmission
+    knobs.tenant.update({"enabled": True, "rate": 2.0, "burst": 2.0,
+                         "max_concurrent": 1})
+    adm = TenantAdmission()
+    assert adm.throttle("a", now=100.0) is None
+    assert adm.throttle("a", now=100.0) is None
+    after = adm.throttle("a", now=100.0)   # burst spent
+    assert after is not None and 0 < after <= 2.0
+    assert adm.throttle("b", now=100.0) is None   # separate bucket
+    assert adm.throttle("a", now=101.0) is None   # refilled
+
+    async def lane():
+        assert await adm.acquire("a", 0.05) == "seat"
+        assert await adm.acquire("b", 0.05) == "seat"  # own lane
+        assert await adm.acquire("a", 0.05) is None    # lane full
+        adm.release("a")
+        assert await adm.acquire("a", 0.05) == "seat"
+        adm.release("a")
+        adm.release("b")
+    asyncio.run(lane())
+    knobs.tenant.enabled = False
+
+    async def disabled():
+        assert await adm.acquire("a", 0.05) == "free"
+    asyncio.run(disabled())
+
+
+def test_router_tenant_429_and_request_tagging(f32, knobs):
+    """The wire shape: an over-budget tenant gets a structured 429
+    with Retry-After while others sail through; every forwarded
+    request carries the bounded tenant label into
+    ``veles_router_requests_total``, the in-flight debug rows and
+    the replica-side queue trace."""
+    from veles_tpu.serving import Router
+    from veles_tpu.telemetry import metrics
+    # rate is deliberately glacial (one token per 50 s): the first
+    # request's COMPILE latency must not refill the bucket before the
+    # second request arrives
+    knobs.tenant.update({"enabled": True, "rate": 0.02, "burst": 1.0,
+                         "max_concurrent": 0,
+                         "label_cardinality": 8})
+    rep = _make_replica("ten-r0", serving_warm_buckets=False,
+                        serving_block_size=4,
+                        serving_prefill_chunk=4)
+    router = Router(health_interval=0.1, health_timeout=5.0,
+                    request_timeout=60.0, retries=3,
+                    retry_delay=0.02, retry_cap=0.2).start()
+    body = {"prompt": [3, 1, 4, 1], "steps": 4, "seed": 0}
+    try:
+        router.add_replica(rep.host, rep.port, replica_id="ten-r0")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if [r for r in router.replica_state()["replicas"]
+                    if r["healthy"]]:
+                break
+            time.sleep(0.05)
+        hdrs, out = _post(router.url, body,
+                          headers={"X-Veles-Tenant": "alice"})
+        assert len(out["tokens"]) == 8
+        # alice's burst is spent; the next request is a structured
+        # 429 with machine-readable backoff
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(router.url, body,
+                  headers={"X-Veles-Tenant": "alice"})
+        assert e.value.code == 429
+        assert float(e.value.headers["Retry-After"]) > 0
+        payload = json.loads(e.value.read().decode())
+        assert "alice" in payload["error"]["message"]
+        # bob is a different bucket: unaffected by alice's 429
+        _, out2 = _post(router.url, body,
+                        headers={"X-Veles-Tenant": "bob"})
+        assert out2["tokens"] == out["tokens"]
+        fam = metrics.get("veles_router_requests_total")
+        assert fam.labels(replica="ten-r0", outcome="ok",
+                          tenant="alice").value >= 1
+        assert fam.labels(replica="ten-r0", outcome="ok",
+                          tenant="bob").value >= 1
+        throttled = metrics.get(
+            "veles_router_tenant_throttled_total")
+        assert throttled.labels(tenant="alice").value >= 1
+        # the tenant travels: the replica's LIVE in-flight table rows
+        # carry the bounded label (a fresh tenant — bob's bucket is
+        # spent — posting enough steps to still be decoding while we
+        # peek)
+        # 4 prompt + 18 steps fits _make_replica's 24-token window
+        slow = dict(body, steps=18)
+        t = threading.Thread(
+            target=lambda: _post(router.url, slow,
+                                 headers={"X-Veles-Tenant": "carol"}),
+            daemon=True)
+        t.start()
+        rep_url = "http://%s:%d" % (rep.host, rep.port)
+        seen = False
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not seen:
+            rows = _get_json(rep_url, "/debug/requests")["requests"]
+            seen = any(r.get("tenant") == "carol" for r in rows)
+            time.sleep(0.005)
+        t.join(timeout=60)
+        assert seen
+    finally:
+        router.stop()
+        rep.stop()
+
+
+# -- satellite: a dead replica must leave the exposition ----------------------
+
+def test_dead_replica_leaves_federation_and_registry(f32):
+    """Health-failed replicas stop contributing their cached
+    ``last_scrape`` to ``GET /metrics/fleet`` (a dead replica's
+    final counters would otherwise be re-summed forever), and
+    deregistration clears every ``veles_serving_*{replica=...}``
+    child from the router-side registry."""
+    from veles_tpu.serving import Router
+    from veles_tpu.telemetry import metrics
+    rep = _make_replica("fed-r0", serving_warm_buckets=False,
+                        serving_block_size=4,
+                        serving_prefill_chunk=4)
+    router = Router(health_interval=0.1, health_timeout=0.5,
+                    request_timeout=60.0, retries=3,
+                    retry_delay=0.02, retry_cap=0.2).start()
+    try:
+        rid = router.add_replica(rep.host, rep.port,
+                                 replica_id="fed-r0")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            _, out = _post(router.url, {"prompt": [3, 1, 4, 1],
+                                        "steps": 2, "seed": 0})
+            fleet_text = urllib.request.urlopen(
+                router.url + "/metrics/fleet",
+                timeout=30).read().decode()
+            if 'replica="fed-r0"' in fleet_text:
+                break
+            time.sleep(0.1)
+        assert 'replica="fed-r0"' in fleet_text
+        # the replica dies; after >=2 failed probes its cached
+        # last_scrape must drop out of the merge — only the
+        # federation's OWN dead marker (veles_fleet_up 0) may still
+        # name the replica until deregistration
+        rep.stop()
+
+        def _stale_lines(text):
+            return [ln for ln in text.splitlines()
+                    if 'replica="fed-r0"' in ln
+                    and not ln.startswith("veles_fleet_up")]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            fleet_text = urllib.request.urlopen(
+                router.url + "/metrics/fleet",
+                timeout=30).read().decode()
+            if not _stale_lines(fleet_text):
+                break
+            time.sleep(0.1)
+        assert not _stale_lines(fleet_text)
+        assert 'veles_fleet_up{replica="fed-r0"} 0' in fleet_text
+        assert 'scrape_errors' in fleet_text
+        # deregistration sweeps the mirrored veles_serving_* children
+        gauge = metrics.gauge("veles_serving_goodput_ratio", "x",
+                              labelnames=("replica",))
+        gauge.labels(replica=rid).set(0.5)
+        router.remove_replica(rid)
+        assert not any(key == (rid,)
+                       for key in gauge.children())
+    finally:
+        router.stop()
+        rep.stop()
+
+
+# -- the new alert rules ------------------------------------------------------
+
+def test_controller_flapping_and_tenant_throttled_rules():
+    """Both PR 16 rules ship in ``default_rules()`` and their
+    expressions fire on the series the controller/admission lane
+    actually move (driven through a manual-tick engine)."""
+    from veles_tpu.telemetry.alerts import AlertEngine, \
+        default_rules
+    from veles_tpu.telemetry.registry import MetricsRegistry
+    rules = {r.name: r for r in default_rules()}
+    assert rules["controller_flapping"].severity == "ticket"
+    assert rules["tenant_throttled"].severity == "info"
+    reg = MetricsRegistry()
+    flaps = reg.counter("veles_controller_scale_transitions_total",
+                        "x")
+    shed = reg.counter("veles_router_tenant_throttled_total", "x",
+                       labelnames=("tenant",))
+    engine = AlertEngine(
+        name="ctl-rules", registry=reg, interval=999,
+        rules=[rules["controller_flapping"],
+               rules["tenant_throttled"]])
+    t0 = 100.0
+    shed.labels(tenant="mallory").inc()    # series must pre-exist:
+    # a rate/increase rule's first sight of a series only seeds its
+    # per-series memory
+    engine.tick(now=t0)                    # increase/rate baseline
+    flaps.inc(4)
+    shed.labels(tenant="mallory").inc(30)
+    assert engine.tick(now=t0 + 10) == []  # pending (hold-down)
+    flaps.inc(4)
+    shed.labels(tenant="mallory").inc(30)
+    fired = engine.tick(now=t0 + 20)
+    assert sorted(f[1].name for f in fired if f[0] == "fire") \
+        == ["controller_flapping", "tenant_throttled"]
+    names = {row["rule"] for row in engine.firing()}
+    assert names == {"controller_flapping", "tenant_throttled"}

@@ -338,6 +338,56 @@ root.common.update({
     # filling.  Off, a dead pool stays dead until a human re-roles
     # the fleet (the pre-rebalance behavior).
     "fleet": {"rebalance": True},
+    # fleet control plane (serving/controller.py): a FleetController
+    # loop on the router host closes three loops — replica count
+    # (scale up on the fast+slow SLO-burn pair or queue pressure,
+    # scale down via drain when both windows are quiet), the
+    # prefill:decode role ratio (prefill queue wait vs decode slot
+    # occupancy, moved through Fleet.restart_as), and KV knobs
+    # (shed_block_factor nudges via POST /serving/tune, kv_blocks
+    # recommendations as audit events only).  Off by default: the
+    # controller only ever acts when an operator arms it.
+    # Hysteresis: scale-up needs the burn pair OR mean queue depth
+    # >= queue_high; scale-down needs quiet_ticks consecutive calm
+    # ticks AND mean slot occupancy <= occupancy_low, and each
+    # direction honors its own cooldown.  role_deadband is the
+    # minimum normalized pressure gap before a re-role fires.
+    "controller": {
+        "enabled": False,
+        "interval": 2.0,
+        "min_replicas": 1,
+        "max_replicas": 4,
+        "scale_up_cooldown": 10.0,
+        "scale_down_cooldown": 30.0,
+        "quiet_ticks": 5,
+        "queue_high": 4.0,
+        "occupancy_low": 0.3,
+        "role_deadband": 0.25,
+        "kv_pressure_high": 0.85,
+        "kv_pressure_low": 0.5,
+        "shed_step": 0.5,
+        "shed_min": 1.0,
+        "shed_max": 8.0,
+        "audit_keep": 256,
+    },
+    # per-tenant admission economics (tenant/admission.py): the
+    # router resolves a tenant id from the auth header (hash of the
+    # bearer token, or X-Veles-Tenant on loopback) and tags every
+    # request with it; with enabled=True it also enforces a
+    # per-tenant token bucket (rate tokens/s, burst capacity;
+    # exceeding it is a structured 429 + Retry-After) and a
+    # weighted-fair concurrency lane (max_concurrent in-flight
+    # requests per tenant, 0 = no cap) so a flooding tenant degrades
+    # only itself.  label_cardinality bounds the metrics label: the
+    # first N distinct tenants keep their own label value, the rest
+    # report as "other".
+    "tenant": {
+        "enabled": False,
+        "rate": 0.0,
+        "burst": 0.0,
+        "max_concurrent": 0,
+        "label_cardinality": 8,
+    },
     # fault injection (veles_tpu/faults/): spec string parsed on first
     # fire(), same grammar as the VELES_FAULTS env var —
     # "point=action[:arg][@after][xtimes][~key];..." (empty = unarmed)

@@ -224,7 +224,7 @@ class RESTfulAPI(Unit):
 
     def _generate_scheduled(self, rows, steps, temperature, top_k,
                             seed, stop, priority=None, trace=None,
-                            resume_tokens=None):
+                            resume_tokens=None, tenant=None):
         """Decode a /generate body through the continuous-batching
         scheduler: every prompt row is its own request (ragged batches
         interleave in the slots like independent clients).  Returns
@@ -245,7 +245,7 @@ class RESTfulAPI(Unit):
                     seed=None if seed is None else int(seed) + i,
                     stop_token=stop, timeout=self.request_timeout,
                     priority=priority, trace=trace,
-                    resume_tokens=resume_tokens))
+                    resume_tokens=resume_tokens, tenant=tenant))
             # the scheduler enforces the deadline itself (408 with
             # partial-token count); the result wait is only a backstop
             # against a wedged loop with the watchdog disabled
@@ -351,10 +351,27 @@ class RESTfulAPI(Unit):
                         self.headers.get(reqtrace.TRACE_HEADER))
                 return tid
 
+            def _tenant(self):
+                """The request's resolved tenant id (cached like the
+                trace id): a loopback peer's ``X-Veles-Tenant`` is
+                trusted — the router forwards its bounded tenant
+                label that way — while a direct remote caller
+                resolves from its own bearer token."""
+                ten = getattr(self, "_tenant_", None)
+                if ten is None:
+                    from veles_tpu.tenant import resolve_tenant
+                    ten = self._tenant_ = resolve_tenant(
+                        {k.lower(): v
+                         for k, v in self.headers.items()},
+                        loopback=self.client_address[0] in
+                        ("127.0.0.1", "::1", "localhost"))
+                return ten
+
             def do_GET(self):
                 # drop any query string BEFORE trimming the trailing
                 # slash — load-balancer probes send /healthz?probe=1
                 self._trace_ = None  # fresh id per request
+                self._tenant_ = None
                 route = self.path.split("?")[0].rstrip("/")
                 if route == "/debug/requests":
                     # the LIVE in-flight request table: trace id,
@@ -646,7 +663,8 @@ class RESTfulAPI(Unit):
                         timeout=api.request_timeout,
                         priority=priority, stream=True,
                         trace=self._trace(),
-                        resume_tokens=resume)
+                        resume_tokens=resume,
+                        tenant=self._tenant())
                 except ValueError as e:
                     self.send_error(400, _status_text(e))
                     return
@@ -729,7 +747,8 @@ class RESTfulAPI(Unit):
                             stop_token=params["stop"],
                             timeout=api.request_timeout,
                             priority=params["priority"],
-                            stream=True, trace=self._trace())
+                            stream=True, trace=self._trace(),
+                            tenant=self._tenant())
                     except ValueError as e:
                         self.send_error(400, _status_text(e))
                         return
@@ -764,7 +783,8 @@ class RESTfulAPI(Unit):
                         rows, params["steps"], params["temperature"],
                         params["top_k"], params["seed"],
                         params["stop"], priority=params["priority"],
-                        trace=self._trace())
+                        trace=self._trace(),
+                        tenant=self._tenant())
                 except ValueError as e:
                     self.send_error(400, _status_text(e))
                     return
@@ -935,6 +955,7 @@ class RESTfulAPI(Unit):
 
             def do_POST(self):
                 self._trace_ = None  # fresh id per request
+                self._tenant_ = None
                 route = self.path.split("?")[0].rstrip("/")
                 if route in ("/serving/prefill",
                              "/serving/kv_import"):
@@ -977,6 +998,36 @@ class RESTfulAPI(Unit):
                             else None)
                     except Exception as e:
                         self.send_error(500, _status_text(e))
+                    return
+                if self.path.rstrip("/") == "/serving/tune":
+                    # the control plane's knob surface: the
+                    # FleetController nudges shed_block_factor here
+                    # under KV pressure.  Guarded like /drain — an
+                    # open tuner is a shed-policy bypass — and the
+                    # factor floors at 0.1 so no tune can disable
+                    # admission shedding outright.
+                    if not self._admin_ok():
+                        self.send_error(
+                            403, "tune needs loopback or the admin "
+                            "token")
+                        return
+                    if api.scheduler_ is None:
+                        self.send_error(
+                            501, "tune needs the serving scheduler")
+                        return
+                    try:
+                        body = self._read_body()
+                        factor = body.get("shed_block_factor")
+                        if factor is not None:
+                            api.scheduler_.shed_block_factor = \
+                                max(0.1, float(factor))
+                    except (TypeError, ValueError) as e:
+                        self.send_error(400, _status_text(e))
+                        return
+                    self._reply_json({
+                        "shed_block_factor":
+                            api.scheduler_.shed_block_factor,
+                        "kv_blocks": api.scheduler_.kv_blocks})
                     return
                 if self.path.rstrip("/") == "/shutdown":
                     # control-plane guard: when serving beyond loopback,
@@ -1239,7 +1290,8 @@ class RESTfulAPI(Unit):
                                     body.get("seed"), stop,
                                     priority=priority,
                                     trace=self._trace(),
-                                    resume_tokens=resume)
+                                    resume_tokens=resume,
+                                    tenant=self._tenant())
                             except ValueError as e:
                                 self.send_error(400, _status_text(e))
                                 return

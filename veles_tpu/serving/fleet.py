@@ -245,6 +245,16 @@ class Fleet(Logger):
             return self._role_of.get(
                 index, self.roles[index % len(self.roles)])
 
+    def index_of(self, replica_id):
+        """The fleet index currently serving router id
+        ``replica_id`` (None when unknown) — how the control plane
+        maps a router replica view back onto a fleet slot."""
+        with self._lock:
+            for index, rid in self._ids.items():
+                if rid == replica_id:
+                    return index
+        return None
+
     # -- spawning --------------------------------------------------------
 
     def _live_role_counts(self, exclude=None):
@@ -343,6 +353,101 @@ class Fleet(Logger):
             with self._lock:
                 self._busy.discard(victim)
         return victim
+
+    # -- control-plane actuation (FleetController's verbs) ---------------
+
+    def grow(self, role=None):
+        """Scale-up: spawn one NEW replica at the next free index
+        (optionally into ``role`` on a specialist fleet) and register
+        it for traffic.  Returns the new index.  ``n`` is a
+        high-water index bound, not a live count — indices are
+        identities (generations, roles) and are never reused by a
+        grow after a retire."""
+        with self._lock:
+            if self._stopping.is_set():
+                raise RuntimeError("fleet is stopping")
+            if role is not None:
+                if not self.roles:
+                    raise ValueError(
+                        "role=%r on a homogeneous fleet" % role)
+                if role not in ("prefill", "decode", "both"):
+                    raise ValueError(
+                        "roles must be prefill/decode/both, got %r"
+                        % role)
+            index = max(list(self._replicas) + [self.n - 1]) + 1
+            self.n = index + 1
+            if role is not None:
+                self._role_of[index] = role
+        self._spawn_one(index)
+        return index
+
+    def retire(self, index):
+        """Scale-down removal of replica ``index``: forget it FIRST
+        (so the monitor never respawns it), deregister from the
+        router, stop the handle.  The caller drains beforehand — the
+        controller's drain → poll-/healthz → retire path; retiring a
+        busy replica is the crash shape the router's retries absorb.
+        Returns the retired router id (None when the index was
+        unknown)."""
+        with self._lock:
+            if index in self._busy:
+                raise RuntimeError(
+                    "replica %d is mid-restart" % index)
+            handle = self._replicas.pop(index, None)
+            rid = self._ids.pop(index, None)
+            self._role_of.pop(index, None)
+            self._generation.pop(index, None)
+        if self.router is not None and rid is not None:
+            try:
+                self.router.remove_replica(rid)
+            except Exception:
+                pass
+        if handle is not None:
+            handle.stop()
+        self.info("replica %d (%s) retired", index, rid)
+        return rid
+
+    def restart_as(self, index, role):
+        """Load-driven re-roling (the controller's ratio loop):
+        restart live replica ``index`` into ``role`` through the
+        same spawn machinery a coverage rebalance uses.
+        :meth:`rebalance` only ever FILLS an empty pool; this moves
+        the prefill:decode RATIO on purpose.  Coverage still wins:
+        if the respawn finds some OTHER pool emptied meanwhile,
+        :meth:`_assign_role` may override the requested role."""
+        if not self.roles:
+            raise RuntimeError("restart_as needs a role-aware fleet")
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                "roles must be prefill/decode/both, got %r" % role)
+        with self._lock:
+            if index not in self._replicas:
+                raise KeyError("no replica %d" % index)
+            if index in self._busy:
+                raise RuntimeError(
+                    "replica %d is mid-restart" % index)
+            self._busy.add(index)
+            old = self._ids.get(index)
+            handle = self._replicas.get(index)
+        try:
+            self.warning("re-role: restarting replica %d (%s) as %s "
+                         "(controller ratio decision)", index, old,
+                         role)
+            if self.router is not None and old is not None:
+                try:
+                    self.router.remove_replica(old)
+                except Exception:
+                    pass
+            if handle is not None:
+                handle.stop()
+            with self._lock:
+                self._role_of[index] = role
+            _rebalance_metric().labels(role=role).inc()
+            self._spawn_one(index)
+        finally:
+            with self._lock:
+                self._busy.discard(index)
+        return index
 
     def _spawn_one(self, index):
         """Spawn replica ``index`` (next generation) and register it

@@ -393,8 +393,10 @@ def _router_series():
     return {
         "requests": metrics.counter(
             "veles_router_requests_total",
-            "forward attempts, by replica and outcome (ok/error)",
-            labelnames=("replica", "outcome")),
+            "forward attempts, by replica, outcome (ok/error) and "
+            "bounded tenant label (first-N distinct tenants keep "
+            "their own, the rest share \"other\")",
+            labelnames=("replica", "outcome", "tenant")),
         "retries": metrics.counter(
             "veles_router_retries_total",
             "forward attempts retried on another replica after a "
@@ -458,6 +460,27 @@ def _router_series():
     }
 
 
+def forget_serving_replica(replica):
+    """Drop every replica-labeled ``veles_serving_*`` child for one
+    replica id (goodput, padding efficiency, KV pressure, export
+    lifecycle, ...).  Walks the live registry rather than a fixed
+    family list, so ad-hoc serving gauges a replica mirrored in sweep
+    too; the label position is looked up per family, so multi-label
+    families (e.g. ``{dtype, replica}``) clean up as well.
+    Idempotent: families with no child for the id are untouched."""
+    replica = str(replica)
+    for name, fam in metrics.collect():
+        if not name.startswith("veles_serving_"):
+            continue
+        names = getattr(fam, "labelnames", ())
+        if "replica" not in names:
+            continue
+        idx = names.index("replica")
+        for key in list(fam.children()):
+            if key[idx] == replica:
+                fam.remove(*key)
+
+
 class RouterMetrics:
     """Thread-safe router counters, mirrored into the process-wide
     registry as the ``veles_router_*`` Prometheus families (same
@@ -485,7 +508,7 @@ class RouterMetrics:
         #: experiences, as opposed to the replica-side view
         self.slo = SLOTracker("router")
 
-    def record_forward(self, replica, ok):
+    def record_forward(self, replica, ok, tenant=None):
         outcome = "ok" if ok else "error"
         with self._lock:
             if ok:
@@ -493,7 +516,8 @@ class RouterMetrics:
             else:
                 self.requests_error += 1
         self._global["requests"].labels(
-            replica=str(replica), outcome=outcome).inc()
+            replica=str(replica), outcome=outcome,
+            tenant=str(tenant or "anon")).inc()
 
     def record_retry(self):
         with self._lock:
@@ -538,9 +562,14 @@ class RouterMetrics:
     def forget_replica(self, replica):
         """Drop a deregistered replica's labeled series so a removed
         replica neither exports stale state forever nor keeps a
-        resolved unreachable-alert series alive."""
+        resolved unreachable-alert series alive.  Router families
+        first, then every ``veles_serving_*{replica=...}`` child the
+        replica's own process mirrored into this registry (the
+        in-process LocalReplica shape) — a retired replica must not
+        leave frozen goodput/KV gauges on the exposition forever."""
         for name in ("replica_up", "breaker_state"):
             self._global[name].remove(str(replica))
+        forget_serving_replica(replica)
 
     def record_stream(self, replica):
         with self._lock:

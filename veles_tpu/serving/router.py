@@ -103,6 +103,7 @@ from veles_tpu.logger import Logger, events
 from veles_tpu.serving.metrics import RouterMetrics
 from veles_tpu.telemetry import reqtrace
 from veles_tpu.telemetry.spans import next_span_id
+from veles_tpu.tenant import TenantAdmission
 
 #: outcomes the router hands to the client as-is (2xx/3xx/4xx — the
 #: replica spoke; 5xx and transport errors are the router's to mask)
@@ -159,6 +160,12 @@ class _Replica(object):
             "consecutive_failures": self.failures,
             "queue_depth": (self.last_metrics or {}).get(
                 "queue_depth"),
+            # slot occupancy (the controller's scale-down and
+            # role-ratio signals read these off replica_state())
+            "active_slots": (self.last_metrics or {}).get(
+                "active_slots"),
+            "max_slots": (self.last_metrics or {}).get(
+                "max_slots"),
             "kv_blocks_used": (self.last_metrics or {}).get(
                 "kv_blocks_used"),
             "kv_blocks_free": (self.last_metrics or {}).get(
@@ -262,6 +269,10 @@ class Router(Logger):
             _router_conf("shed_retry_after", 2)
             if shed_retry_after is None else shed_retry_after)
         self.stats = RouterMetrics()
+        #: per-tenant identity + admission (tenant/admission.py):
+        #: tagging is always on, the bucket/lane enforce only when
+        #: root.common.tenant.enabled
+        self.tenants = TenantAdmission()
         #: the router-tier alert engine (telemetry/alerts.py),
         #: created at start() when root.common.alerts.enabled
         self.alerts = None
@@ -564,7 +575,8 @@ class Router(Logger):
                 rep, method, path,
                 raw if method == "POST" else None,
                 {k: v for k, v in headers.items()
-                 if k in ("x-veles-session", "x-veles-trace")})
+                 if k in ("x-veles-session", "x-veles-trace",
+                          "x-veles-tenant")})
 
         span = None
         if self._tron and trace is not None:
@@ -621,7 +633,9 @@ class Router(Logger):
                 except ValueError:
                     after = 1.0
                 rep.saturated_until = now + min(after, 5.0)
-        self.stats.record_forward(rep.id, out.deliverable)
+        self.stats.record_forward(rep.id, out.deliverable,
+                                  tenant=headers.get(
+                                      "x-veles-tenant"))
         return out
 
     async def _attempt_hedged(self, rep, raw, headers, timeout,
@@ -678,17 +692,19 @@ class Router(Logger):
         t0 = time.monotonic()
         deadline = t0 + self.request_timeout
         idempotent, affinity, _, cls = self._inspect(raw, headers)
+        tenant = headers.get("x-veles-tenant")
         if method == "GET":
             idempotent = True
         root_span = None
         if self._tron and trace is not None:
             root_span = next_span_id()
             events.record("router.request", "begin", cls="Router",
-                          span=root_span, trace=trace, path=path)
+                          span=root_span, trace=trace, path=path,
+                          tenant=tenant)
         seq = next(self._req_seq)
         info = {"trace": trace, "path": path, "t0": t0,
                 "attempts": 0, "replica": None, "stream": False,
-                "cls": cls}
+                "cls": cls, "tenant": tenant}
         self._inflight[seq] = info
         try:
             return await self._forward_attempts(
@@ -699,6 +715,7 @@ class Router(Logger):
             if root_span is not None:
                 events.record("router.request", "end", cls="Router",
                               span=root_span, trace=trace, path=path,
+                              tenant=tenant,
                               duration=time.monotonic() - t0,
                               attempts=info["attempts"])
 
@@ -838,18 +855,20 @@ class Router(Logger):
         t0 = time.monotonic()
         deadline = t0 + self.request_timeout
         _, affinity, _, cls = self._inspect(raw, headers)
+        tenant = headers.get("x-veles-tenant")
         fwd = {k: v for k, v in headers.items()
-               if k in ("x-veles-session", "x-veles-trace")}
+               if k in ("x-veles-session", "x-veles-trace",
+                        "x-veles-tenant")}
         root_span = None
         if self._tron and trace is not None:
             root_span = next_span_id()
             events.record("router.request", "begin", cls="Router",
                           span=root_span, trace=trace, path=path,
-                          stream=True)
+                          stream=True, tenant=tenant)
         seq = next(self._req_seq)
         info = {"trace": trace, "path": path, "t0": t0,
                 "attempts": 0, "replica": None, "stream": True,
-                "cls": cls}
+                "cls": cls, "tenant": tenant}
         self._inflight[seq] = info
         try:
             await self._stream_attempts(
@@ -860,7 +879,7 @@ class Router(Logger):
             if root_span is not None:
                 events.record("router.request", "end", cls="Router",
                               span=root_span, trace=trace, path=path,
-                              stream=True,
+                              stream=True, tenant=tenant,
                               duration=time.monotonic() - t0,
                               attempts=info["attempts"])
 
@@ -1169,11 +1188,13 @@ class Router(Logger):
                 raise
             except Exception:
                 self._breaker_failure(rep)
-                self.stats.record_forward(rep.id, False)
+                self.stats.record_forward(
+                    rep.id, False, tenant=fwd.get("x-veles-tenant"))
                 return ("retry", (502, b""))
             if status >= 500 and status != 503:
                 self._breaker_failure(rep)
-                self.stats.record_forward(rep.id, False)
+                self.stats.record_forward(
+                    rep.id, False, tenant=fwd.get("x-veles-tenant"))
                 body = b""
                 if upstream is not None:
                     try:
@@ -1184,7 +1205,8 @@ class Router(Logger):
                 return ("retry", (status, body))
             # the replica spoke: liveness proven (503 included)
             self._breaker_success(rep)
-            self.stats.record_forward(rep.id, True)
+            self.stats.record_forward(
+                rep.id, True, tenant=fwd.get("x-veles-tenant"))
             if status == 503:
                 try:
                     after = float(rheaders.get("retry-after", 1))
@@ -1265,6 +1287,7 @@ class Router(Logger):
             "attempts": info["attempts"],
             "replica": info["replica"],
             "stream": info["stream"], "cls": info["cls"],
+            "tenant": info.get("tenant"),
         } for info in self._inflight.values()]
 
     def debug_requests(self, timeout=2.0):
@@ -1319,6 +1342,11 @@ class Router(Logger):
                               "rotation", rep.id)
                 rep.healthy = False
                 rep.status = "unreachable"
+                # the cached exposition text is stale the moment the
+                # replica is unreachable: without this the federated
+                # merge keeps summing a DEAD replica's final counters
+                # until something else overwrites last_scrape
+                rep.scrape_failed = True
                 self.stats.record_replica_up(rep.id, False)
             return
         rep.health_failures = 0
@@ -1666,22 +1694,62 @@ class Router(Logger):
             trace = reqtrace.ensure_trace_id(
                 headers.get("x-veles-trace"))
             headers["x-veles-trace"] = trace
-            if method == "POST" and path in self.FORWARD_POSTS \
-                    and self._inspect(body, headers)[2]:
-                # SSE streaming: the proxy writes the whole client
-                # response itself (headers relay chunk by chunk;
-                # first forwarded byte pins the replica)
-                await self._stream_proxy(path, headers, body, writer,
-                                         trace=trace)
-                return
+            # tenant identity at the edge: EVERY request is resolved
+            # and tagged (the forwarded x-veles-tenant header is the
+            # bounded label — replica spans and metrics then agree
+            # with the router's); the token bucket and the fair lane
+            # judge only the forwarded data-plane POSTs
+            peer = writer.get_extra_info("peername")
+            raw_tenant = self.tenants.tag(
+                headers, loopback=bool(peer)
+                and peer[0] in ("127.0.0.1", "::1", "localhost"))
+            tenant = headers["x-veles-tenant"]
+            reply = None
+            seat = None
+            if method == "POST" and path in self.FORWARD_POSTS:
+                after = self.tenants.throttle(raw_tenant)
+                if after is not None:
+                    reply = self._error(
+                        429, "tenant %s over its rate limit"
+                        % tenant, retry_after=after, tenant=tenant,
+                        trace=trace)
+                else:
+                    # the weighted-fair lane: the wait happens in the
+                    # TENANT'S own queue — other tenants' traffic
+                    # never sits behind it
+                    seat = await self.tenants.acquire(
+                        raw_tenant, self.request_timeout)
+                    if seat is None:
+                        reply = self._error(
+                            429, "tenant %s concurrency lane stayed "
+                            "full" % tenant,
+                            retry_after=self.shed_retry_after,
+                            tenant=tenant, trace=trace)
             try:
-                status, rheaders, rbody = await self._route(
-                    method, path, headers, body, trace=trace)
-            except asyncio.CancelledError:
-                raise
-            except Exception as e:  # the router must outlive any bug
-                status, rheaders, rbody = self._error(
-                    500, "router error: %r" % (e,), trace=trace)
+                if reply is None and method == "POST" \
+                        and path in self.FORWARD_POSTS \
+                        and self._inspect(body, headers)[2]:
+                    # SSE streaming: the proxy writes the whole
+                    # client response itself (headers relay chunk by
+                    # chunk; first forwarded byte pins the replica)
+                    await self._stream_proxy(path, headers, body,
+                                             writer, trace=trace)
+                    return
+                if reply is None:
+                    try:
+                        reply = await self._route(
+                            method, path, headers, body, trace=trace)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        # the router must outlive any bug
+                        reply = self._error(
+                            500, "router error: %r" % (e,),
+                            trace=trace)
+            finally:
+                if seat == "seat":
+                    self.tenants.release(raw_tenant)
+            status, rheaders, rbody = reply
             rheaders.setdefault("X-Veles-Trace", trace)
             reason = {200: "OK", 202: "Accepted"}.get(status, "X")
             out = ["HTTP/1.1 %d %s" % (status, reason),

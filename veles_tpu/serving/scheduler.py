@@ -301,10 +301,11 @@ class _Request(object):
                  "t_admit", "t_first", "pf_seq", "pf_caches",
                  "pf_off", "pf_width", "pf_chunk", "pf_matched",
                  "prefix_handle", "priority", "sink", "trace",
-                 "export_only", "kv_import")
+                 "tenant", "export_only", "kv_import")
 
     def __init__(self, prompt, steps, temperature, top_k, stop_token,
-                 seed, deadline, priority=1, sink=None, trace=None):
+                 seed, deadline, priority=1, sink=None, trace=None,
+                 tenant=None):
         self.prompt = prompt
         self.steps = steps
         self.temperature = temperature
@@ -315,6 +316,7 @@ class _Request(object):
         self.priority = int(priority)   # 0 low / 1 normal / 2 high
         self.sink = sink                # TokenStream._push (or None)
         self.trace = trace              # request trace id (reqtrace)
+        self.tenant = tenant            # bounded tenant label (or None)
         self.future = concurrent.futures.Future()
         self.slot = None
         self.generated = []
@@ -596,7 +598,7 @@ class InferenceScheduler(Logger):
     def submit(self, prompt, steps, temperature=0.0, top_k=0,
                seed=None, stop_token=None, timeout=None,
                priority=None, stream=False, trace=None,
-               resume_tokens=None):
+               resume_tokens=None, tenant=None):
         """Queue one sequence for decoding; returns a Future whose
         result is the full token list (prompt + generated, ending at
         the first generated stop token if one fired).  ``timeout``
@@ -682,7 +684,8 @@ class InferenceScheduler(Logger):
             int(seed) & 0xFFFFFFFF,
             time.monotonic() + ttl if ttl > 0 else None,
             priority=prio, sink=ts._push if ts is not None else None,
-            trace=trace)
+            trace=trace,
+            tenant=str(tenant) if tenant is not None else None)
         if resume:
             # the failover-resume lane rides the preempt→resume
             # machinery: the adopted prefix re-prefills with the
@@ -1201,6 +1204,7 @@ class InferenceScheduler(Logger):
                 "trace": req.trace,
                 "phase": phase,
                 "cls": CLASS_NAMES[req.priority],
+                "tenant": req.tenant,
                 "age_s": round(now - req.t_submit, 3),
                 "prompt_tokens": len(req.prompt),
                 "tokens": len(req.generated),
@@ -1703,6 +1707,7 @@ class InferenceScheduler(Logger):
                 req.trace, "queue",
                 duration=req.t_admit - req.t_submit,
                 cls=CLASS_NAMES[req.priority],
+                tenant=req.tenant,
                 resume=bool(req.preempts))
             reqtrace.record(
                 req.trace, "admit", slot=req.slot, tokens=p_len,
@@ -1898,7 +1903,8 @@ class InferenceScheduler(Logger):
             reqtrace.record(
                 req.trace, "queue",
                 duration=req.t_admit - req.t_submit,
-                cls=CLASS_NAMES[req.priority], resume=False)
+                cls=CLASS_NAMES[req.priority],
+                tenant=req.tenant, resume=False)
             reqtrace.record(
                 req.trace, "kv_import", slot=req.slot,
                 tokens=int(imp["length"]), blocks=len(ids))

@@ -298,6 +298,25 @@ def default_rules():
             expr="veles_serving_bucket_padding_efficiency < 0.35",
             description="the fleet is busy but wasting its batches: "
                         "most padded positions carry no request"),
+        AlertRule(
+            "controller_flapping", severity="ticket",
+            for_seconds=5.0,
+            expr="increase(veles_controller_scale_transitions_total)"
+                 " > 2",
+            description="the fleet controller is scaling up AND down "
+                        "inside one evaluation window — its "
+                        "thresholds/cooldowns are mis-tuned and "
+                        "replicas are churning instead of serving"),
+        AlertRule(
+            "tenant_throttled", severity="info",
+            for_seconds=5.0,
+            expr="rate(veles_router_tenant_throttled_total) > 1",
+            description="a tenant is being 429'd at a sustained "
+                        "rate (token bucket or concurrency lane) — "
+                        "either a flood the lane is correctly "
+                        "containing, or a limit set too tight for a "
+                        "legitimate client (per-series: one state "
+                        "machine per bounded tenant label)"),
     ]
 
 
