@@ -2760,6 +2760,110 @@ def main():
     return 0
 
 
+def bench_tsdb(dev):
+    """Observability-memory numbers (``veles_tpu/telemetry/tsdb.py``
+    + the PR 17 per-tenant metering):
+
+    - ``tsdb_sample_overhead_us`` — mean wall time of ONE store
+      sampling pass over the live registry a real serving soak just
+      populated (the recurring cost every process pays at the tier-0
+      step);
+    - ``tsdb_query_p95_us`` — p95 wall time of a windowed
+      ``range()`` query across a mix of series and aggregates
+      (avg/max/p95/rate/last — the dashboard + alert-grammar read
+      path);
+    - ``tenant_metering_overhead_pct`` — metering-on vs metering-off
+      scheduler soak delta (the per-step token/residency attribution
+      is default-ON, so its cost rides every decode step)."""
+    from veles_tpu.config import root
+    from veles_tpu.serving import InferenceScheduler
+    from veles_tpu.telemetry.registry import nearest_rank
+    from veles_tpu.telemetry.tsdb import TimeSeriesStore
+
+    cpu = dev.jax_device.platform == "cpu"
+    if cpu:
+        d_model, layers, heads, vocab, window = 64, 2, 2, 256, 128
+        steps, clients = 8, 4
+    else:
+        d_model, layers, heads, vocab, window = 1024, 8, 8, 32768, 512
+        steps, clients = 64, 8
+    fw = _serving_chain(dev, d_model, layers, heads, vocab, window,
+                        "bench-tsdb")
+    prompt = numpy.random.default_rng(0).integers(
+        0, vocab, (16,)).tolist()
+
+    def soak(sch, reps=1):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            futs = [sch.submit(prompt[: 4 + 3 * (i % 4)], steps,
+                               seed=i, tenant="bench-t%d" % (i % 2))
+                    for i in range(clients)]
+            for f in futs:
+                f.result(600)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    made = [0]
+
+    def timed_soak(metering):
+        """Best-of-3 soak on a fresh scheduler with metering
+        on/off — the knob is read at construction."""
+        made[0] += 1
+        root.common.tsdb.metering = metering
+        sch = InferenceScheduler(fw, max_slots=4, window=window,
+                                 max_queue=2 * clients,
+                                 queue_timeout=600.0,
+                                 warm_buckets=False,
+                                 replica_id="bench-tsdb-%d"
+                                 % made[0]).start()
+        try:
+            sch.submit(prompt, steps).result(600)   # compile+settle
+            return soak(sch, reps=3)
+        finally:
+            sch.close()
+
+    saved_metering = root.common.tsdb.get("metering", True)
+    try:
+        # alternating A/B rounds: best-of-each-arm cancels the
+        # run-order drift a single on-then-off pass bakes in
+        t_off = timed_soak(False)
+        t_on = timed_soak(True)
+        t_off = min(t_off, timed_soak(False))
+        t_on = min(t_on, timed_soak(True))
+    finally:
+        root.common.tsdb.metering = saved_metering
+    # sampling cost over the REAL registry the soaks populated
+    store = TimeSeriesStore(name="bench", interval=3600)
+    store.sample()   # settle series creation
+    n, t0 = 200, time.perf_counter()
+    for _ in range(n):
+        store.sample()
+    sample_us = (time.perf_counter() - t0) / n * 1e6
+    # query cost across the read-path aggregate mix
+    names = [s for s in store.series_names()
+             if s.startswith("veles_")][:8] or ["veles_none"]
+    aggs = ("avg", "max", "p95", "rate", "last")
+    times = []
+    for i in range(300):
+        name, agg = names[i % len(names)], aggs[i % len(aggs)]
+        t0 = time.perf_counter()
+        store.range(name, window=60.0, agg=agg)
+        times.append((time.perf_counter() - t0) * 1e6)
+    query_p95_us = nearest_rank(sorted(times), 0.95)
+    return {
+        "tsdb_sample_overhead_us": round(sample_us, 1),
+        "tsdb_query_p95_us": round(query_p95_us, 1),
+        "tenant_metering_overhead_pct":
+            round(max(0.0, (t_on - t_off) / t_off) * 100.0, 2),
+        "tsdb_config": {
+            "d_model": d_model, "layers": layers, "steps": steps,
+            "clients": clients, "samples_timed": n,
+            "queries_timed": len(times),
+            "series_sampled": store.stats()["series"]},
+    }
+
+
 def _main_standalone(bench_fn, source_key, source_note):
     """Run ONE subsystem bench and merge its keys into the existing
     BENCH.json (the PR5 precedent: a standalone subsystem run, other
@@ -2867,6 +2971,15 @@ def main_controller():
         "carried")
 
 
+def main_tsdb():
+    """``python bench.py tsdb`` — the time-series-store sampling /
+    query cost and tenant-metering overhead bench alone."""
+    return _main_standalone(
+        bench_tsdb, "tsdb_bench_source",
+        "PR17 standalone tsdb/metering bench run; other entries "
+        "carried")
+
+
 if __name__ == "__main__":
     sys.exit(main_router() if "router" in sys.argv[1:]
              else main_spec() if "spec" in sys.argv[1:]
@@ -2876,4 +2989,5 @@ if __name__ == "__main__":
              else main_alerts() if "alerts" in sys.argv[1:]
              else main_failover() if "failover" in sys.argv[1:]
              else main_controller() if "controller" in sys.argv[1:]
+             else main_tsdb() if "tsdb" in sys.argv[1:]
              else main())

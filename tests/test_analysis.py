@@ -421,6 +421,45 @@ b = metrics.counter("veles_x_total", "x",
     assert "M502" not in codes_of(scan(tmp_path, {"m.py": ok}))
 
 
+def test_m503_unbounded_tenant_label(tmp_path):
+    """A tenant-labeled family in a module with no `.label(...)` call
+    fires M503; the twin that routes ids through the bounder is
+    quiet."""
+    bad = """\
+from veles_tpu.telemetry import metrics
+
+c = metrics.counter("veles_tenant_x_total", "x",
+                    labelnames=("tenant",))
+
+def record(tenant, n):
+    c.labels(tenant=tenant).inc(n)
+"""
+    f = [x for x in scan(tmp_path, {"m.py": bad})
+         if x.code == "M503"]
+    assert {x.detail for x in f} == {"veles_tenant_x_total"}
+    # the clean twin: same family, but ids fold through the
+    # admission-layer cardinality bounder before becoming labels
+    ok = """\
+from veles_tpu.telemetry import metrics
+from veles_tpu.tenant.admission import TenantAdmission
+
+_bounder = TenantAdmission()
+c = metrics.counter("veles_tenant_x_total", "x",
+                    labelnames=("tenant",))
+
+def record(tenant, n):
+    c.labels(tenant=_bounder.label(tenant)).inc(n)
+"""
+    assert "M503" not in codes_of(scan(tmp_path, {"m.py": ok}))
+    # families without a tenant label never trigger, bounder or not
+    other = """\
+from veles_tpu.telemetry import metrics
+
+c = metrics.counter("veles_x_total", "x", labelnames=("replica",))
+"""
+    assert "M503" not in codes_of(scan(tmp_path, {"m.py": other}))
+
+
 # -- F-series ----------------------------------------------------------------
 
 def test_f601_undocumented_fire_point(tmp_path):
@@ -527,7 +566,7 @@ def test_package_scans_clean_under_strict_and_fast():
 def test_every_code_has_a_registered_pass():
     assert {"D101", "D102", "D103", "T201", "T202", "T203", "T204",
             "L301", "L302", "C401", "C402",
-            "M501", "M502", "F601", "F602"} == set(ALL_CODES)
+            "M501", "M502", "M503", "F601", "F602"} == set(ALL_CODES)
 
 
 def test_cli_json_smoke_and_no_jax_import():

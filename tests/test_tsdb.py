@@ -1,0 +1,660 @@
+"""The observability plane's memory (PR 17): tsdb ring/tier math
+(exact counter rates across tier boundaries, nearest-rank quantiles,
+byte-budget eviction, reset clamping), ``GET /metrics/history`` on
+replica and router (with fleet-history continuity across replica
+churn), the ``/tenants/usage`` metering rollup equality, trend-aware
+alert rules, controller history windows, the prefix-hit-rate
+no-sample regression, flight-recorder history embedding, dashboard
+sparklines, and the store-on overhead gate."""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from veles_tpu.config import root
+from veles_tpu.telemetry.registry import metrics, nearest_rank
+from veles_tpu.telemetry.tsdb import (
+    TimeSeriesStore, bundle_history, history_query)
+
+pytestmark = pytest.mark.tsdb
+
+
+@pytest.fixture
+def f32():
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    yield
+    root.common.precision.compute_dtype = saved
+
+
+@pytest.fixture
+def fast_tiers():
+    """Sub-second sampling so endpoint tests converge in seconds
+    instead of minutes, restored afterward."""
+    saved = root.common.tsdb.__content__()
+    root.common.tsdb.tiers = ((0.25, 30.0), (2.0, 240.0))
+    yield
+    root.common.tsdb.update(saved)
+
+
+def _serve(handler_cls):
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler_cls)
+    threading.Thread(target=server.serve_forever,
+                     daemon=True).start()
+    return server, server.server_address[1]
+
+
+def _get(url, timeout=10):
+    resp = urllib.request.urlopen(url, timeout=timeout)
+    return resp.status, resp.read().decode()
+
+
+def _fam(name, value, kind="gauge", labels=None, suffix=""):
+    """One single-sample family in the collect_families shape."""
+    return [{"name": name, "type": kind, "help": "",
+             "samples": [(suffix, labels or {}, value)]}]
+
+
+def _store(**kw):
+    kw.setdefault("name", "t-%d" % id(kw))
+    kw.setdefault("max_series", 64)
+    return TimeSeriesStore(**kw)
+
+
+# -- ring/tier math -----------------------------------------------------------
+
+def test_counter_rate_exact_across_tier_boundaries():
+    """Buckets hold DELTAS, so sum(deltas)/window is the same exact
+    rate at every tier — the coarse tier reconstructs precisely what
+    the fine tier measured, never a resampled approximation."""
+    st = _store(tiers=((1.0, 60.0), (10.0, 600.0)))
+    for i in range(31):   # +3/s cumulative counter, t=100..130
+        st.sample(now=100.0 + i, families=_fam(
+            "veles_t_total", 300.0 + 3.0 * i, kind="counter"))
+    for tier in (0, 1):
+        rate = st.range("veles_t_total", window=30.0, agg="rate",
+                        now=130.0, tier=tier)
+        # first sight is delta 0, every later sample lands +3:
+        # 90 increase over the 30 s window at BOTH tiers
+        assert rate == pytest.approx(90.0 / 30.0)
+    # a window past tier-0 retention auto-selects tier 1 and still
+    # answers from the same deltas
+    assert st.tier_for(200.0) == 1
+    assert st.range("veles_t_total", window=200.0, agg="rate",
+                    now=130.0) == pytest.approx(90.0 / 200.0)
+    assert st.range("veles_t_total", window=30.0, agg="sum",
+                    now=130.0, tier=1) == pytest.approx(90.0)
+
+
+def test_counter_reset_clamps_to_zero_delta():
+    """A replica respawn resets its counter — the store records
+    delta 0 for that sample, never a negative spike, and the rate
+    stays >= 0."""
+    st = _store(tiers=((1.0, 60.0),))
+    for t, v in ((100.0, 50.0), (101.0, 60.0), (102.0, 4.0),
+                 (103.0, 9.0)):
+        st.sample(now=t, families=_fam("veles_t_total", v,
+                                       kind="counter"))
+    pts = st.points("veles_t_total", window=10.0, now=103.0, tier=0)
+    assert [v for _, v in pts] == [0.0, 10.0, 0.0, 5.0]
+    assert st.range("veles_t_total", window=10.0, agg="rate",
+                    now=103.0) == pytest.approx(15.0 / 10.0)
+
+
+def test_gauge_aggregates_and_quantiles_match_nearest_rank():
+    st = _store(tiers=((1.0, 600.0),))
+    vals = [float(v) for v in (7, 1, 9, 4, 2, 8, 3, 6, 5, 10)]
+    for i, v in enumerate(vals):
+        st.sample(now=100.5 + i, families=_fam("veles_t_g", v))
+    kw = dict(window=60.0, now=110.0)
+    assert st.range("veles_t_g", agg="avg", **kw) \
+        == pytest.approx(sum(vals) / len(vals))
+    assert st.range("veles_t_g", agg="min", **kw) == 1.0
+    assert st.range("veles_t_g", agg="max", **kw) == 10.0
+    assert st.range("veles_t_g", agg="last", **kw) == 10.0
+    for q in (0.5, 0.95, 0.99):
+        assert st.range("veles_t_g", agg="p%d" % int(q * 100), **kw) \
+            == nearest_rank(sorted(vals), q)
+        assert st.range("veles_t_g", agg=q, **kw) \
+            == nearest_rank(sorted(vals), q)
+    # deriv: per-second slope first -> last bucket
+    assert st.range("veles_t_g", agg="deriv", **kw) \
+        == pytest.approx((10.0 - 7.0) / 9.0)
+    # no data in window -> None; unknown agg -> ValueError
+    assert st.range("veles_t_g", window=60.0, now=9999.0) is None
+    with pytest.raises(ValueError):
+        st.range("veles_t_g", agg="bogus", **kw)
+
+
+def test_histogram_buckets_skipped_sum_count_kept():
+    """``_bucket`` samples (le-cardinality) never land in a ring;
+    ``_sum``/``_count`` ride as monotone series so rate queries over
+    histograms still work.  NaN never lands either."""
+    st = _store(tiers=((1.0, 60.0),))
+    fams = [{"name": "veles_t_ms", "type": "histogram", "help": "",
+             "samples": [("_bucket", {"le": "10"}, 2.0),
+                         ("_bucket", {"le": "+Inf"}, 3.0),
+                         ("_sum", {}, 45.5), ("_count", {}, 3.0)]}]
+    st.sample(now=100.0, families=fams)
+    st.sample(now=101.0, families=_fam("veles_t_nan", float("nan")))
+    names = st.series_names()
+    assert "veles_t_ms_sum" in names and "veles_t_ms_count" in names
+    assert not any("_bucket" in n for n in names)
+    assert "veles_t_nan" not in names
+
+
+def test_bounds_eviction_never_exceeds_byte_budget():
+    from veles_tpu.telemetry.tsdb import POINT_BYTES
+    st = _store(tiers=((1.0, 4.0),), max_series=64,
+                max_bytes=10 * POINT_BYTES)
+    for i in range(12):
+        fams = []
+        for s in range(6):
+            fams.extend(_fam("veles_t_b%d" % s, float(i)))
+        st.sample(now=100.0 + i, families=fams)
+        assert st.bytes_used() <= st.max_bytes
+    assert st.evicted_series > 0
+    # max_series: later arrivals are counted, never stored
+    st2 = _store(tiers=((1.0, 60.0),), max_series=3)
+    fams = []
+    for s in range(5):
+        fams.extend(_fam("veles_t_c%d" % s, 1.0))
+    st2.sample(now=100.0, families=fams)
+    assert len(st2.series_names()) == 3
+    assert st2.dropped_series == 2
+    assert st2.stats()["dropped_series"] == 2
+
+
+def test_history_query_parsing_and_errors():
+    st = _store(tiers=((1.0, 60.0), (10.0, 600.0)))
+    t0 = time.time()   # the endpoint queries against wall-clock now
+    st.sample(now=t0 - 2.0, families=_fam("veles_t_q", 5.0,
+                                          labels={"replica": "r0"}))
+    st.sample(now=t0 - 1.0, families=_fam("veles_t_q", 7.0,
+                                          labels={"replica": "r0"}))
+    cat = history_query(st, "")
+    assert "veles_t_q" in cat["series_names"]
+    assert cat["samples"] == 2
+    ans = history_query(
+        st, "series=veles_t_q&window=60&agg=max&label.replica=r0")
+    assert ans["value"] == 7.0 and ans["tier"] == 0
+    assert ans["labels"] == {"replica": "r0"}
+    assert ans["points"]
+    # selector mismatch -> no data, not an error
+    assert history_query(
+        st, "series=veles_t_q&label.replica=rX")["value"] is None
+    assert history_query(st, "series=veles_t_q&window=nope") \
+        == {"error": "bad window/tier"}
+    assert "error" in history_query(st, "series=veles_t_q&agg=bogus")
+
+
+# -- endpoints: replica + router ----------------------------------------------
+
+def test_replica_history_endpoint_answers_both_tiers(fast_tiers):
+    from tests.test_router import _make_replica
+    rep = _make_replica("tsdb-rep")
+    try:
+        base = "http://%s:%s" % (rep.host, rep.port)
+        deadline = time.monotonic() + 15
+        cat = {}
+        while time.monotonic() < deadline:
+            _, body = _get(base + "/metrics/history")
+            cat = json.loads(body)
+            if cat.get("samples", 0) >= 3 and cat["series_names"]:
+                break
+            time.sleep(0.1)
+        assert cat["samples"] >= 3
+        series = next(n for n in cat["series_names"]
+                      if n.startswith("veles_"))
+        for tier, step in ((0, 0.25), (1, 2.0)):
+            st, body = _get(
+                base + "/metrics/history?series=%s&window=20&tier=%d"
+                % (series, tier))
+            ans = json.loads(body)
+            assert st == 200 and ans["tier"] == tier
+            assert ans["tier_step_s"] == step
+    finally:
+        rep.stop()
+
+
+def _counting_replica(start, step):
+    """A replica stub whose generated-tokens counter advances on
+    every scrape — history tests need a signal that MOVES."""
+    state = {"n": start}
+
+    class Fake(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _reply(self, code, blob, ctype="application/json"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            if path == "/healthz":
+                self._reply(200, json.dumps(
+                    {"status": "ok", "role": "both",
+                     "draining": False}).encode())
+            elif path == "/serving/metrics":
+                self._reply(200, b"{}")
+            elif path == "/metrics":
+                state["n"] += step
+                self._reply(200, (
+                    "# TYPE veles_serving_tokens_generated_total "
+                    "counter\n"
+                    "veles_serving_tokens_generated_total %d\n"
+                    % state["n"]).encode(), "text/plain")
+            else:
+                self._reply(404, b"{}")
+
+    return Fake
+
+
+def test_router_history_two_tiers_and_continuity_across_churn(
+        fast_tiers):
+    """Acceptance: the router's history store samples the FEDERATED
+    merge, so fleet history answers at both tiers and stays
+    continuous — no negative spike, no gap — when a replica is
+    killed and a fresh one (counter reset to ~0) respawns."""
+    from veles_tpu.serving import Router
+    q = ("/metrics/history?series=veles_serving_tokens_generated"
+         "_total&window=25&agg=sum&tier=0")
+    s1, p1 = _serve(_counting_replica(1000, 7))
+    s2, p2 = _serve(_counting_replica(0, 3))
+    router = Router(health_interval=0.1).start()
+    try:
+        router.add_replica("127.0.0.1", p1, replica_id="h1")
+        router.add_replica("127.0.0.1", p2, replica_id="h2")
+        deadline = time.monotonic() + 15
+        ans = {}
+        while time.monotonic() < deadline:
+            _, body = _get(router.url + q)
+            ans = json.loads(body)
+            if len(ans.get("points") or ()) >= 4:
+                break
+            time.sleep(0.1)
+        assert len(ans["points"]) >= 4
+        # both tiers answer, each at its own step
+        for tier, step in ((0, 0.25), (1, 2.0)):
+            st, body = _get(
+                router.url + "/metrics/history?series=veles_serving"
+                "_tokens_generated_total&window=25&agg=rate&tier=%d"
+                % tier)
+            tans = json.loads(body)
+            assert st == 200 and tans["tier"] == tier
+            assert tans["tier_step_s"] == step
+            assert tans["value"] is not None and tans["value"] >= 0
+        # kill h1 (scrapes now fail) and respawn a FRESH replica
+        # whose counter restarts near zero
+        t_churn = time.time()
+        s1.shutdown()
+        router.remove_replica("h1")
+        s3, p3 = _serve(_counting_replica(0, 5))
+        router.add_replica("127.0.0.1", p3, replica_id="h3")
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            _, body = _get(router.url + q)
+            ans = json.loads(body)
+            if any(t > t_churn + 1.0 for t, _ in ans["points"]):
+                break
+            time.sleep(0.1)
+        pts = ans["points"]
+        # continuity: buckets from BEFORE the churn still served
+        # next to buckets from after it...
+        assert any(t < t_churn for t, _ in pts)
+        assert any(t > t_churn + 1.0 for t, _ in pts)
+        # ...and the fleet-sum drop clamped to delta 0 instead of a
+        # negative spike
+        assert min(v for _, v in pts) >= 0.0
+        s3.shutdown()
+    finally:
+        router.stop()
+        s2.shutdown()
+
+
+# -- per-tenant metering ------------------------------------------------------
+
+_USAGE_FAMILIES = {
+    "veles_tenant_usage_prompt_tokens_total": "prompt_tokens",
+    "veles_tenant_usage_generated_tokens_total": "generated_tokens",
+    "veles_tenant_usage_kv_block_seconds_total": "kv_block_seconds",
+    "veles_tenant_usage_compute_seconds_total": "compute_seconds",
+}
+
+
+def _usage_counter_values(family):
+    fam = metrics.get(family)
+    if fam is None:
+        return {}
+    return {key[0]: child.value
+            for key, child in fam.children().items()}
+
+
+def _registry_replica():
+    """A replica stub serving THIS process's live registry — the
+    router's federated merge then sums the very counters the
+    scheduler incremented, which is what the equality acceptance
+    check needs."""
+
+    class Fake(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _reply(self, code, blob, ctype="application/json"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            if path == "/healthz":
+                self._reply(200, json.dumps(
+                    {"status": "ok", "role": "both",
+                     "draining": False}).encode())
+            elif path == "/serving/metrics":
+                self._reply(200, b"{}")
+            elif path == "/metrics":
+                self._reply(200, metrics.render_prometheus()
+                            .encode(), "text/plain")
+            else:
+                self._reply(404, b"{}")
+
+    return Fake
+
+
+def _tiny_fw(name, window=64, vocab=12, dim=16, heads=2):
+    import numpy
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.backends import Device
+    from veles_tpu.memory import Array
+    from veles_tpu.models.standard import make_forwards
+    wf = AcceleratedWorkflow(None, name=name)
+    fw = make_forwards(
+        wf, Array(numpy.zeros((2, window), numpy.int32)), [
+            {"type": "embedding", "vocab": vocab, "dim": dim},
+            {"type": "transformer_block", "heads": heads,
+             "causal": True},
+            {"type": "token_logits", "vocab": vocab}])
+    dev = Device(backend="numpy")
+    for u in fw:
+        u.initialize(device=dev)
+    return fw
+
+
+def test_tenant_usage_rollup_equals_scheduler_counters(f32):
+    """Acceptance: fleet-summed token counts from ``/tenants/usage``
+    equal the scheduler-side per-tenant counters EXACTLY (ints), and
+    the residency/compute seconds match to rounding.  The comparison
+    runs on counter DELTAS against a pre-soak baseline, so earlier
+    tests' metering in the shared process registry cannot skew it."""
+    from veles_tpu.serving import InferenceScheduler, Router
+    baseline = {fam: _usage_counter_values(fam)
+                for fam in _USAGE_FAMILIES}
+    sch = InferenceScheduler(_tiny_fw("tsdb-meter"), max_slots=2,
+                             window=64, kv="paged", block_size=4,
+                             warm_buckets=False,
+                             replica_id="meter-r0").start()
+    try:
+        futs = [sch.submit([3, 1, 4, 1, 5], 8, seed=i,
+                           tenant="usage-a") for i in range(3)]
+        futs += [sch.submit([2, 7, 1], 6, seed=9, tenant="usage-b")]
+        for f in futs:
+            f.result(240)
+        snap = sch.metrics()["tenants"]
+    finally:
+        sch.close()
+    assert snap and all(rec["generated_tokens"] > 0
+                        and rec["kv_block_seconds"] > 0
+                        and rec["compute_seconds"] > 0
+                        for rec in snap.values())
+    server, port = _serve(_registry_replica())
+    router = Router(health_interval=0.1).start()
+    try:
+        router.add_replica("127.0.0.1", port, replica_id="meter-rep")
+        deadline = time.monotonic() + 20
+        usage = {}
+        while time.monotonic() < deadline:
+            _, body = _get(router.url + "/tenants/usage")
+            usage = json.loads(body)["tenants"]
+            if all(label in usage for label in snap):
+                break
+            time.sleep(0.1)
+        for label, rec in snap.items():
+            for fam, field in _USAGE_FAMILIES.items():
+                delta = usage[label][field] \
+                    - baseline[fam].get(label, 0.0)
+                if field.endswith("_tokens"):
+                    assert delta == rec[field], (label, field)
+                else:
+                    assert delta == pytest.approx(rec[field],
+                                                  abs=1e-4), \
+                        (label, field)
+    finally:
+        router.stop()
+        server.shutdown()
+
+
+# -- trend-aware alerting -----------------------------------------------------
+
+def _seed_goodput(st, values, now=None):
+    now = time.time() if now is None else now
+    for dt, v in values:
+        st.sample(now=now + dt, families=_fam(
+            "veles_serving_goodput_tokens_per_sec", v))
+
+
+def test_goodput_regression_rule_fires_and_resolves():
+    """E2E through the engine state machine: a goodput collapse vs
+    the hour-long baseline fires ``goodput_regression`` after its
+    hold-down, and a recovery resolves it."""
+    from veles_tpu.telemetry.alerts import AlertEngine, default_rules
+    rule = next(r for r in default_rules()
+                if r.name == "goodput_regression")
+    assert rule.severity == "ticket"
+    st = _store(name="t-goodput")
+    # an hour of healthy baseline, then a collapse in the recent
+    # 60 s window: drop_vs_baseline = (100 - 10) / 100 = 0.9 > 0.5
+    _seed_goodput(st, [(-3000.0, 100.0), (-2500.0, 100.0),
+                       (-2000.0, 100.0), (-1500.0, 100.0),
+                       (-1000.0, 100.0), (-40.0, 10.0),
+                       (-20.0, 10.0)])
+    engine = AlertEngine(name="t-goodput-eng", rules=[rule],
+                         interval=999, tsdb=st)
+    assert engine.tick(now=1000.0) == []          # pending
+    fired = engine.tick(now=1000.0 + rule.for_seconds + 1.0)
+    assert [w for w, _, _ in fired] == ["fire"]
+    assert engine.firing()[0]["rule"] == "goodput_regression"
+    # recovery: enough fresh healthy buckets pull the recent average
+    # back over the threshold
+    _seed_goodput(st, [(-12.0 + i, 100.0) for i in range(12)])
+    resolved = engine.tick(now=1010.0)
+    assert [w for w, _, _ in resolved] == ["resolve"]
+    assert engine.firing() == []
+
+
+def test_trend_rules_quiet_without_a_store():
+    """The trend expressions evaluate to NO rows when no history
+    store exists — a process without a tsdb never pages."""
+    from veles_tpu.telemetry.alerts import AlertRule
+    rule = AlertRule(name="t", expr="deriv(veles_t_g, 60) > 0")
+    assert rule.evaluate(metrics, {}, 1.0, tsdb=None) == []
+
+
+# -- controller history windows -----------------------------------------------
+
+def test_controller_decisions_consume_history_windows():
+    """Acceptance: the KV-tune decision keys off the SMOOTHED window
+    average (instantaneous pressure is below threshold here), the
+    pool recommendation is sized from the window p95, and the audit
+    record carries the window stats."""
+    from tests.test_controller import _StubFleet, _StubRouter, _view
+    from veles_tpu.serving.controller import FleetController
+    saved = root.common.controller.__content__()
+    root.common.controller.update({
+        "queue_high": 100.0, "occupancy_low": 0.0,
+        "quiet_ticks": 99, "scale_up_cooldown": 0.0,
+        "kv_pressure_high": 0.8, "kv_pressure_low": 0.3,
+        "shed_step": 0.5, "shed_min": 1.0, "shed_max": 8.0,
+        "history_window": 60.0})
+    try:
+        st = _store(name="t-ctl", tiers=((1.0, 600.0),))
+        now = time.time()
+        for i, v in enumerate((0.84, 0.88, 0.92, 0.96)):
+            st.sample(now=now - 8.0 + 2.0 * i, families=_fam(
+                "veles_serving_kv_pressure", v,
+                labels={"replica": "r0"}))
+        # instantaneous pressure is a healthy 0.5 — only the window
+        # average (0.9) crosses kv_pressure_high
+        views = [_view("r0", kv_blocks_used=50, kv_blocks_free=50)]
+        ctl = FleetController(_StubRouter(views), _StubFleet(),
+                              interval=999, tsdb=st)
+        tuned = []
+        ctl._tune_replica = lambda view, factor: tuned.append(
+            (view["id"], factor)) or True
+        ctl.tick(now=100.0)
+        assert tuned == [("r0", 3.5)]
+        rec = [d for d in ctl.audit()
+               if d["action"] == "tune_shed"][0]
+        assert rec["window"]["kv_pressure_avg"] \
+            == pytest.approx(0.9)
+        sized = [d for d in ctl.audit()
+                 if d["action"] == "recommend_kv_blocks"][0]
+        # ceil(100 blocks * p95 0.96 / high 0.8) = 120 — sized from
+        # observed history, not the flat 1.25 fudge (125)
+        assert sized["kv_blocks"] == 120
+        assert sized["window"]["kv_pressure_p95"] \
+            == pytest.approx(0.96)
+    finally:
+        root.common.controller.update(saved)
+
+
+# -- prefix-hit-rate regression ----------------------------------------------
+
+def test_prefix_hit_rate_absent_until_window_populated():
+    """Regression: under ``_PREFIX_MIN_LOOKUPS`` recent lookups the
+    family must export NO sample for the replica — not a
+    fake-healthy 1.0 that pacifies the collapse alert."""
+    from veles_tpu.serving.metrics import ServingMetrics
+    fam_name = "veles_serving_prefix_hit_rate_recent"
+    m = ServingMetrics(replica="pfx-regress")
+    floor = ServingMetrics._PREFIX_MIN_LOOKUPS
+    for _ in range(floor - 1):
+        m.record_prefix_lookup(1, 4)
+    fam = metrics.get(fam_name)
+    assert ("pfx-regress",) not in fam.children()
+    m.record_prefix_lookup(0, 4)      # the window fills here
+    assert fam.children()[("pfx-regress",)].value \
+        == pytest.approx((floor - 1) / floor)
+    # a fresh instance (restart shape) retracts the stale sample on
+    # its FIRST below-threshold lookup instead of re-exporting 1.0
+    m2 = ServingMetrics(replica="pfx-regress")
+    m2.record_prefix_lookup(1, 4)
+    assert ("pfx-regress",) not in fam.children()
+
+
+# -- flight recorder + dashboard ---------------------------------------------
+
+def test_flight_recorder_bundle_embeds_history():
+    from veles_tpu.telemetry.flight_recorder import FlightRecorder
+    st = _store(name="t-bundle")
+    now = time.time()
+    for i in range(5):
+        st.sample(now=now - 10.0 + 2.0 * i, families=_fam(
+            "veles_serving_goodput_tokens_per_sec", 40.0 + i))
+    info = FlightRecorder().bundle("test")
+    hist = info["history"]["t-bundle"]
+    pts = hist["veles_serving_goodput_tokens_per_sec"]
+    assert len(pts) == 5 and pts[-1][1] == 44.0
+    assert bundle_history()["t-bundle"] == hist
+
+
+def test_dashboard_sparklines_and_tenant_usage_render():
+    from veles_tpu.telemetry.dashboard import (
+        render_history_sparklines, render_tenant_usage)
+    page = render_history_sparklines({
+        "veles_x<script>": [(1.0, 1.0), (2.0, 9.0), (3.0, 5.0)],
+        "veles_flat": [(1.0, 2.0), (2.0, 2.0)]})
+    assert "<script>" not in page
+    assert "veles_x&lt;script&gt;" in page
+    assert "▁" in page and "█" in page      # spark blocks rendered
+    assert render_history_sparklines({}) \
+        == "<p class='dim'>no history yet</p>"
+    usage = {"window_s": 60.0, "tenants": {
+        "acme<b>": {"prompt_tokens": 10, "generated_tokens": 32,
+                    "generated_tokens_per_sec": 1.5,
+                    "kv_block_seconds": 2.25,
+                    "compute_seconds": 0.125}}}
+    page = render_tenant_usage(usage)
+    assert "acme&lt;b&gt;" in page and "<b>" not in page
+    assert "32" in page and "1.5" in page
+    assert render_tenant_usage({"tenants": {}}) \
+        == "<p class='dim'>no tenant usage recorded</p>"
+
+
+# -- overhead gate ------------------------------------------------------------
+
+@pytest.mark.tsdb_overhead
+def test_tsdb_overhead_under_5_percent(f32, spec_trained_chain):
+    """The store is default-ON, so its sampling cost rides every
+    serving process: gate the store-on vs store-off scheduler soak
+    at <5% (the telemetry/alerting overhead precedent) — with the
+    sampler ticking at 2 Hz, twice the shipped 1 Hz tier-0 step.
+    (Not faster: mid-suite the process registry carries hundreds of
+    families, so a deliberately-hot sampler on a small host measures
+    registry bloat, not the shipped cadence.)"""
+    from veles_tpu.serving import InferenceScheduler
+    fw, pattern = spec_trained_chain
+    prompt = [p % 12 for p in pattern]
+    sch = InferenceScheduler(fw, max_slots=2, window=64, kv="paged",
+                             block_size=4, prefill_chunk=4,
+                             warm_buckets=False,
+                             replica_id="tsdb-soak").start()
+
+    def soak(requests=4, steps=24):
+        futs = [sch.submit(prompt, steps, seed=i)
+                for i in range(requests)]
+        for f in futs:
+            f.result(240)
+
+    def best_of(reps=3):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            soak()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    try:
+        soak()   # compile + settle
+        t_off = best_of()
+        store = TimeSeriesStore(name="overhead",
+                                interval=0.5).start()
+        try:
+            t_on = best_of()
+        finally:
+            store.stop()
+        overhead = (t_on - t_off) / t_off
+        if overhead >= 0.05:   # one retry rides out load spikes
+            t_off = min(t_off, best_of())
+            store = TimeSeriesStore(name="overhead2",
+                                    interval=0.5).start()
+            try:
+                t_on = min(t_on, best_of())
+            finally:
+                store.stop()
+            overhead = min(overhead, (t_on - t_off) / t_off)
+        assert overhead < 0.05, \
+            "tsdb overhead %.1f%% (on %.3fs, off %.3fs)" \
+            % (overhead * 100, t_on, t_off)
+    finally:
+        sch.close()

@@ -20,6 +20,16 @@ pass checks both statically:
   call sites.  Only the first registration's ``labelnames`` takes
   effect, so every other declaration is dead text that will
   eventually disagree with reality.
+- **M503** — a family declared with a ``tenant`` label in a module
+  that never routes the label value through the admission-layer
+  cardinality bounder (no ``….label(…)`` call anywhere in the
+  module).  Tenant ids are CALLER-chosen strings; exporting them raw
+  as label values is an unbounded-cardinality hole — every distinct
+  id mints a new time series in the registry, the federation merge
+  and the tsdb ring.  ``TenantAdmission.label()`` caps the set
+  (first-N stable, rest folded into ``"other"``), so the static
+  proxy for "bounded" is: the registering module contains at least
+  one call whose attribute name is ``label``.
 
 Only calls whose receiver is a registry (``metrics.…`` /
 ``registry.…``) with a literal string name are checked — direct
@@ -72,15 +82,27 @@ class MetricsHygienePass(Pass):
                 "across call sites — the registry honors only the "
                 "FIRST registration, so the others are dead text "
                 "whose .labels() calls can raise at runtime",
+        "M503": "tenant-labeled metric family registered in a module "
+                "with no cardinality-bounder .label() call — raw "
+                "caller-chosen tenant ids mint unbounded label "
+                "series; route values through "
+                "TenantAdmission.label()",
     }
 
     def run(self, module, project):
         findings = []
         sites = project.shared.setdefault("metric_sites", {})
+        tenant_decls = []
+        has_bounder_call = False
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call) \
-                    or not isinstance(node.func, ast.Attribute) \
-                    or node.func.attr not in _METHODS:
+                    or not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr == "label":
+                # any `<something>.label(...)` counts as routing
+                # through the cardinality bounder (M503)
+                has_bounder_call = True
+            if node.func.attr not in _METHODS:
                 continue
             recv = dotted(node.func.value)
             if recv is None \
@@ -101,6 +123,17 @@ class MetricsHygienePass(Pass):
             if labels is not None:
                 sites.setdefault(name, []).append(
                     (labels, module, node))
+                if "tenant" in labels:
+                    tenant_decls.append((name, node))
+        if not has_bounder_call:
+            for name, node in tenant_decls:
+                findings.append(self.finding(
+                    module, node, "M503", qualname_of(node), name,
+                    "family %r carries a 'tenant' label but this "
+                    "module never calls a cardinality bounder "
+                    "(.label(...)) — raw tenant ids make label "
+                    "cardinality unbounded; fold values through "
+                    "TenantAdmission.label() first" % name))
         return findings
 
     def finalize(self, project):

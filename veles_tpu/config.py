@@ -369,6 +369,7 @@ root.common.update({
         "shed_min": 1.0,
         "shed_max": 8.0,
         "audit_keep": 256,
+        "history_window": 30.0,
     },
     # per-tenant admission economics (tenant/admission.py): the
     # router resolves a tenant id from the auth header (hash of the
@@ -387,6 +388,28 @@ root.common.update({
         "burst": 0.0,
         "max_concurrent": 0,
         "label_cardinality": 8,
+    },
+    # embedded time-series store (telemetry/tsdb.py): a background
+    # ticker samples the metrics registry (replicas) or the federated
+    # fleet merge (router) into downsampling tiers of
+    # (step_s, retention_s) ring buffers — counters as per-bucket
+    # deltas so rates are exact across tier boundaries, gauges as
+    # (count, sum, min, max, last) aggregates.  Queryable via
+    # GET /metrics/history and TimeSeriesStore.range(); feeds the
+    # *_over_time/deriv/drop_vs_baseline alert functions, the
+    # controller's history windows and the dashboard sparklines.
+    # max_series caps distinct stored series (later arrivals are
+    # dropped + counted); max_bytes is the estimated-allocation
+    # budget (least-recently-updated whole series evicted when
+    # exceeded).  metering gates the scheduler's per-tenant usage
+    # attribution (veles_tenant_usage_* families + /tenants/usage)
+    # — separate knob so the on-vs-off overhead soak can isolate it.
+    "tsdb": {
+        "enabled": True,
+        "tiers": ((1.0, 600.0), (10.0, 3600.0), (60.0, 86400.0)),
+        "max_series": 512,
+        "max_bytes": 16 << 20,
+        "metering": True,
     },
     # fault injection (veles_tpu/faults/): spec string parsed on first
     # fire(), same grammar as the VELES_FAULTS env var —

@@ -266,6 +266,10 @@ class RESTfulAPI(Unit):
         #: replica-tier alert engine (telemetry/alerts.py), created
         #: at initialize() when root.common.alerts.enabled
         self.alerts_ = None
+        #: replica-tier history store (telemetry/tsdb.py), created
+        #: at initialize() when root.common.tsdb.enabled — samples
+        #: the process registry; GET /metrics/history queries it
+        self.tsdb_ = None
         #: POST /drain latched: /healthz answers 503 "draining" and
         #: the scheduler (if any) stops admitting
         self._draining_ = False
@@ -488,6 +492,20 @@ class RESTfulAPI(Unit):
                         self._reply_json({"enabled": False})
                         return
                     self._reply_json(api.alerts_.snapshot())
+                    return
+                if route == "/metrics/history":
+                    # windowed queries over the replica's embedded
+                    # history store (?series=...&window=...&agg=...
+                    # &label.<k>=<v>&tier=N; no series = catalog)
+                    if api.tsdb_ is None:
+                        self._reply_json({"enabled": False},
+                                         code=503)
+                        return
+                    from veles_tpu.telemetry.tsdb import \
+                        history_query
+                    query = self.path.partition("?")[2]
+                    self._reply_json(
+                        history_query(api.tsdb_, query))
                     return
                 if route == "/metrics":
                     # Prometheus text exposition of the process-wide
@@ -1374,11 +1392,17 @@ class RESTfulAPI(Unit):
             name="restful-api")
         self._thread_.start()
         from veles_tpu.config import root as _root
+        if self.tsdb_ is None \
+                and _root.common.tsdb.get("enabled", True):
+            from veles_tpu.telemetry.tsdb import TimeSeriesStore
+            self.tsdb_ = TimeSeriesStore(
+                name=self.replica_id or "replica").start()
         if self.alerts_ is None \
                 and _root.common.alerts.get("enabled", True):
             from veles_tpu.telemetry.alerts import AlertEngine
             self.alerts_ = AlertEngine(
-                name=self.replica_id or "replica").start()
+                name=self.replica_id or "replica",
+                tsdb=self.tsdb_).start()
         self.info("REST API on http://%s:%d/api", self.host, self.port)
 
     def run(self):
@@ -1398,6 +1422,9 @@ class RESTfulAPI(Unit):
         alerts, self.alerts_ = self.alerts_, None
         if alerts is not None:
             alerts.stop()
+        tsdb, self.tsdb_ = self.tsdb_, None
+        if tsdb is not None:
+            tsdb.stop()
         if self.scheduler_ is not None:
             self.scheduler_.close()
             self.scheduler_ = None

@@ -40,6 +40,18 @@ three loops, every decision an auditable JSONL event plus
   decisions an operator replays from the audit trail, never a live
   repool.
 
+Since PR 17 the controller has a memory: when the router carries a
+history store (:mod:`veles_tpu.telemetry.tsdb`), every tick reads a
+smoothed ``history_window`` of fleet-merged KV pressure and goodput
+instead of trusting one instantaneous sample — KV tuning acts on the
+windowed average, ``recommend_kv_blocks`` sizes the pool from the
+observed pressure *p95* (the percentile a provisioning decision
+should survive, not the moment the tick happened to land on), and
+every audit record carries the ``window`` stats it decided from.
+With no store (or an empty window) each consumer falls back to the
+instantaneous observation, so the controller never stalls on its own
+telemetry.
+
 Config ``root.common.controller.*``, default OFF — :meth:`start`
 refuses to arm unless ``enabled`` is set, so a fleet never drives
 itself without an operator's say-so.  The loop consumes only
@@ -94,10 +106,14 @@ class FleetController(Logger):
     ``root.common.controller.enabled``; ``tick()`` is one evaluation
     pass and is how tests drive the state machine directly."""
 
-    def __init__(self, router, fleet, interval=None):
+    def __init__(self, router, fleet, interval=None, tsdb=None):
         super(FleetController, self).__init__()
         self.router = router
         self.fleet = fleet
+        #: explicit history store; None resolves the router's
+        #: (lazily, per tick — the router builds its store at
+        #: start(), usually after this constructor ran)
+        self.tsdb = tsdb
         self.interval = float(
             _controller_conf("interval", 2.0)
             if interval is None else interval)
@@ -181,7 +197,41 @@ class FleetController(Logger):
             "kv_pressure": used / (used + free) if used + free
             else 0.0,
             "kv_blocks_total": used + free,
+            "window": self._window_stats(),
         }
+
+    def _window_stats(self):
+        """Smoothed history over the router's fleet-merged store:
+        ``history_window`` seconds of KV pressure (avg + p95) and
+        goodput.  None when there is no store or no data yet — every
+        consumer then falls back to the instantaneous sample, so the
+        controller keeps working while its memory warms up."""
+        store = self.tsdb if self.tsdb is not None \
+            else getattr(self.router, "tsdb", None)
+        if store is None:
+            return None
+        window = float(_controller_conf("history_window", 30.0))
+        try:
+            kv_avg = store.range("veles_serving_kv_pressure",
+                                 window=window, agg="avg")
+            kv_p95 = store.range("veles_serving_kv_pressure",
+                                 window=window, agg="p95")
+            goodput = store.range(
+                "veles_serving_goodput_tokens_per_sec",
+                window=window, agg="avg")
+        except Exception as e:
+            self.warning("history window read failed: %r", e)
+            return None
+        if kv_avg is None and kv_p95 is None and goodput is None:
+            return None
+        out = {"window_s": window}
+        if kv_avg is not None:
+            out["kv_pressure_avg"] = round(kv_avg, 4)
+        if kv_p95 is not None:
+            out["kv_pressure_p95"] = round(kv_p95, 4)
+        if goodput is not None:
+            out["goodput_avg"] = round(goodput, 3)
+        return out
 
     def _burn_firing(self):
         """The firing SLO-burn rules on the router's alert engine —
@@ -255,7 +305,8 @@ class FleetController(Logger):
             reason="slo_burn" if burn else "queue_depth",
             burn_rules=list(burn),
             queue_mean=round(obs["queue_mean"], 3),
-            replicas=len(obs["live"]) + 1)
+            replicas=len(obs["live"]) + 1,
+            window=obs.get("window"))
 
     def _grow_role(self, obs):
         """The role a scale-up spawns with: None for homogeneous
@@ -290,7 +341,8 @@ class FleetController(Logger):
             "scale_down", index=index, replica=victim["id"],
             reason="quiet", occupancy=round(obs["occupancy"], 3),
             queue_mean=round(obs["queue_mean"], 3),
-            replicas=len(live) - 1)
+            replicas=len(live) - 1,
+            window=obs.get("window"))
 
     def _drain_victim(self, live):
         """The replica a scale-down drains: least outstanding work,
@@ -402,7 +454,13 @@ class FleetController(Logger):
         step = float(_controller_conf("shed_step", 0.5))
         lo = float(_controller_conf("shed_min", 1.0))
         hi = float(_controller_conf("shed_max", 8.0))
-        pressure = obs["kv_pressure"]
+        window = obs.get("window")
+        # the smoothed window (when the history store has one) beats
+        # the instantaneous sample: one tick landing on a transient
+        # spike/trough must not whipsaw admission shedding
+        pressure = window["kv_pressure_avg"] \
+            if window and "kv_pressure_avg" in window \
+            else obs["kv_pressure"]
         if pressure >= high:
             base = hi / 2.0 if self._shed_factor is None \
                 else self._shed_factor
@@ -416,11 +474,18 @@ class FleetController(Logger):
         if pressure >= high:
             # sizing recommendation rides the audit trail only — a
             # pool repool needs a restart, which is the operator's
-            # (or a future rolling-restart policy's) call
+            # (or a future rolling-restart policy's) call.  Sized
+            # from the OBSERVED pressure percentile when history is
+            # available: a pool provisioned so the window's p95
+            # lands at kv_pressure_high, not a flat fudge factor
+            p95 = (window or {}).get("kv_pressure_p95")
+            if p95 is not None and high > 0:
+                blocks = int(-(-obs["kv_blocks_total"] * p95 // high))
+            else:
+                blocks = int(obs["kv_blocks_total"] * 1.25)
             self._decide(
-                "recommend_kv_blocks",
-                kv_blocks=int(obs["kv_blocks_total"] * 1.25) or None,
-                kv_pressure=round(pressure, 3))
+                "recommend_kv_blocks", kv_blocks=blocks or None,
+                kv_pressure=round(pressure, 3), window=window)
         if target == self._shed_factor:
             return None
         applied = [r["id"] for r in obs["live"]
@@ -431,7 +496,8 @@ class FleetController(Logger):
         self._shed_factor = target
         return self._decide(
             "tune_shed", shed_block_factor=target,
-            kv_pressure=round(pressure, 3), replicas=applied)
+            kv_pressure=round(pressure, 3), replicas=applied,
+            window=window)
 
     def _tune_replica(self, view, factor):
         """POST /serving/tune to one replica (admin bearer when

@@ -360,9 +360,10 @@ def _registry_series():
         "prefix_rate": metrics.gauge(
             "veles_serving_prefix_hit_rate_recent",
             "radix prefix-cache hit rate over the recent lookup "
-            "window (reads 1.0 until enough lookups arrive, so the "
-            "collapse alert never fires on idle); labeled per "
-            "replica", labelnames=("replica",)),
+            "window (NO sample until the window has enough lookups "
+            "— an idle replica exports nothing rather than a fake "
+            "healthy 1.0 that would pacify the collapse alert); "
+            "labeled per replica", labelnames=("replica",)),
         # disaggregated-handoff export lifecycle: a healthy fleet
         # fetches every parked record within the TTL — pending
         # should hover near 0 and expired should never grow (the
@@ -383,7 +384,63 @@ def _registry_series():
             "veles_serving_kv_export_fetched_total",
             "export records claimed by their one-shot fetch; "
             "labeled per replica", labelnames=("replica",)),
+        "ttft_p95": metrics.gauge(
+            "veles_serving_ttft_p95_ms",
+            "recent-window TTFT p95 as a gauge (the histogram's "
+            "reservoir percentile) — the series the ttft_p95_creep "
+            "trend rule differentiates; labeled per replica",
+            labelnames=("replica",)),
+        # per-tenant cost metering (PR 17): the usage quantities a
+        # bill is made of, attributed by the scheduler at step/retire
+        # boundaries to the bounded tenant label (tenant/admission.py
+        # first-N cardinality bound — raw ids never become label
+        # values).  Counters, so the router's federated merge sums
+        # them fleet-wide and the tsdb rates them over any window.
+        "tenant_prompt_tokens": metrics.counter(
+            "veles_tenant_usage_prompt_tokens_total",
+            "prompt tokens ingested (prefill cost), by bounded "
+            "tenant label", labelnames=("tenant",)),
+        "tenant_generated_tokens": metrics.counter(
+            "veles_tenant_usage_generated_tokens_total",
+            "tokens generated (decode output), by bounded tenant "
+            "label", labelnames=("tenant",)),
+        "tenant_kv_block_seconds": metrics.counter(
+            "veles_tenant_usage_kv_block_seconds_total",
+            "KV blocks held x wall seconds, sampled at decode-step "
+            "boundaries — the HBM-residency cost of a tenant's "
+            "streams, by bounded tenant label",
+            labelnames=("tenant",)),
+        "tenant_compute_seconds": metrics.counter(
+            "veles_tenant_usage_compute_seconds_total",
+            "step wall time attributed to a tenant's active slots "
+            "(each step's duration split evenly across its live "
+            "requests), by bounded tenant label",
+            labelnames=("tenant",)),
     }
+
+
+# -- tenant label bounding ----------------------------------------------------
+
+_tenant_bounder = None
+_tenant_bounder_lock = threading.Lock()
+
+
+def _tenant_label(tenant):
+    """Bound a raw tenant id to its metrics-safe label value through
+    the admission cardinality bounder (first-N distinct tenants keep
+    their own label, the rest read "other") — a raw id NEVER becomes
+    a label value, so a tenant flood cannot leak unbounded series
+    into the registry (analysis pass M503 enforces this flow at
+    every tenant-labeled registration site).  One shared bounder per
+    process, so every metrics instance agrees on which N tenants won
+    their own label."""
+    global _tenant_bounder
+    if _tenant_bounder is None:
+        from veles_tpu.tenant.admission import TenantAdmission
+        with _tenant_bounder_lock:
+            if _tenant_bounder is None:
+                _tenant_bounder = TenantAdmission()
+    return _tenant_bounder.label(str(tenant or "anon"))
 
 
 _BREAKER_STATES = {"closed": 0, "half_open": 1, "open": 2}
@@ -679,6 +736,12 @@ class ServingMetrics:
         self._steps = deque(maxlen=recent)
         #: recent prefix lookups (True = hit) for the windowed rate
         self._prefix_recent = deque(maxlen=64)
+        #: per-tenant usage accumulators, keyed by BOUNDED label —
+        #: the scheduler-side metering ground truth the
+        #: /tenants/usage fleet rollup must equal exactly:
+        #: label -> {prompt_tokens, generated_tokens,
+        #: kv_block_seconds, compute_seconds}
+        self.tenant_usage = {}
         # per-priority-class counters + TTFT windows, created on the
         # first request of each class (most deployments see one)
         self._classes = {}
@@ -817,9 +880,68 @@ class ServingMetrics:
         self._global["spec_accepted"].inc(accepted)
         self._global["spec_rollback"].inc(drafted - accepted)
 
+    # -- per-tenant metering (PR 17) ------------------------------------
+
+    def _tenant_rec(self, label):
+        """lock held."""
+        rec = self.tenant_usage.get(label)
+        if rec is None:
+            rec = self.tenant_usage[label] = {
+                "prompt_tokens": 0, "generated_tokens": 0,
+                "kv_block_seconds": 0.0, "compute_seconds": 0.0}
+        return rec
+
+    def record_tenant_tokens(self, tenant, prompt=0, generated=0):
+        """Retire-time token attribution (failed requests attribute
+        too — the prefill/decode compute was spent either way)."""
+        label = _tenant_label(tenant)
+        prompt, generated = int(prompt), int(generated)
+        with self._lock:
+            rec = self._tenant_rec(label)
+            rec["prompt_tokens"] += prompt
+            rec["generated_tokens"] += generated
+        if prompt:
+            self._global["tenant_prompt_tokens"].labels(
+                tenant=label).inc(prompt)
+        if generated:
+            self._global["tenant_generated_tokens"].labels(
+                tenant=label).inc(generated)
+
+    def record_tenant_step(self, usage):
+        """One decode-step boundary's residency/compute attribution:
+        ``usage`` maps raw tenant id ->
+        ``(kv_block_seconds, compute_seconds)`` increments the
+        scheduler sampled for that step (blocks held x step wall
+        time; the step's duration split across its active slots)."""
+        for tenant, (blocks_s, compute_s) in usage.items():
+            label = _tenant_label(tenant)
+            with self._lock:
+                rec = self._tenant_rec(label)
+                rec["kv_block_seconds"] += blocks_s
+                rec["compute_seconds"] += compute_s
+            if blocks_s > 0:
+                self._global["tenant_kv_block_seconds"].labels(
+                    tenant=label).inc(blocks_s)
+            if compute_s > 0:
+                self._global["tenant_compute_seconds"].labels(
+                    tenant=label).inc(compute_s)
+
+    def tenant_usage_snapshot(self):
+        """Per-tenant usage rollup (bounded labels), rounded for the
+        JSON surface."""
+        with self._lock:
+            return {label: {
+                "prompt_tokens": rec["prompt_tokens"],
+                "generated_tokens": rec["generated_tokens"],
+                "kv_block_seconds": round(rec["kv_block_seconds"], 6),
+                "compute_seconds": round(rec["compute_seconds"], 6),
+            } for label, rec in sorted(self.tenant_usage.items())}
+
     #: minimum recent lookups before the windowed hit rate is
-    #: trusted — below it the gauge reads 1.0 (healthy) so the
-    #: prefix_hit_collapse alert never fires on idle/startup traffic
+    #: trusted — below it NO sample is exported (the series is
+    #: absent, not a fake-healthy 1.0), so the prefix_hit_collapse
+    #: alert neither fires on idle/startup traffic nor gets
+    #: pacified by an idle replica's placeholder
     _PREFIX_MIN_LOOKUPS = 16
 
     def record_prefix_lookup(self, matched_blocks, block_size):
@@ -834,8 +956,10 @@ class ServingMetrics:
         with self._lock:
             self._prefix_recent.append(matched_blocks > 0)
             window = list(self._prefix_recent)
-        rate = (sum(window) / len(window)
-                if len(window) >= self._PREFIX_MIN_LOOKUPS else 1.0)
+        if len(window) < self._PREFIX_MIN_LOOKUPS:
+            self._global["prefix_rate"].remove(self.replica)
+            return
+        rate = sum(window) / len(window)
         self._global["prefix_rate"].labels(
             replica=self.replica).set(round(rate, 4))
 
@@ -854,6 +978,8 @@ class ServingMetrics:
         self._global["ttft_ms"].observe(ttft_ms)
         self._global["queued_ms"].observe(queued_ms)
         self._global["class_ttft_ms"].labels(cls=cls).observe(ttft_ms)
+        self._global["ttft_p95"].labels(replica=self.replica).set(
+            round(self._ttft.percentile(0.95), 3))
         self.slo.record(cls, "ttft", ttft_ms)
 
     def record_prefill_chunk(self, tokens, chunk_ms):

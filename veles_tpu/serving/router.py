@@ -276,6 +276,12 @@ class Router(Logger):
         #: the router-tier alert engine (telemetry/alerts.py),
         #: created at start() when root.common.alerts.enabled
         self.alerts = None
+        #: the router-tier history store (telemetry/tsdb.py),
+        #: created at start() when root.common.tsdb.enabled — its
+        #: ticker samples the FEDERATED merge, so fleet-wide history
+        #: survives replica churn (a dead replica's counted work
+        #: stays in the buckets it landed in)
+        self.tsdb = None
         #: request tracing (telemetry/reqtrace.py), read once — the
         #: per-attempt gate is an attribute test
         self._tron = reqtrace.enabled()
@@ -311,12 +317,28 @@ class Router(Logger):
         # flight-recorder / debug surface (weakly held)
         reqtrace.register("router", self)
         from veles_tpu.config import root
+        if root.common.tsdb.get("enabled", True):
+            from veles_tpu.telemetry.tsdb import TimeSeriesStore
+
+            def _fleet_collect():
+                # the store's ticker thread marshals onto the router
+                # loop for the merge; a stopped/stopping router just
+                # yields an empty sample instead of raising forever
+                try:
+                    return self._call(self._fleet_async())
+                except Exception:
+                    return []
+            self.tsdb = TimeSeriesStore(
+                name="router", collect=_fleet_collect).start()
         if root.common.alerts.get("enabled", True):
             from veles_tpu.telemetry.alerts import AlertEngine
             # no providers: GET /alerts is answered ON the router
             # loop, and a provider marshalling back into that loop
-            # (replica_state) would deadlock the handler
-            self.alerts = AlertEngine(name="router").start()
+            # (replica_state) would deadlock the handler.  The trend
+            # rules read the router's own store — fleet-merged
+            # history, not any single replica's
+            self.alerts = AlertEngine(name="router",
+                                      tsdb=self.tsdb).start()
         self.info("router on http://%s:%d -> %d replica(s)",
                   self.host, self.port, len(self._seed_replicas))
         return self
@@ -328,6 +350,8 @@ class Router(Logger):
         self._health_task = asyncio.ensure_future(self._health_loop())
 
     def stop(self):
+        if self.tsdb is not None:
+            self.tsdb.stop()
         if self.alerts is not None:
             self.alerts.stop()
         with self._lock:
@@ -1590,7 +1614,74 @@ class Router(Logger):
                 {"tokens": toks if squeeze else [toks]}).encode()
         return None
 
-    async def _route(self, method, path, headers, body, trace=None):
+    def _fleet_families(self):
+        """loop thread: every replica's last-polled /metrics text
+        merged (counters/histograms summed, gauges re-labeled per
+        replica) + the veles_fleet_* rollups — the one federated
+        view /metrics/fleet renders, the history store samples and
+        /tenants/usage totals from."""
+        from veles_tpu.telemetry import federation
+        scrapes, errors = [], []
+        for rep in self._replicas.values():
+            if rep.last_scrape and not rep.scrape_failed:
+                scrapes.append((rep.id, federation.parse_prometheus(
+                    rep.last_scrape)))
+            else:
+                errors.append(rep.id)
+        return federation.fleet_families(scrapes, errors=errors)
+
+    async def _fleet_async(self):
+        return self._fleet_families()
+
+    _TENANT_USAGE_FAMILIES = {
+        "veles_tenant_usage_prompt_tokens_total": "prompt_tokens",
+        "veles_tenant_usage_generated_tokens_total":
+            "generated_tokens",
+        "veles_tenant_usage_kv_block_seconds_total":
+            "kv_block_seconds",
+        "veles_tenant_usage_compute_seconds_total":
+            "compute_seconds",
+    }
+
+    def _tenant_usage(self, window=60.0):
+        """loop thread: the ``GET /tenants/usage`` rollup — exact
+        fleet-summed totals straight from the CURRENT federated
+        merge (counters sum across replicas, so these equal the
+        scheduler-side per-tenant counters exactly), plus windowed
+        token rates answered by the history store."""
+        totals = {}
+        for fam in self._fleet_families():
+            field = self._TENANT_USAGE_FAMILIES.get(fam["name"])
+            if field is None:
+                continue
+            for suffix, labels, value in fam["samples"]:
+                if suffix:
+                    continue
+                rec = totals.setdefault(
+                    labels.get("tenant", "anon"),
+                    {f: 0.0
+                     for f in self._TENANT_USAGE_FAMILIES.values()})
+                rec[field] += value
+        out = {}
+        for tenant, rec in sorted(totals.items()):
+            row = {
+                "prompt_tokens": int(rec["prompt_tokens"]),
+                "generated_tokens": int(rec["generated_tokens"]),
+                "kv_block_seconds": round(rec["kv_block_seconds"], 6),
+                "compute_seconds": round(rec["compute_seconds"], 6),
+            }
+            if self.tsdb is not None:
+                for field in ("prompt_tokens", "generated_tokens"):
+                    rate = self.tsdb.range(
+                        "veles_tenant_usage_%s_total" % field,
+                        {"tenant": tenant}, window=window, agg="rate")
+                    row["%s_per_sec" % field] = round(rate, 4) \
+                        if rate is not None else None
+            out[tenant] = row
+        return {"window_s": float(window), "tenants": out}
+
+    async def _route(self, method, path, headers, body, trace=None,
+                     query=""):
         if method == "POST" and path == "/generate":
             reply = await self._maybe_disagg(body, headers, trace)
             if reply is not None:
@@ -1629,24 +1720,29 @@ class Router(Logger):
                           "text/plain; version=0.0.4; charset=utf-8"},
                     registry.render_prometheus().encode())
         if method == "GET" and path == "/metrics/fleet":
-            # federated scrape: every replica's last-polled /metrics
-            # text merged (counters/histograms summed, gauges
-            # re-labeled per replica) + the veles_fleet_* rollups
             from veles_tpu.telemetry import federation
-            scrapes, errors = [], []
-            for rep in self._replicas.values():
-                if rep.last_scrape and not rep.scrape_failed:
-                    scrapes.append((rep.id, federation
-                                    .parse_prometheus(
-                                        rep.last_scrape)))
-                else:
-                    errors.append(rep.id)
-            families = federation.fleet_families(scrapes,
-                                                 errors=errors)
             return (200, {"Content-Type":
                           "text/plain; version=0.0.4; charset=utf-8"},
-                    federation.render_families_text(families)
-                    .encode())
+                    federation.render_families_text(
+                        self._fleet_families()).encode())
+        if method == "GET" and path == "/metrics/history":
+            if self.tsdb is None:
+                return self._error(503, "tsdb disabled")
+            from veles_tpu.telemetry.tsdb import history_query
+            return (200, {"Content-Type": "application/json"},
+                    json.dumps(history_query(self.tsdb, query),
+                               default=str).encode())
+        if method == "GET" and path == "/tenants/usage":
+            from urllib.parse import parse_qs
+            params = {k: v[-1]
+                      for k, v in parse_qs(query or "").items()}
+            try:
+                window = float(params.get("window", 60.0))
+            except ValueError:
+                return self._error(400, "bad window")
+            return (200, {"Content-Type": "application/json"},
+                    json.dumps(self._tenant_usage(window=window),
+                               default=str).encode())
         if method == "GET" and path == "/alerts":
             snap = self.alerts.snapshot() if self.alerts is not None \
                 else {"enabled": False}
@@ -1655,7 +1751,16 @@ class Router(Logger):
         if method == "GET" and path == "/dashboard":
             from veles_tpu.telemetry.dashboard import \
                 render_dashboard_html
+            from veles_tpu.telemetry.tsdb import BUNDLE_SERIES
             state = await self._state()
+            history = None
+            if self.tsdb is not None:
+                history = {}
+                for series in BUNDLE_SERIES:
+                    pts = self.tsdb.points(series, window=300.0,
+                                           tier=0)
+                    if pts:
+                        history[series] = pts
             page = render_dashboard_html(
                 "veles fleet — %s:%d" % (self.host, self.port),
                 replicas=state["replicas"],
@@ -1664,7 +1769,10 @@ class Router(Logger):
                 if self.alerts is not None else None,
                 inflight=self._inflight_rows(),
                 note="%d replica(s), %d eligible" % (
-                    len(self._replicas), state["eligible"]))
+                    len(self._replicas), state["eligible"]),
+                history=history,
+                tenants=self._tenant_usage()
+                if self.tsdb is not None else None)
             return (200,
                     {"Content-Type": "text/html; charset=utf-8"},
                     page.encode())
@@ -1687,7 +1795,8 @@ class Router(Logger):
             length = int(headers.get("content-length", 0))
             body = await reader.readexactly(length) if length \
                 else b""
-            path = target.split("?")[0].rstrip("/") or "/"
+            path, _, query = target.partition("?")
+            path = path.rstrip("/") or "/"
             # the EDGE mint: accept the client's X-Veles-Trace when
             # sane, else mint — and propagate it to the replica via
             # the same (sanitized) header so one id spans the fleet
@@ -1738,7 +1847,8 @@ class Router(Logger):
                 if reply is None:
                     try:
                         reply = await self._route(
-                            method, path, headers, body, trace=trace)
+                            method, path, headers, body, trace=trace,
+                            query=query)
                     except asyncio.CancelledError:
                         raise
                     except Exception as e:

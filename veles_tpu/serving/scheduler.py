@@ -294,6 +294,14 @@ def _serving_conf(name, default):
     return root.common.serving.get(name, default)
 
 
+def _metering_enabled():
+    """``root.common.tsdb.metering`` — gates the per-tenant usage
+    attribution (token counts at retire, KV-block-seconds and
+    compute-seconds at step boundaries)."""
+    from veles_tpu.config import root
+    return bool(root.common.tsdb.get("metering", True))
+
+
 class _Request(object):
     __slots__ = ("prompt", "steps", "temperature", "top_k",
                  "stop_token", "seed", "deadline", "future", "slot",
@@ -531,6 +539,10 @@ class InferenceScheduler(Logger):
         #: construction — the per-boundary gate must be an attribute
         #: test, not a config-tree walk
         self._tron = reqtrace.enabled()
+        #: per-tenant metering gate (root.common.tsdb.metering), read
+        #: ONCE for the same reason — the step boundary is the hot
+        #: path the overhead soak holds to <5%
+        self._metering = _metering_enabled()
         self._queue = collections.deque()
         self._active = {}            # slot -> _Request (decoding)
         self._prefilling = []        # admitted, mid-chunked-prefill
@@ -1177,6 +1189,7 @@ class InferenceScheduler(Logger):
         snap["draining"] = draining
         snap["drained"] = self._drained.is_set()
         snap["queued_kv_blocks"] = queued_blocks
+        snap["tenants"] = self.stats.tenant_usage_snapshot()
         return snap
 
     def debug_requests(self):
@@ -2023,6 +2036,29 @@ class InferenceScheduler(Logger):
                 drafts[slot] = d
         return drafts
 
+    def _meter_step(self, active, cache, dt):
+        """Step-boundary usage attribution (PR 17 metering): each
+        active request charges its tenant KV-blocks-held x the step's
+        wall time, plus an even 1/n split of the step's duration as
+        compute-seconds.  Sampled here — not at retire — so a
+        long-lived stream's HBM residency accrues while it runs, and
+        a preempted request stops being charged the moment its
+        blocks are released."""
+        if not self._metering or not active or dt <= 0:
+            return
+        share = dt / len(active)
+        usage = {}
+        for slot, req in active.items():
+            if self.kv == "paged":
+                blocks = int(cache.n_blocks[slot])
+            else:
+                blocks = -(-(len(req.prompt) + len(req.generated))
+                           // self.block_size)
+            rec = usage.setdefault(req.tenant or "anon", [0.0, 0.0])
+            rec[0] += blocks * dt
+            rec[1] += share
+        self.stats.record_tenant_step(usage)
+
     def _step_paged(self, cache, active):
         """Packed step: ONLY the active slots ride the batch, padded
         to a power-of-two occupancy bucket; the attended range is the
@@ -2057,6 +2093,7 @@ class InferenceScheduler(Logger):
         dt = time.perf_counter() - t0
         # plain decode: every active slot emits exactly one token
         self.stats.record_step(n, b, tokens=n, duration_s=dt)
+        self._meter_step(active, cache, dt)
         for j, slot in enumerate(slots):
             req = active[slot]
             self._emit(req, int(nxt[j]))
@@ -2117,6 +2154,9 @@ class InferenceScheduler(Logger):
             self.forwards, cache, toks, pos, lens, tables, temps,
             topks, seeds, counts))
         dt = time.perf_counter() - t0
+        # metered BEFORE acceptance retires finished slots — the
+        # step's residency belongs to everyone who rode the batch
+        self._meter_step(active, cache, dt)
         emitted = {}
         for j, slot in enumerate(slots):
             req = active[slot]
@@ -2161,6 +2201,7 @@ class InferenceScheduler(Logger):
         dt = time.perf_counter() - t0
         self.stats.record_step(len(active), s, tokens=len(active),
                                duration_s=dt)
+        self._meter_step(active, cache, dt)
         for slot, req in active.items():
             self._emit(req, int(nxt[slot]))
             self._maybe_finish(req, cache)
@@ -2184,6 +2225,14 @@ class InferenceScheduler(Logger):
             self._active.pop(req.slot, None)
         self._release_slot(req, cache, finished=error is None)
         self._sync_kv_gauges(cache)
+        if self._metering:
+            # token attribution happens for ERRORS too — the prefill
+            # and decode compute was spent either way, and a bill
+            # that forgets failures undercharges the tenant causing
+            # them
+            self.stats.record_tenant_tokens(
+                req.tenant, prompt=len(req.prompt),
+                generated=len(req.generated))
         if self._tron:
             # an INSTANT at the retire boundary ("duration" would
             # backdate it into a request-spanning bar): total_s is

@@ -30,6 +30,8 @@ from veles_tpu.telemetry.reqtrace import (  # noqa: F401
     TRACE_HEADER, clean_trace_id, ensure_trace_id, new_trace_id)
 from veles_tpu.telemetry.spans import (  # noqa: F401
     iter_spans, next_span_id, span)
+from veles_tpu.telemetry.tsdb import (  # noqa: F401
+    DEFAULT_TIERS, TimeSeriesStore, bundle_history, history_query)
 
 
 def enabled():

@@ -21,6 +21,25 @@ counters) or ``rate`` (delta per second).  Without a func, every
 matching series gets its OWN state machine, so one replica's breaker
 firing does not mask a second replica's.
 
+**Trend functions** (PR 17) read the embedded time-series store
+(:mod:`veles_tpu.telemetry.tsdb`) instead of the instantaneous
+registry, so rules can compare now against history::
+
+    avg_over_time(family[{sel}][, window_s]) OP number
+    max_over_time(family[{sel}][, window_s]) OP number
+    min_over_time(family[{sel}][, window_s]) OP number
+    deriv(family[{sel}][, window_s]) OP number          # per-second slope
+    drop_vs_baseline(family[{sel}][, short, long]) OP number
+
+``drop_vs_baseline`` is the regression detector: the fractional drop
+of the short-window average below the long-window *median* (the
+trailing baseline), 0 when the baseline is empty or non-positive —
+``> 0.5`` means "running at less than half the trailing-hour
+median".  Windows default to 60s (and 3600s for the baseline).  Each
+stored series matching the selector keeps its own state machine;
+with no live store the functions yield no rows (and a firing
+instance resolves via the vanished-series path).
+
 **Shipped defaults** (:func:`default_rules`, disable with
 ``root.common.alerts.defaults = False``) cover the fleet's known
 failure shapes: multi-window fast+slow SLO burn (the SRE Workbook
@@ -67,6 +86,17 @@ _EXPR = re.compile(
     r'([A-Za-z_:][A-Za-z0-9_:]*)\s*(?:\{([^}]*)\})?\s*\)?\s*'
     r'(>=|<=|==|!=|>|<)\s*'
     r'(-?(?:\d+\.?\d*|\.\d+)(?:[eE]-?\d+)?)\s*$')
+# the tsdb-backed trend functions: windows are positional seconds
+# (avg/max/min_over_time + deriv take one, drop_vs_baseline takes
+# short, long).  Tried BEFORE _EXPR so "deriv(...)" never half-parses
+# as a bare family read.
+_EXPR_TIME = re.compile(
+    r'^\s*(avg_over_time|max_over_time|min_over_time|deriv|'
+    r'drop_vs_baseline)\s*\(\s*'
+    r'([A-Za-z_:][A-Za-z0-9_:]*)\s*(?:\{([^}]*)\})?\s*'
+    r'(?:,\s*(\d+\.?\d*)\s*)?(?:,\s*(\d+\.?\d*)\s*)?\)\s*'
+    r'(>=|<=|==|!=|>|<)\s*'
+    r'(-?(?:\d+\.?\d*|\.\d+)(?:[eE]-?\d+)?)\s*$')
 _SEL_LABEL = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)\s*=\s*'
                         r'"?([^",}]*)"?')
 
@@ -102,9 +132,23 @@ class AlertRule:
         self.description = description
         self.expr = expr
         self._parsed = None
+        self._time_memo = None   # (store id+sample count, rows)
         if kind == "expr":
             if not expr:
                 raise ValueError("rule %s: expr required" % name)
+            mt = _EXPR_TIME.match(expr)
+            if mt is not None:
+                func, family, selector, w1, w2, op, threshold = \
+                    mt.groups()
+                self._parsed = {
+                    "func": func, "family": family,
+                    "selector": dict(
+                        _SEL_LABEL.findall(selector or "")),
+                    "op": op, "threshold": float(threshold),
+                    "time": True,
+                    "w1": float(w1) if w1 else None,
+                    "w2": float(w2) if w2 else None}
+                return
             m = _EXPR.match(expr)
             if m is None:
                 raise ValueError("rule %s: cannot parse expr %r"
@@ -162,13 +206,28 @@ class AlertRule:
             out.append((labels, float(value)))
         return out
 
-    def evaluate(self, registry, prev, dt):
+    def evaluate(self, registry, prev, dt, tsdb=None):
         """[(labels dict, value, condition bool)] — one entry per
         alert instance this tick.  ``prev`` is the engine's
         per-series memory for increase/rate (first sight reads as
-        delta 0, so restarts never page on a counter's history)."""
+        delta 0, so restarts never page on a counter's history);
+        ``tsdb`` is the history store the trend functions query."""
         if self.kind == "slo_burn":
             return self._evaluate_slo_burn(registry)
+        if self._parsed.get("time"):
+            # the store only gains data once per sampling interval,
+            # so between samples the answer cannot change — memoize
+            # on the sample counter (engines often tick much faster
+            # than the store samples, e.g. 20 Hz test intervals
+            # against the 1 Hz tier-0 ticker)
+            key = (id(tsdb), tsdb.samples) if tsdb is not None \
+                else None
+            cached = self._time_memo
+            if cached is not None and cached[0] == key:
+                return cached[1]
+            rows = self._evaluate_time(tsdb)
+            self._time_memo = (key, rows)
+            return rows
         p = self._parsed
         cmp_, thr = _OPS[p["op"]], p["threshold"]
         rows = self._series(registry)
@@ -193,6 +252,44 @@ class AlertRule:
             return [(dict(p["selector"]), agg, cmp_(agg, thr))]
         return [(labels, v, v == v and cmp_(v, thr))
                 for labels, v in rows]
+
+    def _evaluate_time(self, store):
+        """The tsdb-backed trend functions: one row per stored
+        series matching the selector.  No live store (or no data in
+        the window) yields no rows — a firing instance then resolves
+        through the vanished-series path instead of latching."""
+        if store is None:
+            return []
+        p = self._parsed
+        cmp_, thr = _OPS[p["op"]], p["threshold"]
+        family, sel, func = p["family"], p["selector"], p["func"]
+        w1 = p["w1"] if p["w1"] is not None else 60.0
+        out = []
+        for labels in store.label_sets(family, sel):
+            try:
+                if func == "drop_vs_baseline":
+                    long_ = p["w2"] if p["w2"] is not None else 3600.0
+                    base = store.range(family, labels, window=long_,
+                                       agg=0.5)
+                    recent = store.range(family, labels, window=w1,
+                                         agg="avg")
+                    if base is None or recent is None or base <= 0:
+                        value = 0.0
+                    else:
+                        value = (base - recent) / base
+                else:
+                    agg = {"avg_over_time": "avg",
+                           "max_over_time": "max",
+                           "min_over_time": "min",
+                           "deriv": "deriv"}[func]
+                    value = store.range(family, labels, window=w1,
+                                        agg=agg)
+            except Exception:
+                continue
+            if value is None:
+                continue
+            out.append((labels, value, cmp_(value, thr)))
+        return out
 
     def _evaluate_slo_burn(self, registry):
         """The SRE multi-window pair: one instance per
@@ -317,6 +414,35 @@ def default_rules():
                         "containing, or a limit set too tight for a "
                         "legitimate client (per-series: one state "
                         "machine per bounded tenant label)"),
+        AlertRule(
+            "goodput_regression", severity="ticket",
+            for_seconds=5.0,
+            expr="drop_vs_baseline("
+                 "veles_serving_goodput_tokens_per_sec, 60, 3600)"
+                 " > 0.5",
+            description="goodput over the last minute is running at "
+                        "less than half the trailing-hour median — "
+                        "a regression the instantaneous gauge can't "
+                        "see (it has no memory of what 'normal' "
+                        "was); catches slow-burn degradations like "
+                        "a shrinking batch or a sick replica "
+                        "dragging the fleet"),
+        AlertRule(
+            "ttft_p95_creep", severity="ticket", for_seconds=10.0,
+            expr="deriv(veles_serving_ttft_p95_ms, 600) > 1.0",
+            description="TTFT p95 climbing >1ms/s sustained over 10 "
+                        "minutes — queueing or prefill pressure "
+                        "building faster than the fleet absorbs it "
+                        "(the creep an instantaneous threshold "
+                        "misses until it's already an SLO burn)"),
+        AlertRule(
+            "kv_pressure_growth", severity="info",
+            for_seconds=10.0,
+            expr="deriv(veles_serving_kv_pressure, 300) > 0.001",
+            description="paged-KV occupancy growing monotonically "
+                        "over 5 minutes — long-lived streams are "
+                        "accumulating toward the shed threshold; "
+                        "heads-up before kv_block_pressure tickets"),
     ]
 
 
@@ -356,11 +482,12 @@ class AlertEngine(Logger):
 
     def __init__(self, name="alerts", rules=None, registry=None,
                  interval=None, webhook_url=None, providers=None,
-                 resolved_keep=64):
+                 resolved_keep=64, tsdb=None):
         super(AlertEngine, self).__init__()
         self.name = str(name)
         self.registry = registry if registry is not None \
             else default_registry
+        self.tsdb = tsdb        # None -> resolve a live store per tick
         self.interval = float(_alerts_conf("interval", 1.0)
                               if interval is None else interval)
         self.webhook_url = _alerts_conf("webhook_url", None) \
@@ -421,10 +548,15 @@ class AlertEngine(Logger):
             dt = (now - self._last_tick) if self._last_tick else 0.0
             self._last_tick = now
             self.ticks += 1
+        store = self.tsdb
+        if store is None:
+            from veles_tpu.telemetry.tsdb import default_store
+            store = default_store()
         transitions = []
         for rule in self.rules:
             try:
-                rows = rule.evaluate(self.registry, self._prev, dt)
+                rows = rule.evaluate(self.registry, self._prev, dt,
+                                     tsdb=store)
             except Exception as e:
                 self.warning("rule %s evaluation failed: %r",
                              rule.name, e)

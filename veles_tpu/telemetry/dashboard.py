@@ -155,10 +155,77 @@ def render_inflight_table(requests):
                    "attempts", "replica", "stream"), rows)
 
 
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(points, width=40):
+    """A unicode block sparkline over ``[(t, value)]`` points (last
+    ``width`` kept) — no javascript, no external assets, survives
+    any terminal-grade browser.  Returns "" for no data."""
+    vals = [float(v) for _, v in points][-int(width):]
+    vals = [v for v in vals if v == v]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_BLOCKS[0] * len(vals)
+    return "".join(
+        _SPARK_BLOCKS[min(len(_SPARK_BLOCKS) - 1,
+                          int((v - lo) / span * len(_SPARK_BLOCKS)))]
+        for v in vals)
+
+
+def render_history_sparklines(history):
+    """The history section: ``history`` maps display name ->
+    ``[(t, value)]`` tier-0 points (the tsdb ``points()`` shape).
+    One row per series: sparkline + last/min/max over the window."""
+    if not history:
+        return "<p class='dim'>no history yet</p>"
+    rows = []
+    for name in sorted(history):
+        points = list(history[name] or ())
+        vals = [float(v) for _, v in points if v == v]
+        if not vals:
+            continue
+        rows.append((
+            _e(name),
+            "<span style='font-family:monospace'>%s</span>"
+            % html.escape(_sparkline(points)),
+            _num(vals[-1]), _num(min(vals)), _num(max(vals))))
+    if not rows:
+        return "<p class='dim'>no history yet</p>"
+    return _table(("series", "trend", "last", "min", "max"), rows)
+
+
+def render_tenant_usage(usage):
+    """The per-tenant metering lines: ``usage`` is the router's
+    ``/tenants/usage`` payload (``{"window_s", "tenants": {label:
+    {...}}}``)."""
+    tenants = (usage or {}).get("tenants") or {}
+    if not tenants:
+        return "<p class='dim'>no tenant usage recorded</p>"
+    rows = []
+    for tenant in sorted(tenants):
+        rec = tenants[tenant]
+        rows.append((
+            _e(tenant),
+            _e(rec.get("prompt_tokens")),
+            _e(rec.get("generated_tokens")),
+            _num(rec.get("generated_tokens_per_sec")),
+            _num(rec.get("kv_block_seconds"), "%.2f"),
+            _num(rec.get("compute_seconds"), "%.3f")))
+    return _table(("tenant", "prompt tok", "generated tok",
+                   "gen tok/s", "kv block-s", "compute-s"), rows)
+
+
 def render_dashboard_html(title, replicas=(), slo=None, alerts=None,
-                          inflight=(), note=None, refresh=2):
+                          inflight=(), note=None, refresh=2,
+                          history=None, tenants=None):
     """Compose the full page.  ``alerts`` is an
-    ``AlertEngine.snapshot()`` dict (or None)."""
+    ``AlertEngine.snapshot()`` dict (or None); ``history`` maps
+    series display names to tier-0 point lists (sparkline rows);
+    ``tenants`` is the ``/tenants/usage`` payload."""
     alerts = alerts or {}
     parts = []
     if note:
@@ -170,6 +237,12 @@ def render_dashboard_html(title, replicas=(), slo=None, alerts=None,
     parts.append("<h3>alerts</h3>")
     parts.append(render_alerts_table(
         alerts.get("firing") or (), alerts.get("pending") or ()))
+    if history is not None:
+        parts.append("<h3>history</h3>")
+        parts.append(render_history_sparklines(history))
+    if tenants is not None:
+        parts.append("<h3>tenant usage</h3>")
+        parts.append(render_tenant_usage(tenants))
     parts.append("<h3>in flight</h3>")
     parts.append(render_inflight_table(list(inflight)))
     return (_PAGE

@@ -228,6 +228,15 @@ class FlightRecorder:
         except Exception:
             pass
         try:
+            # the last minutes of tier-0 history for the key serving
+            # series (goodput, KV pressure, SLO burn, TTFT p95) from
+            # every live store — the LEAD-UP to the hang, not just
+            # the moment of death
+            from veles_tpu.telemetry import tsdb
+            info["history"] = tsdb.bundle_history()
+        except Exception:
+            pass
+        try:
             from veles_tpu.logger import events
             info["events"] = list(events.ring)[-self.max_events:]
         except Exception:
