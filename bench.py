@@ -2864,6 +2864,255 @@ def bench_tsdb(dev):
     }
 
 
+def bench_tiered_kv(dev):
+    """Fleet-global tiered KV (PR 19):
+
+    - ``kv_wire_mbps_{b64,binary}`` — encode+decode round-trip
+      throughput of one KV export record over the legacy b64-JSON
+      envelope vs the length-prefixed binary frame (the handoff and
+      prefix-shipping wire; acceptance wants binary >= 5x);
+    - ``fleet_prefix_hit_rate_{affinity,topology}`` — 2 replicas
+      behind the router, every prompt re-served under a CHANGED
+      session key (a reconnecting client): crc32 affinity re-lands
+      half the prompts cold, cache-topology routing follows the
+      advertised digests to the warm replica;
+    - ``warm_ttft_p95_ms_{device,host,peer}`` — steps=1 latency of a
+      warm prompt whose prefix is device-resident (trie hit), in the
+      host tier (promotion on admit; scheduler-level both), or only
+      on a DRAINED peer (router-level: binary prefix fetch + forward
+      — the HTTP hops ride this number).
+    """
+    import threading  # noqa: F401  (parity with sibling benches)
+    import urllib.request
+    import zlib
+
+    from veles_tpu.accelerated_units import AcceleratedWorkflow
+    from veles_tpu.memory import Array
+    from veles_tpu.models.standard import make_forwards
+    from veles_tpu.restful_api import RESTfulAPI, RestfulLoader
+    from veles_tpu.serving import (
+        InferenceScheduler, LocalReplica, Router)
+    from veles_tpu.serving import disagg
+
+    rng = numpy.random.default_rng(19)
+
+    # -- the wire ------------------------------------------------------
+    blocks, bs, d, layers_n = 24, 16, 128, 4
+    rec = {"handle": "bench", "prompt":
+           rng.integers(0, 999, (blocks * bs,)).tolist(),
+           "length": blocks * bs, "kv_dtype": "fp32",
+           "block_size": bs,
+           "logits": rng.standard_normal(4096).astype(numpy.float32),
+           "layers": {
+               i: {"k": rng.standard_normal((blocks, bs, d))
+                   .astype(numpy.float32),
+                   "v": rng.standard_normal((blocks, bs, d))
+                   .astype(numpy.float32)}
+               for i in range(layers_n)}}
+    payload = disagg.record_nbytes(rec)
+    reps_n = 6
+    t0 = time.perf_counter()
+    for _ in range(reps_n):
+        disagg.decode_export_binary(disagg.encode_export_binary(rec))
+    mbps_binary = payload * reps_n / (time.perf_counter() - t0) / 1e6
+    t0 = time.perf_counter()
+    for _ in range(reps_n):
+        disagg.decode_export(
+            json.loads(json.dumps(disagg.encode_export(rec))))
+    mbps_b64 = payload * reps_n / (time.perf_counter() - t0) / 1e6
+
+    # -- shared tiny-fleet plumbing ------------------------------------
+    vocab, d_model, heads, layers, window = 64, 32, 2, 2, 128
+    made = [0]
+
+    def spawn(replica_id, **extra):
+        made[0] += 1
+        wf = AcceleratedWorkflow(None,
+                                 name="bench-tkv-%d" % made[0])
+        spec = [{"type": "embedding", "vocab": vocab,
+                 "dim": d_model}]
+        spec += [{"type": "transformer_block", "heads": heads,
+                  "causal": True} for _ in range(layers)]
+        spec += [{"type": "token_logits", "vocab": vocab}]
+        fw = make_forwards(
+            wf, Array(numpy.zeros((1, window), numpy.int32)), spec)
+        for u in fw:
+            u.initialize(device=dev)
+        loader = RestfulLoader(wf, sample_shape=(window,),
+                               minibatch_size=1, max_wait=10.0)
+        loader.initialize(device=dev)
+        api = RESTfulAPI(wf, loader=loader, forwards=fw,
+                         name="bench-tkv-api-%d" % made[0],
+                         max_slots=2, max_queue=256,
+                         request_timeout=600.0,
+                         replica_id=replica_id,
+                         serving_block_size=4,
+                         serving_prefill_chunk=16,
+                         serving_prefix_cache=True,
+                         serving_warm_buckets=False, **extra)
+        api.output = fw[-1].output
+        api.initialize()
+        return LocalReplica(api, loader)
+
+    def post(url, payload, session=None, timeout=600):
+        headers = {"Content-Type": "application/json"}
+        if session:
+            headers["X-Veles-Session"] = session
+        req = urllib.request.Request(
+            url + "/generate", data=json.dumps(payload).encode(),
+            headers=headers)
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return dict(resp.headers), json.load(resp)
+
+    def session_for(ids, target, salt):
+        for i in range(10000):
+            s = "%s%d" % (salt, i)
+            if max(ids, key=lambda r: zlib.crc32(
+                    ("%s|%s" % (s, r)).encode())) == target:
+                return s
+        raise AssertionError("no session for %s" % target)
+
+    def wait_digests(router, rid, floor, timeout=20.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            state = {r["id"]: r for r in
+                     router.replica_state()["replicas"]}
+            if state[rid]["prefix_digests"] >= floor:
+                return
+            time.sleep(0.05)
+        raise AssertionError("digests never reached %d on %s"
+                             % (floor, rid))
+
+    def fleet_hits(reps):
+        return sum(r.api.scheduler_.metrics()["prefix_cache_hits"]
+                   for r in reps)
+
+    # -- hit rate: crc32 affinity vs cache topology --------------------
+    n_prompts = 12
+    prompts = [rng.integers(0, vocab, (16,)).tolist()
+               for _ in range(n_prompts)]
+    hit_rate = {}
+    for mode, routing in (("affinity", False), ("topology", True)):
+        reps = [spawn("tr%d" % i) for i in range(2)]
+        router = Router(health_interval=0.2, request_timeout=600.0,
+                        prefix_routing=routing,
+                        prefix_fetch=False).start()
+        try:
+            ids = ["tr0", "tr1"]
+            for i, rep in enumerate(reps):
+                router.add_replica(rep.host, rep.port,
+                                   replica_id=ids[i])
+            for i, p in enumerate(prompts):       # first visit
+                post(router.url, {"prompt": p, "steps": 4},
+                     session="w%d" % i)
+            if routing:
+                wait_digests(router, "tr0", 1)
+                wait_digests(router, "tr1", 1)
+            warm0 = fleet_hits(reps)
+            for i, p in enumerate(prompts):       # reconnected
+                post(router.url, {"prompt": p, "steps": 4},
+                     session="r%d" % i)
+            hit_rate[mode] = round(
+                (fleet_hits(reps) - warm0) / n_prompts, 3)
+        finally:
+            router.stop()
+            for rep in reps:
+                rep.stop()
+
+    # -- warm TTFT by tier ---------------------------------------------
+    n_probes = 6
+    probes = [rng.integers(0, vocab, (24,)).tolist()
+              for _ in range(n_probes)]
+
+    def p95_ms(samples):
+        return round(
+            sorted(samples)[int(0.95 * (len(samples) - 1))] * 1e3, 2)
+
+    wf = AcceleratedWorkflow(None, name="bench-tkv-sched")
+    spec = [{"type": "embedding", "vocab": vocab, "dim": d_model}]
+    spec += [{"type": "transformer_block", "heads": heads,
+              "causal": True} for _ in range(layers)]
+    spec += [{"type": "token_logits", "vocab": vocab}]
+    fw = make_forwards(
+        wf, Array(numpy.zeros((1, window), numpy.int32)), spec)
+    for u in fw:
+        u.initialize(device=dev)
+    sch = InferenceScheduler(fw, max_slots=2, window=window,
+                             kv="paged", block_size=4, kv_blocks=40,
+                             prefill_chunk=16, prefix_cache=True,
+                             warm_buckets=False,
+                             kv_host_bytes=64 << 20,
+                             request_timeout=600.0).start()
+    try:
+        for p in probes:
+            sch.submit(p, 4).result(600)
+        t_dev = []
+        for p in probes:
+            t0 = time.perf_counter()
+            sch.submit(p, 1).result(600)
+            t_dev.append(time.perf_counter() - t0)
+        # demote every probe chain: two long prompts overcommit the
+        # 40-block pool, trie eviction parks the contents host-side
+        for k in range(2):
+            sch.submit(rng.integers(0, vocab, (96,)).tolist(),
+                       4).result(600)
+        host_blocks = sch.metrics().get("kv_host_blocks", 0)
+        t_host = []
+        for p in probes:
+            t0 = time.perf_counter()
+            sch.submit(p, 1).result(600)
+            t_host.append(time.perf_counter() - t0)
+        promotions = sch.metrics().get("kv_host_promotions", 0)
+    finally:
+        sch.close()
+
+    reps = [spawn("pf%d" % i) for i in range(2)]
+    router = Router(health_interval=0.2, request_timeout=600.0,
+                    prefix_fetch_min=2).start()
+    try:
+        ids = ["pf0", "pf1"]
+        for i, rep in enumerate(reps):
+            router.add_replica(rep.host, rep.port,
+                               replica_id=ids[i])
+        aim = session_for(ids, "pf0", "warm")
+        for p in probes:
+            post(router.url, {"prompt": p, "steps": 4}, session=aim)
+        wait_digests(router, "pf0", 5 * n_probes)
+        router.drain_replica("pf0")
+        t_peer = []
+        for p in probes:          # each probe ships pf0 -> pf1
+            t0 = time.perf_counter()
+            post(router.url, {"prompt": p, "steps": 1})
+            t_peer.append(time.perf_counter() - t0)
+        peer_fetches = router.replica_state()["router"][
+            "prefix_peer_fetches"]
+    finally:
+        router.stop()
+        for rep in reps:
+            rep.stop()
+
+    return {
+        "kv_wire_mbps_b64": round(mbps_b64, 1),
+        "kv_wire_mbps_binary": round(mbps_binary, 1),
+        "kv_wire_speedup": round(mbps_binary / mbps_b64, 2)
+        if mbps_b64 else None,
+        "fleet_prefix_hit_rate_affinity": hit_rate["affinity"],
+        "fleet_prefix_hit_rate_topology": hit_rate["topology"],
+        "warm_ttft_p95_ms_device": p95_ms(t_dev),
+        "warm_ttft_p95_ms_host": p95_ms(t_host),
+        "warm_ttft_p95_ms_peer": p95_ms(t_peer),
+        "tiered_kv_config": {
+            "wire_payload_mb": round(payload / 1e6, 2),
+            "wire_reps": reps_n, "d_model": d_model,
+            "layers": layers, "vocab": vocab, "window": window,
+            "block_size": 4, "kv_blocks": 40,
+            "hit_rate_prompts": n_prompts, "ttft_probes": n_probes,
+            "host_blocks_after_churn": host_blocks,
+            "host_promotions": promotions,
+            "peer_fetches": peer_fetches},
+    }
+
+
 def _main_standalone(bench_fn, source_key, source_note):
     """Run ONE subsystem bench and merge its keys into the existing
     BENCH.json (the PR5 precedent: a standalone subsystem run, other
@@ -2980,6 +3229,16 @@ def main_tsdb():
         "carried")
 
 
+def main_tieredkv():
+    """``python bench.py tieredkv`` — the binary-KV-wire throughput,
+    topology-vs-affinity fleet hit rate and per-tier warm-TTFT bench
+    alone."""
+    return _main_standalone(
+        bench_tiered_kv, "tieredkv_bench_source",
+        "PR19 standalone tiered-KV/prefix-shipping bench run; other "
+        "entries carried")
+
+
 if __name__ == "__main__":
     sys.exit(main_router() if "router" in sys.argv[1:]
              else main_spec() if "spec" in sys.argv[1:]
@@ -2990,4 +3249,5 @@ if __name__ == "__main__":
              else main_failover() if "failover" in sys.argv[1:]
              else main_controller() if "controller" in sys.argv[1:]
              else main_tsdb() if "tsdb" in sys.argv[1:]
+             else main_tieredkv() if "tieredkv" in sys.argv[1:]
              else main())

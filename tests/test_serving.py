@@ -449,11 +449,15 @@ def _serve_api(name, **kwargs):
     return api, loader, post
 
 
+@pytest.mark.slow
 def test_rest_serving_concurrent_soak(f32):
     """Acceptance: with the serving subsystem enabled, N concurrent
     /generate clients complete in < 2x the single-client wall-clock
     (vs ~Nx under the old decode lock), and every client's greedy
-    output stays exactly its solo decode."""
+    output stays exactly its solo decode.  ``slow`` since PR 19: the
+    wall-clock ratio is a soak-grade assertion (the parity half is
+    covered by the scheduler/REST parity tests that stay in tier-1)
+    — run with ``pytest -m slow``."""
     n_clients, steps = 4, 16
     api, loader, post = _serve_api("soak-serving", max_slots=4)
     try:

@@ -247,17 +247,27 @@ def test_trace_export_cli(tmp_path, capsys):
 
 def test_track_jit_counts_compiles():
     import jax
-    calls = metrics.counter("veles_jit_calls_total",
-                            labelnames=("fn",)).labels("test.tracked")
-    base_calls = calls.value
-    f = track_jit("test.tracked", jax.jit(lambda x: x * 2))
-    assert int(f(numpy.int32(2))) == 4
-    assert int(f(numpy.int32(3))) == 6        # cache hit
-    assert float(f(numpy.float32(2.0))) == 4.0  # recompile: new dtype
+    # pin the persistent compilation cache OFF for this test: an
+    # earlier test (any scheduler soak) may have enabled the on-disk
+    # cache, and a cache populated by a previous run would label
+    # these compiles "hit" instead of "cold".
+    prev = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        calls = metrics.counter(
+            "veles_jit_calls_total",
+            labelnames=("fn",)).labels("test.tracked")
+        base_calls = calls.value
+        f = track_jit("test.tracked", jax.jit(lambda x: x * 2))
+        assert int(f(numpy.int32(2))) == 4
+        assert int(f(numpy.int32(3))) == 6        # cache hit
+        assert float(f(numpy.float32(2.0))) == 4.0  # new dtype
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
     compiles = metrics.counter(
         "veles_jit_compiles_total",
         labelnames=("fn", "cache")).labels("test.tracked", "cold")
-    assert compiles.value == 2  # no persistent cache -> all cold
+    assert compiles.value == 2  # cache pinned off -> all cold
     assert calls.value - base_calls == 3
     hist = metrics.histogram(
         "veles_jit_compile_seconds",

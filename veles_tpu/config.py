@@ -207,6 +207,17 @@ root.common.update({
         "affinity_tokens": 16,
         "request_timeout": None,
         "shed_retry_after": 2,
+        # cache-topology routing (PR 19): prefix_routing routes
+        # single-row /generate bodies to the replica advertising the
+        # longest resident prefix (falls back to crc32 affinity when
+        # nobody is warm); prefix_fetch additionally SHIPS a peer's
+        # longer resident prefix onto the chosen replica over the
+        # binary KV wire before forwarding, when the peer leads by
+        # at least prefix_fetch_min blocks (best-effort — failures
+        # admit cold and count prefix_peer_fetch_fails)
+        "prefix_routing": True,
+        "prefix_fetch": True,
+        "prefix_fetch_min": 2,
     },
     # host-side instrumentation (per-unit spans + metric histograms,
     # veles_tpu/telemetry/) — on by default, overhead-gated in CI.
@@ -329,6 +340,17 @@ root.common.update({
         "spec_k": 4,
         "prefix_cache": True,
         "prefix_evict": True,
+        # tiered KV (PR 19): kv_host_bytes > 0 arms the host-RAM
+        # overflow tier — prefix blocks evicted from the device trie
+        # demote into host buffers (byte-budgeted, LRU) and promote
+        # back when a matching prompt admits; 0 disables (evictions
+        # discard, the pre-tier behavior).  kv_export_bytes caps the
+        # TOTAL bytes parked in pending disagg KV exports (oldest
+        # records expire first once over), replacing the old flat
+        # 64-record cap — a byte budget tracks the actual HBM-sized
+        # payloads a prefill replica holds for its decode peers
+        "kv_host_bytes": 0,
+        "kv_export_bytes": 256 << 20,
     },
     # replica supervision (serving/fleet.py): rebalance lets a
     # disaggregated fleet re-role replicas when a whole role pool

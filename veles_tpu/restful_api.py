@@ -94,6 +94,8 @@ class RESTfulAPI(Unit):
                  serving_spec=None, serving_spec_k=None,
                  serving_prefix_cache=None, serving_warm_buckets=None,
                  serving_tp=None, serving_role=None,
+                 serving_kv_host_bytes=None,
+                 serving_kv_export_bytes=None,
                  replica_id=None, **kwargs):
         super(RESTfulAPI, self).__init__(workflow, **kwargs)
         self.loader = loader
@@ -147,6 +149,12 @@ class RESTfulAPI(Unit):
         #: only; "decode" replicas adopt exports via POST
         #: /serving/kv_import; "both" (default) is colocated
         self.serving_role = serving_role
+        #: tiered-KV knobs (None defers to
+        #: ``root.common.serving.{kv_host_bytes,kv_export_bytes}``):
+        #: host-RAM overflow budget for evicted prefix blocks, and
+        #: the byte cap on outstanding disagg KV exports
+        self.serving_kv_host_bytes = serving_kv_host_bytes
+        self.serving_kv_export_bytes = serving_kv_export_bytes
         #: /generate resource caps — an unbounded request would pay a
         #: giant alloc + a multi-second compile before failing; None
         #: defers to root.common.api.{max_steps,max_batch}
@@ -305,6 +313,8 @@ class RESTfulAPI(Unit):
                     warm_buckets=self.serving_warm_buckets,
                     tp=self.serving_tp,
                     role=self.serving_role,
+                    kv_host_bytes=self.serving_kv_host_bytes,
+                    kv_export_bytes=self.serving_kv_export_bytes,
                     replica_id=self.replica_id).start()
                 self.info(
                     "serving scheduler: %d slots, window %d, "
@@ -422,6 +432,16 @@ class RESTfulAPI(Unit):
                             404, "unknown or expired kv export "
                             "handle")
                         return
+                    if self._wants_binary():
+                        # zero-copy binary framing (Accept:
+                        # application/x-veles-kv) — the fast path
+                        # both disagg handoffs and peer prefix
+                        # fetches negotiate; legacy peers keep the
+                        # b64-JSON envelope below
+                        from veles_tpu.serving.disagg import \
+                            encode_export_binary
+                        self._reply_binary(encode_export_binary(rec))
+                        return
                     self._reply_json(encode_export(rec))
                     return
                 if route == "/healthz":
@@ -534,6 +554,40 @@ class RESTfulAPI(Unit):
                 self.send_header("Content-Length", str(len(blob)))
                 self.end_headers()
                 self.wfile.write(blob)
+
+            def _reply_binary(self, blob, code=200):
+                """Raw-bytes reply for the zero-copy KV wire
+                (``application/x-veles-kv``): no JSON, no base64 —
+                the body IS the frame."""
+                from veles_tpu.serving.disagg import \
+                    WIRE_CONTENT_TYPE
+                self.send_response(code)
+                self.send_header("Content-Type", WIRE_CONTENT_TYPE)
+                if api.replica_id:
+                    self.send_header("X-Veles-Replica",
+                                     str(api.replica_id))
+                self.send_header(reqtrace.TRACE_HEADER,
+                                 self._trace())
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def _wants_binary(self):
+                from veles_tpu.serving.disagg import \
+                    WIRE_CONTENT_TYPE
+                return WIRE_CONTENT_TYPE in \
+                    (self.headers.get("Accept") or "")
+
+            def _sent_binary(self):
+                from veles_tpu.serving.disagg import \
+                    WIRE_CONTENT_TYPE
+                ctype = (self.headers.get("Content-Type")
+                         or "").split(";")[0].strip().lower()
+                return ctype == WIRE_CONTENT_TYPE
+
+            def _read_raw(self):
+                length = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(length)
 
             def _reply_error(self, code, message, retry_after=None,
                              **extra):
@@ -929,14 +983,23 @@ class RESTfulAPI(Unit):
                 """POST /serving/kv_import — the decode half (roles
                 "decode"/"both"): adopt an exported prefill record
                 and decode; replies like a single-row /generate."""
-                from veles_tpu.serving.disagg import decode_export
+                from veles_tpu.serving.disagg import (
+                    decode_export, decode_export_binary)
                 from veles_tpu.serving.scheduler import SchedulerError
                 if api.forwards is None or api.scheduler_ is None:
                     self.send_error(404, "no servable model chain")
                     return
                 try:
-                    body = self._read_body()
-                    export = decode_export(body.get("export") or {})
+                    if self._sent_binary():
+                        # binary frame: the record is the body, the
+                        # sampler parameters ride the frame header's
+                        # "extra" dict
+                        export, body = decode_export_binary(
+                            self._read_raw())
+                    else:
+                        body = self._read_body()
+                        export = decode_export(
+                            body.get("export") or {})
                     steps = int(body.get("steps", 0))
                     temperature = float(body.get("temperature")
                                         or 0.0)
@@ -971,6 +1034,87 @@ class RESTfulAPI(Unit):
                     return
                 self._reply_json({"tokens": toks})
 
+            def _serving_prefix_export(self):
+                """POST /serving/prefix_export — the fleet-wide
+                prefix store's read half: body ``{"tokens": [...]}``,
+                reply the raw KV blocks of the longest resident
+                prefix of those tokens across both tiers (binary
+                frame when Accept negotiates it), or 404 when
+                nothing is resident.  Unlike /generate this WORKS on
+                a draining replica — rescuing a drained peer's warm
+                cache is the point."""
+                from veles_tpu.serving.disagg import (
+                    encode_export, encode_export_binary)
+                from veles_tpu.serving.scheduler import SchedulerError
+                if api.forwards is None or api.scheduler_ is None:
+                    self.send_error(404, "no servable model chain")
+                    return
+                try:
+                    body = self._read_body()
+                    tokens = [int(t) for t in body.get("tokens")
+                              or ()]
+                except (TypeError, ValueError):
+                    self.send_error(400, "tokens must be a flat "
+                                    "list of token ids")
+                    return
+                try:
+                    fut = api.scheduler_.submit_prefix_export(tokens)
+                    rec = fut.result(api.request_timeout + 30.0)
+                except ValueError as e:
+                    self.send_error(400, _status_text(e))
+                    return
+                except SchedulerError as e:
+                    self._reply_scheduler_error(e)
+                    return
+                except concurrent.futures.TimeoutError:
+                    self._reply_error(408, "prefix export timed out")
+                    return
+                if rec is None:
+                    self._reply_error(404, "no resident prefix for "
+                                      "these tokens")
+                    return
+                if self._wants_binary():
+                    self._reply_binary(encode_export_binary(rec))
+                    return
+                self._reply_json(encode_export(rec))
+
+            def _serving_prefix_import(self):
+                """POST /serving/prefix_import — the write half: the
+                router ships a peer's prefix_export record here
+                (binary frame, or legacy JSON under ``{"record":
+                ...}``); new chunks join this replica's radix cache
+                so the request behind the transfer — and every later
+                one — admits warm.  Replies ``{"blocks": adopted}``."""
+                from veles_tpu.serving.disagg import (
+                    decode_export, decode_export_binary)
+                from veles_tpu.serving.scheduler import SchedulerError
+                if api.forwards is None or api.scheduler_ is None:
+                    self.send_error(404, "no servable model chain")
+                    return
+                try:
+                    if self._sent_binary():
+                        record, _ = decode_export_binary(
+                            self._read_raw())
+                    else:
+                        record = decode_export(
+                            self._read_body().get("record") or {})
+                except (TypeError, ValueError) as e:
+                    self.send_error(400, _status_text(e))
+                    return
+                try:
+                    fut = api.scheduler_.submit_prefix_import(record)
+                    out = fut.result(api.request_timeout + 30.0)
+                except ValueError as e:
+                    self.send_error(400, _status_text(e))
+                    return
+                except SchedulerError as e:
+                    self._reply_scheduler_error(e)
+                    return
+                except concurrent.futures.TimeoutError:
+                    self._reply_error(408, "prefix import timed out")
+                    return
+                self._reply_json(out)
+
             def do_POST(self):
                 self._trace_ = None  # fresh id per request
                 self._tenant_ = None
@@ -988,6 +1132,20 @@ class RESTfulAPI(Unit):
                             e.status, _status_text(e),
                             retry_after=1 if e.status == 503
                             else None)
+                    except Exception as e:
+                        self.send_error(500, _status_text(e))
+                    return
+                if route in ("/serving/prefix_export",
+                             "/serving/prefix_import"):
+                    # deliberately NOT behind restful.generate: a
+                    # prefix transfer is cache plumbing, not a
+                    # client request — its faults are injected at
+                    # the router's router.prefix.fetch point
+                    try:
+                        if route == "/serving/prefix_export":
+                            self._serving_prefix_export()
+                        else:
+                            self._serving_prefix_import()
                     except Exception as e:
                         self.send_error(500, _status_text(e))
                     return

@@ -31,18 +31,66 @@ causal mask never reads them, and carrying them keeps the import a
 plain block scatter.  Importer validation (dtype/block-size/shape
 mismatches are client errors) lives in
 ``InferenceScheduler.submit_imported``.
+
+Binary wire (``application/x-veles-kv``)::
+
+    b"VKV1" | u32 header_len (LE) | header JSON (UTF-8) | raw bytes
+
+The header carries every scalar field of the JSON envelope plus an
+``arrays`` manifest — ``[{"key": ["logits"] | ["layers", "<i>",
+"<name>"], "dtype": ..., "shape": [...]}, ...]`` — and the payload is
+the C-order bytes of each manifest entry concatenated in order.  The
+decoder slices ``numpy.frombuffer`` views straight out of the frame
+(no base64, no per-element JSON), which is what makes this the fast
+path: encode is one memcpy per array, decode is zero-copy.  Both
+disagg handoffs and the router's peer prefix fetches speak it;
+``logits`` is optional so prefix records (blocks only, no sampling
+state) reuse the same frame.  An ``extra`` header field carries
+side-channel parameters (e.g. the decode hop's sampler settings) so
+binary POSTs need no JSON wrapper.
 """
 
 import base64
+import json
+import struct
 import uuid
 
 import numpy
+
+#: Content-Type / Accept token for the binary frame below.
+WIRE_CONTENT_TYPE = "application/x-veles-kv"
+
+_MAGIC = b"VKV1"
 
 
 def mint_handle():
     """An unguessable export handle (the record may hold model
     activations — the handle is the only capability to fetch it)."""
     return uuid.uuid4().hex
+
+
+def _np_dtype(name):
+    """``numpy.dtype`` by name, including the ml_dtypes extension
+    types numpy cannot look up itself (a bfloat16-pool export names
+    its storage dtype "bfloat16")."""
+    try:
+        return numpy.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        try:
+            return numpy.dtype(getattr(ml_dtypes, name))
+        except (AttributeError, TypeError):
+            raise ValueError("unknown kv wire dtype %r" % (name,))
+
+
+def _raw(a):
+    """A C-order bytes-like of ``a`` — the zero-copy memoryview when
+    the dtype speaks the buffer protocol, one memcpy (``tobytes``)
+    for the extension dtypes that refuse it (bfloat16's 'E')."""
+    try:
+        return a.data
+    except (TypeError, ValueError, BufferError):
+        return a.tobytes()
 
 
 def _encode_array(a):
@@ -53,24 +101,26 @@ def _encode_array(a):
 
 def _decode_array(obj):
     raw = base64.b64decode(obj["b64"])
-    return numpy.frombuffer(raw, dtype=numpy.dtype(obj["dtype"])) \
+    return numpy.frombuffer(raw, dtype=_np_dtype(obj["dtype"])) \
         .reshape([int(s) for s in obj["shape"]]).copy()
 
 
 def encode_export(record):
     """Serialize a scheduler export record (numpy arrays) into the
     JSON-safe envelope above."""
-    return {
+    out = {
         "handle": record["handle"],
         "prompt": [int(t) for t in record["prompt"]],
         "length": int(record["length"]),
         "kv_dtype": record["kv_dtype"],
         "block_size": int(record["block_size"]),
-        "logits": _encode_array(record["logits"]),
         "layers": {str(i): {n: _encode_array(a)
                             for n, a in layer.items()}
                    for i, layer in record["layers"].items()},
     }
+    if "logits" in record:
+        out["logits"] = _encode_array(record["logits"])
+    return out
 
 
 def decode_export(obj):
@@ -78,16 +128,129 @@ def decode_export(obj):
     ``submit_imported`` consumes.  Raises ``ValueError`` on a
     malformed payload (client error, not a replica fault)."""
     try:
-        return {
+        rec = {
             "handle": str(obj["handle"]),
             "prompt": [int(t) for t in obj["prompt"]],
             "length": int(obj["length"]),
             "kv_dtype": str(obj["kv_dtype"]),
             "block_size": int(obj["block_size"]),
-            "logits": _decode_array(obj["logits"]),
             "layers": {int(i): {n: _decode_array(a)
                                 for n, a in layer.items()}
                        for i, layer in obj["layers"].items()},
         }
+        if obj.get("logits") is not None:
+            rec["logits"] = _decode_array(obj["logits"])
+        return rec
     except (KeyError, TypeError, AttributeError) as e:
         raise ValueError("malformed kv export payload: %r" % (e,))
+
+
+def record_nbytes(record):
+    """Payload size of a record's arrays in bytes — the budgeting
+    unit for the export table's byte cap and the host tier."""
+    n = record["logits"].nbytes if "logits" in record else 0
+    for layer in record["layers"].values():
+        for a in layer.values():
+            n += a.nbytes
+    return n
+
+
+def _manifest(record):
+    """Deterministic array order for the binary frame: logits first
+    (when present), then layers by chain index, names sorted."""
+    entries = []
+    if "logits" in record:
+        entries.append((("logits",), record["logits"]))
+    for i in sorted(record["layers"]):
+        layer = record["layers"][i]
+        for n in sorted(layer):
+            entries.append((("layers", str(i), n), layer[n]))
+    return entries
+
+
+def encode_export_binary(record, extra=None):
+    """Frame a record as ``application/x-veles-kv`` bytes (see module
+    docstring).  ``extra`` (JSON-safe dict) rides in the header —
+    binary POST bodies carry their side parameters there instead of a
+    JSON wrapper."""
+    entries = [(key, numpy.ascontiguousarray(a))
+               for key, a in _manifest(record)]
+    header = {
+        "handle": record["handle"],
+        "prompt": [int(t) for t in record["prompt"]],
+        "length": int(record["length"]),
+        "kv_dtype": record["kv_dtype"],
+        "block_size": int(record["block_size"]),
+        "arrays": [{"key": list(key), "dtype": str(a.dtype),
+                    "shape": list(a.shape)} for key, a in entries],
+    }
+    if extra:
+        header["extra"] = extra
+    hjson = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    return b"".join([_MAGIC, struct.pack("<I", len(hjson)), hjson]
+                    + [_raw(a) for _, a in entries])
+
+
+def decode_export_binary(blob):
+    """Parse an ``application/x-veles-kv`` frame back into ``(record,
+    extra)``.  Array contents are zero-copy ``frombuffer`` views into
+    ``blob`` (read-only — importers scatter them, never mutate).
+    Raises ``ValueError`` on a malformed frame."""
+    try:
+        view = memoryview(blob)
+        if bytes(view[:4]) != _MAGIC:
+            raise ValueError("bad kv wire magic")
+        (hlen,) = struct.unpack("<I", view[4:8])
+        header = json.loads(bytes(view[8:8 + hlen]).decode("utf-8"))
+        record = {
+            "handle": str(header["handle"]),
+            "prompt": [int(t) for t in header["prompt"]],
+            "length": int(header["length"]),
+            "kv_dtype": str(header["kv_dtype"]),
+            "block_size": int(header["block_size"]),
+            "layers": {},
+        }
+        off = 8 + hlen
+        for ent in header["arrays"]:
+            dtype = _np_dtype(str(ent["dtype"]))
+            shape = [int(s) for s in ent["shape"]]
+            nbytes = dtype.itemsize * int(numpy.prod(shape, dtype=numpy.int64))
+            a = numpy.frombuffer(view[off:off + nbytes],
+                                 dtype=dtype).reshape(shape)
+            off += nbytes
+            key = ent["key"]
+            if key == ["logits"]:
+                record["logits"] = a
+            elif len(key) == 3 and key[0] == "layers":
+                record["layers"].setdefault(int(key[1]), {})[
+                    str(key[2])] = a
+            else:
+                raise ValueError("bad array key %r" % (key,))
+        if off != len(view):
+            raise ValueError("kv wire length mismatch")
+        return record, header.get("extra") or {}
+    except (KeyError, TypeError, AttributeError, struct.error,
+            json.JSONDecodeError) as e:
+        raise ValueError("malformed kv wire frame: %r" % (e,))
+
+
+def quantize_record(record):
+    """int8-quantize a fp32 record's K/V blocks in flight (PR 12's
+    per-row absmax machinery), shrinking the wire ~4x.  Lossy — never
+    used on parity-critical paths (disagg keeps the pool dtype); the
+    importer sees a regular int8 record with inline scales.  int8
+    records pass through untouched."""
+    if record["kv_dtype"] != "fp32":
+        return record
+    from ..ops import paged_attention as pa
+    layers = {}
+    for i, layer in record["layers"].items():
+        k_q, k_s = pa.quantize_kv_rows(layer["k"])
+        v_q, v_s = pa.quantize_kv_rows(layer["v"])
+        layers[i] = {"k": numpy.asarray(k_q), "v": numpy.asarray(v_q),
+                     "k_scale": numpy.asarray(k_s, dtype=numpy.float32),
+                     "v_scale": numpy.asarray(v_s, dtype=numpy.float32)}
+    out = dict(record)
+    out["kv_dtype"] = "int8"
+    out["layers"] = layers
+    return out

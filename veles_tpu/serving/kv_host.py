@@ -1,0 +1,167 @@
+"""Host-RAM overflow tier for the radix prefix cache.
+
+The radix cache (PR 9) lives in the device block pools, so its
+capacity is whatever HBM live requests leave over — hit rate
+collapses exactly when load rises.  This tier is the overflow: when
+admission pressure evicts a refcount-0 block from the trie, the
+scheduler first gathers its contents (``PagedKVCache.export_blocks``
+— int8 stays int8, scales ride along) and parks them HERE, keyed by
+the rolling digest of the token prefix the block completes
+(:func:`serving.prefix_cache.chunk_digests`).  A later admission
+whose prompt extends past its device-resident prefix into host
+territory PROMOTES those blocks back into freshly claimed device
+blocks and re-inserts them into the trie — the request then admits
+through the ordinary warm path (staging gather + chunked prefill of
+the cold tail).  Effective cache capacity becomes HBM + host RAM.
+
+Storage is the :class:`memory.Array` host/device pair protocol with
+only the host half populated: each demoted array is adopted as a
+host mirror (``HOST_DIRTY``), and the promotion scatter is the
+first — and only — device upload it ever gets.  The tier's bytes are
+visible in ``memory.Watcher`` under :data:`WATCH_KEY`, bounded by a
+byte budget with LRU eviction.
+
+Consistency: a digest names a full token path, and each entry stores
+its own chunk tokens, so a match re-verifies tokens level by level —
+a crc32 collision degrades to a miss, never to wrong KV.  Evicting a
+mid-chain entry orphans its descendants (the match walk breaks at
+the gap); orphans are never touched again, so LRU ages them out.
+Single-threaded like the trie: the scheduler loop owns every call.
+"""
+
+import numpy
+
+from .. import memory
+from .prefix_cache import chunk_digests
+
+#: ``memory.Watcher`` accounting key for host-tier bytes.
+WATCH_KEY = "host:kv-tier"
+
+
+class _HostBlock:
+    __slots__ = ("digest", "key", "depth", "layers", "nbytes",
+                 "stamp")
+
+    def __init__(self, digest, key, depth, layers, nbytes, stamp):
+        self.digest = digest      # rolling digest of the full path
+        self.key = key            # this block's block_size tokens
+        self.depth = depth        # 0-based chunk index in the path
+        self.layers = layers      # {chain idx: {name: memory.Array}}
+        self.nbytes = nbytes
+        self.stamp = stamp        # LRU tick of the last touch
+
+
+class HostKVTier:
+    """Byte-budgeted, LRU host store of demoted KV blocks."""
+
+    def __init__(self, byte_budget, block_size):
+        self.byte_budget = int(byte_budget)
+        self.block_size = int(block_size)
+        self._entries = {}        # digest -> _HostBlock
+        self._clock = 0
+        self.bytes = 0            # resident payload bytes (gauge)
+        self.demotions = 0        # blocks accepted, cumulative
+        self.promotions = 0       # blocks promoted out, cumulative
+        self.evictions = 0        # blocks LRU-dropped, cumulative
+
+    @property
+    def blocks(self):
+        return len(self._entries)
+
+    def digests(self):
+        """Every resident path digest — merged into the replica's
+        cache-topology advertisement next to the trie's."""
+        return list(self._entries)
+
+    # -- demote ----------------------------------------------------------
+
+    def put(self, path_tokens, layers):
+        """Adopt one evicted block's contents.  ``path_tokens`` is
+        the full token prefix the block completes (must be
+        block-aligned); ``layers`` is ``export_blocks`` output for
+        that single block — ``{chain idx: {name: [1, bs, d] numpy}}``.
+        Returns True when adopted (False: over-budget singleton or
+        unaligned path)."""
+        bs = self.block_size
+        if not path_tokens or len(path_tokens) % bs:
+            return False
+        held = {}
+        nbytes = 0
+        for i, layer in layers.items():
+            held[int(i)] = row = {}
+            for name, a in layer.items():
+                arr = memory.Array(numpy.ascontiguousarray(a))
+                row[str(name)] = arr
+                nbytes += arr.mem.nbytes
+        if nbytes > self.byte_budget:
+            return False
+        self._clock += 1
+        digest = chunk_digests(path_tokens, bs)[-1]
+        old = self._entries.pop(digest, None)
+        if old is not None:
+            self._drop(old)
+        while self.bytes + nbytes > self.byte_budget:
+            if not self._evict_lru():
+                return False
+        self._entries[digest] = _HostBlock(
+            digest, tuple(int(t) for t in path_tokens[-bs:]),
+            len(path_tokens) // bs - 1, held, nbytes, self._clock)
+        self.bytes += nbytes
+        self.demotions += 1
+        memory.Watcher.alloc(WATCH_KEY, nbytes)
+        return True
+
+    # -- promote ---------------------------------------------------------
+
+    def match(self, tokens, start_blocks, max_blocks=None):
+        """The host extension of a device-resident prefix: entries
+        for consecutive chunks of ``tokens`` starting at depth
+        ``start_blocks``, token-verified level by level.  Entries are
+        NOT removed — call :meth:`pop` once their promotion lands."""
+        bs = self.block_size
+        digs = chunk_digests(tokens, bs)
+        stop = len(digs)
+        if max_blocks is not None:
+            stop = min(stop, int(start_blocks) + int(max_blocks))
+        out = []
+        self._clock += 1
+        for d in range(int(start_blocks), stop):
+            e = self._entries.get(digs[d])
+            if e is None or e.depth != d or e.key != tuple(
+                    int(t) for t in tokens[d * bs:(d + 1) * bs]):
+                break
+            e.stamp = self._clock
+            out.append(e)
+        return out
+
+    def pop(self, entries):
+        """Remove promoted entries (their contents now live in device
+        blocks — keeping the host copy would double-count the budget;
+        a later device eviction re-demotes them)."""
+        for e in entries:
+            if self._entries.pop(e.digest, None) is not None:
+                self._drop(e)
+                self.promotions += 1
+
+    # -- budget ----------------------------------------------------------
+
+    def _drop(self, entry):
+        self.bytes -= entry.nbytes
+        memory.Watcher.free(WATCH_KEY, entry.nbytes)
+
+    def _evict_lru(self):
+        victim = None
+        for e in self._entries.values():
+            if victim is None or e.stamp < victim.stamp:
+                victim = e
+        if victim is None:
+            return False
+        del self._entries[victim.digest]
+        self._drop(victim)
+        self.evictions += 1
+        return True
+
+    def clear(self):
+        for e in list(self._entries.values()):
+            self._drop(e)
+        self._entries.clear()

@@ -460,6 +460,18 @@ class PagedKVCache:
                 raise ValueError("block %d double-freed" % b)
             self._free_blocks.append(b)
 
+    def take_free_blocks(self, n):
+        """Claim ``n`` blocks off the free list OUTSIDE the slot
+        machinery — the tiered-KV ingest path (host-tier promotion,
+        peer prefix import) fills them via :meth:`import_blocks` and
+        hands ownership straight to the prefix cache.  Returns the
+        id list, or None when the free list is short (the ingest is
+        best-effort and simply stays cold)."""
+        n = int(n)
+        if n < 0 or n > len(self._free_blocks):
+            return None
+        return [self._free_blocks.pop() for _ in range(n)]
+
     def check(self, resident=()):
         """Invariant sweep (tests): every block is exactly one of
         {trash, free, resident-in-the-prefix-cache,

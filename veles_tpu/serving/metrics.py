@@ -384,6 +384,36 @@ def _registry_series():
             "veles_serving_kv_export_fetched_total",
             "export records claimed by their one-shot fetch; "
             "labeled per replica", labelnames=("replica",)),
+        # host-RAM KV overflow tier (serving/kv_host.py): demotions
+        # park evicted prefix blocks in host RAM, promotions bring
+        # them back on a matching admission.  Sustained promotion ~=
+        # demotion churn means the budget is too small for the
+        # working set (the kv_host_thrash alert rule)
+        "kv_host_blocks": metrics.gauge(
+            "veles_serving_kv_host_blocks",
+            "KV blocks resident in the host-RAM overflow tier; "
+            "labeled per replica", labelnames=("replica",)),
+        "kv_host_bytes": metrics.gauge(
+            "veles_serving_kv_host_bytes",
+            "payload bytes resident in the host-RAM overflow tier "
+            "(bounded by kv_host_bytes); labeled per replica",
+            labelnames=("replica",)),
+        "kv_host_promotions": metrics.counter(
+            "veles_serving_kv_host_promotions_total",
+            "host-tier blocks promoted back into device pools on a "
+            "matching admission (incl. peer-prefix imports); "
+            "labeled per replica", labelnames=("replica",)),
+        "kv_host_demotions": metrics.counter(
+            "veles_serving_kv_host_demotions_total",
+            "evicted prefix blocks demoted into the host tier "
+            "instead of dropped; labeled per replica",
+            labelnames=("replica",)),
+        "kv_host_thrash": metrics.gauge(
+            "veles_serving_kv_host_thrash_rate",
+            "min(promotion, demotion) blocks/s over the recent "
+            "window — high when blocks ping-pong between tiers "
+            "(the kv_host_thrash alert rule); labeled per replica",
+            labelnames=("replica",)),
         "ttft_p95": metrics.gauge(
             "veles_serving_ttft_p95_ms",
             "recent-window TTFT p95 as a gauge (the histogram's "
@@ -474,6 +504,15 @@ def _router_series():
             "/generate requests served disaggregated: prefill on a "
             "prefill-specialist, KV export handed to a decode "
             "replica"),
+        "prefix_fetches": metrics.counter(
+            "veles_router_prefix_peer_fetches_total",
+            "prefix blocks shipped replica-to-replica ahead of a "
+            "request (fleet-wide prefix store: export from the "
+            "holder, import on the target)"),
+        "prefix_fetch_fails": metrics.counter(
+            "veles_router_prefix_peer_fetch_fails_total",
+            "peer prefix transfers that failed or were dropped — "
+            "the request still runs, just cold"),
         "breaker_state": metrics.gauge(
             "veles_router_breaker_state",
             "per-replica circuit breaker: 0 closed, 1 half-open, "
@@ -552,6 +591,8 @@ class RouterMetrics:
         self.hedge_wins = 0
         self.shed = 0
         self.disagg_handoffs = 0
+        self.prefix_fetches = 0
+        self.prefix_fetch_fails = 0
         self.restarts = 0
         self.drains = 0
         self.streams = 0
@@ -601,6 +642,16 @@ class RouterMetrics:
         with self._lock:
             self.disagg_handoffs += 1
         self._global["disagg"].inc()
+
+    def record_prefix_fetch(self, blocks=1):
+        with self._lock:
+            self.prefix_fetches += 1
+        self._global["prefix_fetches"].inc(int(blocks))
+
+    def record_prefix_fetch_fail(self):
+        with self._lock:
+            self.prefix_fetch_fails += 1
+        self._global["prefix_fetch_fails"].inc()
 
     def record_breaker(self, replica, state):
         self._global["breaker_state"].labels(
@@ -680,6 +731,8 @@ class RouterMetrics:
                 "shed": self.shed,
                 "streams_pinned": self.streams,
                 "stream_failovers": dict(self.stream_failovers),
+                "prefix_peer_fetches": self.prefix_fetches,
+                "prefix_peer_fetch_fails": self.prefix_fetch_fails,
                 "replica_restarts": self.restarts,
                 "replica_drains": self.drains,
             }
@@ -736,6 +789,11 @@ class ServingMetrics:
         self._steps = deque(maxlen=recent)
         #: recent prefix lookups (True = hit) for the windowed rate
         self._prefix_recent = deque(maxlen=64)
+        self.kv_host_promotions = 0     # host tier -> device blocks
+        self.kv_host_demotions = 0      # device -> host tier blocks
+        #: recent host-tier movements feeding the thrash-rate gauge:
+        #: (t, promoted, demoted)
+        self._kv_host_recent = deque(maxlen=64)
         #: per-tenant usage accumulators, keyed by BOUNDED label —
         #: the scheduler-side metering ground truth the
         #: /tenants/usage fleet rollup must equal exactly:
@@ -965,6 +1023,39 @@ class ServingMetrics:
 
     def record_prefix_evict(self, blocks):
         self._global["prefix_evictions"].inc(int(blocks))
+
+    def record_kv_host(self, promoted=0, demoted=0):
+        """Host-tier block movement at one boundary; also refreshes
+        the thrash-rate gauge — min(promotion, demotion) blocks/s
+        over the recent window, which is high exactly when the same
+        blocks ping-pong between tiers (budget too small for the
+        working set) and near zero for healthy one-way flow."""
+        promoted, demoted = int(promoted), int(demoted)
+        now = time.monotonic()
+        with self._lock:
+            self.kv_host_promotions += promoted
+            self.kv_host_demotions += demoted
+            self._kv_host_recent.append((now, promoted, demoted))
+            window = list(self._kv_host_recent)
+        if promoted:
+            self._global["kv_host_promotions"].labels(
+                replica=self.replica).inc(promoted)
+        if demoted:
+            self._global["kv_host_demotions"].labels(
+                replica=self.replica).inc(demoted)
+        span = now - window[0][0]
+        if span <= 0 or len(window) < 2:
+            return
+        rate = min(sum(w[1] for w in window),
+                   sum(w[2] for w in window)) / span
+        self._global["kv_host_thrash"].labels(
+            replica=self.replica).set(round(rate, 4))
+
+    def set_kv_host(self, blocks, nbytes):
+        self._global["kv_host_blocks"].labels(
+            replica=self.replica).set(int(blocks))
+        self._global["kv_host_bytes"].labels(
+            replica=self.replica).set(int(nbytes))
 
     def set_prefix_blocks(self, resident, shared):
         self._global["prefix_resident"].set(int(resident))

@@ -28,7 +28,35 @@ Ownership contract with :class:`serving.kv_slots.PagedKVCache`:
 Single-threaded like the block cache: the scheduler's decode loop
 owns every mutating call; the lock-free counters read by metrics are
 monitoring-grade.
+
+Digests: the fleet tier (tiered KV, PR 19) identifies a resident
+prefix by a ROLLING crc32 over its block-aligned token chunks —
+``chunk_digests`` below is the one shared definition (scheduler
+advertisement, host-tier keys, and router topology lookups must all
+agree bit-for-bit).  crc32 is 32-bit, so a digest match is a HINT:
+every consumer re-verifies against actual tokens (the host tier
+stores them; a stale router hint just yields a 404'd peer fetch).
 """
+
+import zlib
+
+
+def chunk_digests(tokens, block_size, max_depth=None):
+    """Rolling digests of ``tokens`` at block granularity: entry i is
+    the crc32 of chunks 0..i chained (chunk i's canonical bytes,
+    seeded with digest i-1).  The fleet-wide name of the prefix
+    ``tokens[:(i + 1) * block_size]``."""
+    bs = int(block_size)
+    n = len(tokens) // bs
+    if max_depth is not None:
+        n = min(n, int(max_depth))
+    out, d = [], 0
+    for i in range(n):
+        chunk = tokens[i * bs:(i + 1) * bs]
+        d = zlib.crc32(
+            (",".join(str(int(t)) for t in chunk)).encode("ascii"), d)
+        out.append(d)
+    return out
 
 
 class _Node:
@@ -127,6 +155,49 @@ class RadixPrefixCache:
         :meth:`match` without pinning (admission sizing)."""
         return len(self._walk(tokens, max_blocks))
 
+    def resident_prefix(self, tokens, max_blocks=None):
+        """Block ids of the resident leading chunks of ``tokens`` —
+        :meth:`match` without pinning or hit/miss accounting.  For
+        loop-thread probes that read the blocks synchronously (the
+        tiered-KV prefix export and the promotion depth check): no
+        other mutation can interleave, so pins would be dead
+        weight and the stats would double-count the admission's
+        own lookup."""
+        return [n.block for n in self._walk(tokens, max_blocks)]
+
+    def path_digests(self, max_entries=1024):
+        """Rolling digests (:func:`chunk_digests`) of every resident
+        path, breadth-first so shallow — most shareable — prefixes
+        survive the cap.  This is the replica's cache-topology
+        advertisement: the router matches a prompt's own digests
+        against these to find the longest resident prefix fleet-wide."""
+        out = []
+        queue = [(0, n) for n in self._root.values()]
+        while queue and len(out) < int(max_entries):
+            next_q = []
+            for seed, node in queue:
+                d = zlib.crc32(
+                    (",".join(str(int(t)) for t in node.key))
+                    .encode("ascii"), seed)
+                out.append(d)
+                if len(out) >= int(max_entries):
+                    break
+                next_q.extend((d, c) for c in node.children.values())
+            queue = next_q
+        return out
+
+    def _path_tokens(self, node):
+        """The full token prefix a node's block completes (root keys
+        concatenated) — the demotion path's host-tier key."""
+        keys = []
+        while node is not None:
+            keys.append(node.key)
+            node = node.parent
+        out = []
+        for key in reversed(keys):
+            out.extend(key)
+        return tuple(out)
+
     # -- match / release -------------------------------------------------
 
     def _chunks(self, tokens, max_blocks=None):
@@ -209,6 +280,13 @@ class RadixPrefixCache:
         """Free up to ``n_blocks`` blocks, LRU-first over refcount-0
         LEAVES (peeling a cold chain bottom-up), and return their
         ids for ``PagedKVCache.reclaim``."""
+        return [bid for bid, _ in self.evict_with_paths(n_blocks)]
+
+    def evict_with_paths(self, n_blocks):
+        """:meth:`evict`, but each freed block comes with the full
+        token prefix it completed — ``[(block_id, path_tokens)]`` —
+        so the demotion path can re-key the contents into the host
+        tier before the device block is reclaimed."""
         freed = []
         while len(freed) < int(n_blocks):
             victim = None
@@ -223,7 +301,8 @@ class RadixPrefixCache:
                     stack.append((node, node.children))
             if victim is None:
                 break
-            freed.append(self._evict_node(victim))
+            path = self._path_tokens(victim)
+            freed.append((self._evict_node(victim), path))
         return freed
 
     def _evict_node(self, node):

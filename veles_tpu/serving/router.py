@@ -68,6 +68,24 @@ registry) into fleet behavior:
   ``GET /v1/models`` forward with the same affinity/retry/breaker
   machinery as ``/generate``.
 
+- **cache-topology routing + prefix shipping (PR 19)** — each
+  metrics poll carries the replica's ``prefix_digests``
+  advertisement (rolling crc32 path digests of every resident
+  prefix, device trie + host tier).  For single-row ``/generate``
+  bodies the router computes the prompt's own digests and routes to
+  the replica holding the LONGEST resident prefix — an upgrade over
+  blind crc32 affinity, which spreads identical prompts by hash
+  regardless of who is actually warm.  When a PEER holds a prefix
+  ``prefix_fetch_min`` blocks longer than the chosen target's, the
+  router first SHIPS it: ``POST /serving/prefix_export`` on the
+  peer (binary KV wire, ``application/x-veles-kv``) → ``POST
+  /serving/prefix_import`` on the target — so one replica's warm
+  cache seeds another's and a drained replica's warmth is rescued
+  before it dies.  Both steps are best-effort: any failure counts
+  ``veles_router_prefix_peer_fetch_fails_total`` and the request
+  proceeds cold.  Fault point ``router.prefix.fetch`` (keyed by the
+  holder id) injects exactly the peer-death window.
+
 - **request tracing + SLOs** — every request gets a trace id at the
   edge (``X-Veles-Trace``, accepted-or-minted, echoed on EVERY reply
   including structured errors) that is propagated to the replica; the
@@ -100,7 +118,9 @@ import zlib
 
 from veles_tpu import faults
 from veles_tpu.logger import Logger, events
+from veles_tpu.serving.disagg import WIRE_CONTENT_TYPE
 from veles_tpu.serving.metrics import RouterMetrics
+from veles_tpu.serving.prefix_cache import chunk_digests
 from veles_tpu.telemetry import reqtrace
 from veles_tpu.telemetry.spans import next_span_id
 from veles_tpu.tenant import TenantAdmission
@@ -124,7 +144,7 @@ class _Replica(object):
                  "health_failures", "breaker", "failures",
                  "opened_at", "probing", "saturated_until",
                  "last_health", "last_metrics", "requests", "role",
-                 "last_scrape", "scrape_failed")
+                 "last_scrape", "scrape_failed", "prefix_digests")
 
     def __init__(self, replica_id, host, port):
         self.id = str(replica_id)
@@ -146,6 +166,10 @@ class _Replica(object):
         self.last_metrics = None
         self.last_scrape = None   # latest /metrics exposition text
         self.scrape_failed = False
+        #: cache-topology advertisement off the last metrics poll:
+        #: rolling digests of every prefix resident on the replica
+        #: (device trie + host tier) — the routing warmth signal
+        self.prefix_digests = frozenset()
         self.requests = 0
 
     def view(self):
@@ -181,6 +205,11 @@ class _Replica(object):
             # well-aimed router keeps this high on repeat traffic
             "prefix_hit_rate": (self.last_metrics or {}).get(
                 "prefix_cache_hit_rate"),
+            # tiered-KV topology: how much warmth the replica
+            # advertises, and how much of it lives in host RAM
+            "prefix_digests": len(self.prefix_digests),
+            "kv_host_blocks": (self.last_metrics or {}).get(
+                "kv_host_blocks"),
             "spec_accept_rate": (self.last_metrics or {}).get(
                 "spec_accept_rate"),
             # per-priority-class QoS counters (TTFT p95, preempts,
@@ -229,7 +258,9 @@ class Router(Logger):
                  breaker_failures=None, breaker_cooldown=None,
                  retries=None, retry_delay=None, retry_cap=None,
                  hedge_delay=None, affinity_tokens=None,
-                 request_timeout=None, shed_retry_after=None):
+                 request_timeout=None, shed_retry_after=None,
+                 prefix_routing=None, prefix_fetch=None,
+                 prefix_fetch_min=None):
         super(Router, self).__init__()
         self.host = host
         self.port = int(port)
@@ -268,6 +299,19 @@ class Router(Logger):
         self.shed_retry_after = int(
             _router_conf("shed_retry_after", 2)
             if shed_retry_after is None else shed_retry_after)
+        #: tiered-KV topology (PR 19): route /generate on the
+        #: longest advertised resident prefix instead of blind crc32
+        #: affinity, and ship a peer's longer prefix onto the target
+        #: when it leads by >= prefix_fetch_min blocks
+        self.prefix_routing = bool(
+            _router_conf("prefix_routing", True)
+            if prefix_routing is None else prefix_routing)
+        self.prefix_fetch = bool(
+            _router_conf("prefix_fetch", True)
+            if prefix_fetch is None else prefix_fetch)
+        self.prefix_fetch_min = int(
+            _router_conf("prefix_fetch_min", 2)
+            if prefix_fetch_min is None else prefix_fetch_min)
         self.stats = RouterMetrics()
         #: per-tenant identity + admission (tenant/admission.py):
         #: tagging is always on, the bucket/lane enforce only when
@@ -482,11 +526,62 @@ class Router(Logger):
                 if r.id not in exclude and self._serves(r, phase)
                 and self._eligible(r, now)]
 
-    def _pick(self, affinity, now, exclude=(), phase="decode"):
+    @staticmethod
+    def _prompt_row(raw):
+        """The single prompt row of a /generate body as an int list,
+        or None when the body is not topology-routable (multi-row,
+        non-token prompt, malformed — those keep the affinity
+        path)."""
+        try:
+            body = json.loads(raw.decode() or "{}")
+        except Exception:
+            return None
+        prompt = body.get("prompt")
+        if not isinstance(prompt, list) or not prompt:
+            return None
+        if isinstance(prompt[0], list):
+            if len(prompt) != 1:
+                return None  # batch rows share one replica anyway
+            row = prompt[0]
+        else:
+            row = prompt
+        if not row or not all(
+                isinstance(t, int) and not isinstance(t, bool)
+                for t in row):
+            return None
+        return row
+
+    @staticmethod
+    def _match_depth(rep, row, memo):
+        """How many leading block chunks of prompt ``row`` the
+        replica advertises as resident (device trie + host tier).
+        ``memo`` caches the prompt's digests per block size across
+        one request's replica comparisons.  A digest is a 32-bit
+        HINT — the replica re-verifies tokens on admission, so an
+        overcount here costs a miss, never wrong KV."""
+        if not rep.prefix_digests:
+            return 0
+        bs = (rep.last_metrics or {}).get("kv_block_size")
+        if not bs:
+            return 0
+        bs = int(bs)
+        digs = memo.get(bs)
+        if digs is None:
+            digs = memo[bs] = chunk_digests(row, bs)
+        n = 0
+        for d in digs:
+            if d not in rep.prefix_digests:
+                break
+            n += 1
+        return n
+
+    def _pick(self, affinity, now, exclude=(), phase="decode",
+              row=None, memo=None):
         """Choose the attempt's replica: a half-open breaker's probe
-        first (recovery must not wait for idle), then the affinity
-        target, then least-outstanding (ties by id for
-        determinism)."""
+        first (recovery must not wait for idle), then the replica
+        advertising the longest resident prefix of ``row`` (when
+        prefix routing supplied one), then the affinity target, then
+        least-outstanding (ties by id for determinism)."""
         candidates = self._pickable(now, exclude, phase)
         if not candidates:
             return None
@@ -495,6 +590,12 @@ class Router(Logger):
             rep = min(half, key=lambda r: r.id)
             rep.probing = True
             return rep
+        if row is not None:
+            warm = min(candidates,
+                       key=lambda r: (-self._match_depth(r, row, memo),
+                                      r.outstanding, r.id))
+            if self._match_depth(warm, row, memo) > 0:
+                return warm
         if affinity is not None:
             # rendezvous hash over the FULL registry (stable under
             # breaker flaps), honored only when the owner is eligible
@@ -730,10 +831,15 @@ class Router(Logger):
                 "attempts": 0, "replica": None, "stream": False,
                 "cls": cls, "tenant": tenant}
         self._inflight[seq] = info
+        # cache-topology routing: only single-row token /generate
+        # bodies carry a routable prefix; everything else keeps the
+        # affinity path untouched
+        row = self._prompt_row(raw) if self.prefix_routing \
+            and method == "POST" and path == "/generate" else None
         try:
             return await self._forward_attempts(
                 path, raw, headers, method, trace, t0, deadline,
-                idempotent, affinity, cls, info)
+                idempotent, affinity, cls, info, row=row)
         finally:
             self._inflight.pop(seq, None)
             if root_span is not None:
@@ -745,15 +851,16 @@ class Router(Logger):
 
     async def _forward_attempts(self, path, raw, headers, method,
                                 trace, t0, deadline, idempotent,
-                                affinity, cls, info):
+                                affinity, cls, info, row=None):
         best_tokens = None
         last = None
         attempts = 0
+        memo = {}
         while attempts < self.retries:
             now = time.monotonic()
             if now >= deadline:
                 break
-            rep = self._pick(affinity, now)
+            rep = self._pick(affinity, now, row=row, memo=memo)
             if rep is None:
                 break  # fleet-level shed (or nothing left to try)
             attempts += 1
@@ -761,6 +868,12 @@ class Router(Logger):
             info["replica"] = rep.id
             if attempts > 1:
                 self.stats.record_retry()
+            elif row is not None and self.prefix_fetch:
+                # first attempt only: ship a peer's longer resident
+                # prefix onto the chosen replica before forwarding
+                # (best-effort — a failed fetch just admits cold)
+                await self._maybe_prefix_fetch(
+                    rep, row, memo, trace, deadline)
             out = await self._attempt_hedged(
                 rep, raw, headers, deadline - now, idempotent, now,
                 path=path, method=method, trace=trace,
@@ -826,8 +939,10 @@ class Router(Logger):
             lines = ["%s %s HTTP/1.1" % (method, path),
                      "Host: %s:%d" % (rep.host, rep.port),
                      "Connection: close",
-                     "Content-Length: %d" % len(blob),
-                     "Content-Type: application/json"]
+                     "Content-Length: %d" % len(blob)]
+            if not any(k.lower() == "content-type"
+                       for k in (headers or {})):
+                lines.append("Content-Type: application/json")
             for k, v in (headers or {}).items():
                 lines.append("%s: %s" % (k, v))
             writer.write(("\r\n".join(lines) + "\r\n\r\n").encode()
@@ -1389,6 +1504,10 @@ class Router(Logger):
                 self._http(rep, "GET", "/serving/metrics", None),
                 self.health_timeout)
             rep.last_metrics = json.loads(mbody.decode())
+            digs = rep.last_metrics.get("prefix_digests")
+            rep.prefix_digests = frozenset(
+                int(d) for d in digs) if isinstance(digs, list) \
+                else frozenset()
         except asyncio.CancelledError:
             raise
         except Exception:
@@ -1420,7 +1539,11 @@ class Router(Logger):
                      "Host: %s:%d" % (rep.host, rep.port),
                      "Connection: close",
                      "Content-Length: %d" % len(blob)]
-            if body is not None:
+            # an explicit Content-Type (the binary KV wire) wins
+            # over the JSON default — never send the header twice
+            if body is not None and not any(
+                    k.lower() == "content-type"
+                    for k in (headers or {})):
                 lines.append("Content-Type: application/json")
             for k, v in (headers or {}).items():
                 lines.append("%s: %s" % (k, v))
@@ -1613,6 +1736,86 @@ class Router(Logger):
             return 200, rheaders, json.dumps(
                 {"tokens": toks if squeeze else [toks]}).encode()
         return None
+
+    async def _maybe_prefix_fetch(self, target, row, memo, trace,
+                                  deadline):
+        """Ship the prompt's warm prefix onto ``target`` before the
+        forward: when a PEER advertises a resident prefix at least
+        ``prefix_fetch_min`` blocks longer than the target's, fetch
+        it over the binary KV wire (``POST /serving/prefix_export``
+        on the peer, Accept ``application/x-veles-kv``) and import it
+        into the target (``POST /serving/prefix_import``, same
+        frame).  DRAINING peers still qualify as holders — a
+        draining replica's cache is exactly the warmth worth
+        rescuing, and its scheduler serves prefix exports to the
+        end.  Best-effort throughout: every failed leg counts
+        ``prefix_fetch_fails`` and the request proceeds cold; the
+        second-best holder gets one retry.  Fault point
+        ``router.prefix.fetch`` (keyed by the holder id) injects the
+        peer dying between advertisement and fetch."""
+        have = self._match_depth(target, row, memo)
+        holders = [r for r in self._replicas.values()
+                   if r.id != target.id and r.healthy
+                   and self._match_depth(r, row, memo) - have
+                   >= self.prefix_fetch_min]
+        holders.sort(key=lambda r: (-self._match_depth(r, row, memo),
+                                    r.outstanding, r.id))
+        for holder in holders[:2]:
+            budget = min(deadline - time.monotonic(), 10.0)
+            if budget <= 0:
+                return
+            try:
+                dropped = await asyncio.get_running_loop() \
+                    .run_in_executor(None, faults.fire,
+                                     "router.prefix.fetch", holder.id)
+            except faults.InjectedFault:
+                dropped = True
+            blob = None
+            if not dropped:
+                try:
+                    status, rheaders, body = await asyncio.wait_for(
+                        self._http(
+                            holder, "POST", "/serving/prefix_export",
+                            json.dumps({"tokens": row}).encode(),
+                            {"Accept": WIRE_CONTENT_TYPE}),
+                        budget)
+                    ctype = rheaders.get("content-type", "") \
+                        .split(";")[0].strip().lower()
+                    if status == 200 and ctype == WIRE_CONTENT_TYPE:
+                        blob = body
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    blob = None
+            if blob is None:
+                # advertisement was stale (evicted since the poll),
+                # the peer died, or the drop was injected — next
+                self.stats.record_prefix_fetch_fail()
+                continue
+            budget = min(deadline - time.monotonic(), 10.0)
+            if budget <= 0:
+                return
+            try:
+                status, _, rbody = await asyncio.wait_for(
+                    self._http(
+                        target, "POST", "/serving/prefix_import",
+                        blob, {"Content-Type": WIRE_CONTENT_TYPE}),
+                    budget)
+                if status == 200:
+                    blocks = int(json.loads(
+                        rbody.decode()).get("blocks") or 0)
+                    self.stats.record_prefix_fetch(max(1, blocks))
+                    self.info("prefix fetch %s -> %s: %d block(s)",
+                              holder.id, target.id, blocks)
+                    return
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass
+            # the import leg failed (target busy/shape mismatch) —
+            # a second holder's export rarely helps, but it is the
+            # only remaining card and costs one bounded POST
+            self.stats.record_prefix_fetch_fail()
 
     def _fleet_families(self):
         """loop thread: every replica's last-polled /metrics text

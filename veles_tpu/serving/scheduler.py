@@ -183,6 +183,7 @@ from veles_tpu.telemetry import reqtrace
 from veles_tpu.serving.engine import (
     first_tokens, paged_decode_step, slot_decode_step,
     verify_step_paged, verify_supported)
+from veles_tpu.serving.kv_host import HostKVTier
 from veles_tpu.serving.kv_slots import (
     PagedKVCache, SlotKVCache, paged_supported)
 from veles_tpu.serving.metrics import ServingMetrics
@@ -273,11 +274,19 @@ class RoleMismatchError(SchedulerError):
 
 
 #: how long an unclaimed KV export survives (seconds) and how many
-#: records one prefill replica parks at once — the handoff is
-#: immediate in a healthy fleet; these bound a crashed decode pool's
-#: leak
+#: payload BYTES one prefill replica parks at once (the
+#: ``kv_export_bytes`` knob's default) — the handoff is immediate in
+#: a healthy fleet; these bound a crashed decode pool's leak.  A byte
+#: budget replaces the old flat count-64 cap: records are whole
+#: prompts of KV, so counting records let 64 long-prompt exports pin
+#: unbounded host RAM while starving nothing
 EXPORT_TTL = 120.0
-EXPORT_CAP = 64
+EXPORT_BYTES = 256 << 20
+
+#: cap on the per-replica cache-topology advertisement
+#: (``prefix_digests`` in the metrics scrape) — breadth-first, so
+#: the shallow, most shareable prefixes survive the cut
+_DIGEST_MAX = 512
 
 
 def _bucket(n, floor, cap):
@@ -377,7 +386,8 @@ class InferenceScheduler(Logger):
                  request_timeout=None, watchdog=None,
                  shed_block_factor=None, spec=None, spec_k=None,
                  prefix_cache=None, prefix_evict=None, tp=None,
-                 role=None, replica_id=None):
+                 role=None, replica_id=None, kv_host_bytes=None,
+                 kv_export_bytes=None):
         super(InferenceScheduler, self).__init__()
         if not serving_supported(forwards):
             raise ValueError(
@@ -486,6 +496,25 @@ class InferenceScheduler(Logger):
         self.prefix_evict = bool(
             _serving_conf("prefix_evict", True)
             if prefix_evict is None else prefix_evict)
+        #: host-RAM overflow tier byte budget (serving/kv_host.py):
+        #: prefix-cache evictions demote block contents to host RAM
+        #: instead of dropping them, and matching admissions promote
+        #: them back.  0 disables (the tier-1 baseline); needs the
+        #: prefix cache (the tier is keyed by its token paths)
+        hb = int(_serving_conf("kv_host_bytes", 0)
+                 if kv_host_bytes is None else kv_host_bytes or 0)
+        if hb and not pfx:
+            self.info("kv_host_bytes needs the prefix cache; host "
+                      "tier disabled")
+            hb = 0
+        self.kv_host_bytes = hb
+        #: parked-export byte budget (replaces the flat count cap):
+        #: oldest unclaimed records pay when a new park would
+        #: overflow it, counted as expiries
+        self.kv_export_bytes = int(
+            _serving_conf("kv_export_bytes", EXPORT_BYTES)
+            if kv_export_bytes is None else kv_export_bytes
+            or EXPORT_BYTES)
         #: tensor-parallel mesh size (0 = off): shards the jitted
         #: steps over a {"tp": N} mesh — Megatron weight splits +
         #: head-wise paged pools, per-chip kv_blocks HBM / N
@@ -532,6 +561,7 @@ class InferenceScheduler(Logger):
             else "sched%d" % next(_SCHED_SEQ)
         self.stats = ServingMetrics(replica=self.replica_id)
         self._exports = {}           # handle -> export record (lock)
+        self._exports_bytes = 0      # parked payload bytes (lock)
         self._exports_claimed = {}   # handle -> fetch time (lock) —
         #                              what tells a double-fetch race
         #                              (409) from a junk handle (404)
@@ -557,6 +587,9 @@ class InferenceScheduler(Logger):
         self._preempts_owed = []     # eviction demands (class bound
         #                              per entry; None = any victim)
         self._aux = collections.deque()  # embed/score jobs (loop-run)
+        self._prefix_jobs = collections.deque()  # tiered-KV prefix
+        #                              export/import jobs (loop-run,
+        #                              one per boundary like _aux)
         self._queued_blocks = 0      # block budget committed in-queue
         self._beat = None            # loop-iteration heartbeat stamp
         self._working = False        # loop mid-iteration (not parked)
@@ -566,6 +599,12 @@ class InferenceScheduler(Logger):
         self._ready = threading.Event()
         self.cache_ = None           # set by the loop thread
         self.prefix_ = None          # radix cache (loop thread too)
+        #: host KV tier — constructed HERE (no device dependencies)
+        #: so the reference is immutable across threads; only the
+        #: loop thread mutates its contents
+        self.host_ = HostKVTier(self.kv_host_bytes,
+                                self.block_size) \
+            if self.kv_host_bytes > 0 else None
 
     # -- client side ----------------------------------------------------
 
@@ -810,6 +849,7 @@ class InferenceScheduler(Logger):
             self._sweep_exports_locked(now)
             rec = self._exports.pop(str(handle), None)
             if rec is not None:
+                self._exports_bytes -= rec.get("bytes", 0)
                 self._exports_claimed[str(handle)] = now
                 self.stats.record_kv_export_fetched()
                 self.stats.set_kv_exports_pending(len(self._exports))
@@ -840,6 +880,7 @@ class InferenceScheduler(Logger):
         stale = [h for h, r in self._exports.items()
                  if now - r["t"] > EXPORT_TTL]
         for h in stale:
+            self._exports_bytes -= self._exports[h].get("bytes", 0)
             del self._exports[h]
         if stale:
             self.stats.record_kv_export_expired(len(stale))
@@ -923,6 +964,61 @@ class InferenceScheduler(Logger):
             ts._bind(self, req.future)
             return ts
         return req.future
+
+    def _submit_prefix_job(self, kind, payload):
+        if self.kv != "paged" or not self.prefix_cache:
+            raise ValueError(
+                "prefix %s needs the paged cache with the prefix "
+                "cache enabled" % kind)
+        fut = concurrent.futures.Future()
+        with self._wake:
+            if self._closed:
+                raise SchedulerError("scheduler is closed")
+            if len(self._prefix_jobs) >= self.max_queue:
+                raise QueueFullError(
+                    "prefix-transfer queue full (%d waiting)"
+                    % len(self._prefix_jobs))
+            self._prefix_jobs.append((kind, payload, fut))
+            self._wake.notify()
+        return fut
+
+    def submit_prefix_export(self, tokens):
+        """Queue a peer-prefix read (the fleet-wide prefix store's
+        GET half): the future resolves to an export-shaped record —
+        no logits, prompt truncated to the covered prefix — holding
+        the RAW blocks of the longest resident prefix of ``tokens``
+        across BOTH tiers (device trie, then its host-tier
+        extension), or None when nothing is resident.  Works on a
+        draining replica: reads don't extend its in-flight set,
+        and a drained peer's warm state is exactly what's worth
+        rescuing."""
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise ValueError("tokens must be non-empty")
+        return self._submit_prefix_job("export", tokens)
+
+    def submit_prefix_import(self, record):
+        """Queue a peer-prefix adoption (the router ships a
+        :meth:`submit_prefix_export` record from the replica that
+        had it): new chunks take freshly claimed device blocks and
+        join the trie, so the triggering request — and every later
+        one — admits warm here.  The future resolves to ``{"blocks":
+        adopted}``.  Raises ``ValueError`` on a record that doesn't
+        match this replica's pool layout."""
+        if str(record.get("kv_dtype")) != self.kv_dtype:
+            raise ValueError(
+                "prefix record kv_dtype %r != this replica's %r"
+                % (record.get("kv_dtype"), self.kv_dtype))
+        if int(record.get("block_size", 0)) != self.block_size:
+            raise ValueError(
+                "prefix record block_size %s != this replica's %d"
+                % (record.get("block_size"), self.block_size))
+        prompt = [int(t) for t in record.get("prompt", ())]
+        if not prompt or int(record.get("length", -1)) != len(prompt):
+            raise ValueError("prefix record prompt/length mismatch")
+        if len(prompt) % self.block_size:
+            raise ValueError("prefix record must be block-aligned")
+        return self._submit_prefix_job("import", record)
 
     def _enqueue_locked(self, req, front=False):
         """Insert one request into the class-ordered queue (highest
@@ -1099,6 +1195,107 @@ class InferenceScheduler(Logger):
         except concurrent.futures.InvalidStateError:
             pass
 
+    def _prefix_tick(self, cache):
+        """Run ONE queued prefix export/import job at this boundary —
+        the same decode-stall bound as a prefill chunk or an aux
+        pass."""
+        with self._lock:
+            if not self._prefix_jobs:
+                return
+            kind, payload, fut = self._prefix_jobs.popleft()
+        if fut.done():   # consumer already gave up
+            return
+        try:
+            if kind == "export":
+                out = self._prefix_export_job(cache, payload)
+            else:
+                out = self._prefix_import_job(cache, payload)
+        except Exception as e:
+            fut.set_exception(
+                e if isinstance(e, SchedulerError)
+                else SchedulerError(repr(e)))
+            return
+        try:
+            fut.set_result(out)
+        except concurrent.futures.InvalidStateError:
+            pass
+
+    def _prefix_export_job(self, cache, tokens):
+        """Gather the longest resident prefix of ``tokens`` — the
+        device trie walk, then its host-tier extension (already host
+        numpy, the gather is free) — into an export-shaped record."""
+        if self.prefix_ is None:
+            return None
+        bs = self.block_size
+        ids = self.prefix_.resident_prefix(tokens)
+        layers = cache.export_blocks(ids) if ids else None
+        if self.host_ is not None:
+            entries = self.host_.match(tokens, len(ids))
+            for e in entries:
+                if layers is None:
+                    layers = {i: {nm: a.mem.copy()
+                                  for nm, a in row.items()}
+                              for i, row in e.layers.items()}
+                    continue
+                if set(e.layers) != set(layers):
+                    break  # defensive: mismatched chain shape
+                layers = {i: {nm: numpy.concatenate(
+                    [layers[i][nm], e.layers[i][nm].mem])
+                    for nm in layers[i]} for i in layers}
+        if layers is None:
+            return None
+        blocks = next(iter(next(iter(
+            layers.values())).values())).shape[0]
+        covered = blocks * bs
+        from veles_tpu.serving.disagg import mint_handle
+        return {
+            "handle": mint_handle(),
+            "prompt": [int(t) for t in tokens[:covered]],
+            "length": covered,
+            "kv_dtype": self.kv_dtype,
+            "block_size": bs,
+            "layers": layers,
+        }
+
+    def _prefix_import_job(self, cache, record):
+        """Adopt a peer's prefix record: chunks already resident
+        keep their incumbents; the new consecutive extension
+        scatters into freshly claimed blocks and joins the trie.
+        Fires the promote fault point — a peer import IS a
+        promotion into the device tier, just from a remote source."""
+        pfx = self.prefix_
+        if pfx is None:
+            raise SchedulerError("no prefix cache on this replica")
+        bs = self.block_size
+        tokens = record["prompt"]
+        total = int(record["length"]) // bs
+        dev = pfx.resident_prefix(tokens)
+        n_new = total - len(dev)
+        ids = None
+        while n_new > 0:
+            ids = cache.take_free_blocks(n_new)
+            if ids is not None:
+                break
+            n_new -= 1  # adopt the longest extension that fits
+        if not n_new or ids is None:
+            return {"blocks": 0}
+        try:
+            faults.fire("scheduler.kv.promote")
+            sliced = {i: {nm: a[len(dev):len(dev) + n_new]
+                          for nm, a in layer.items()}
+                      for i, layer in record["layers"].items()}
+            cache.import_blocks(ids, sliced)
+        except Exception:
+            cache.reclaim(ids)
+            raise
+        covered = (len(dev) + n_new) * bs
+        _, rejected = pfx.insert(
+            [int(t) for t in tokens[:covered]], dev + ids)
+        if rejected:
+            cache.reclaim(rejected)
+        self._sync_prefix_gauges()
+        return {"blocks": n_new}
+
     def drain(self, timeout=None):
         """Begin a graceful drain: admission closes (submits raise
         :class:`DrainingError` — 503 + Retry-After material), every
@@ -1174,6 +1371,22 @@ class InferenceScheduler(Logger):
             out["prefix_cache_blocks_shared"] = pfx.shared_blocks()
             out["prefix_cache_hit_rate"] = \
                 round(pfx.hits / total, 4) if total else None
+            # the cache-topology advertisement: rolling path digests
+            # of every resident prefix, BOTH tiers (a host-resident
+            # prefix is promotable, so it is routable warmth too).
+            # The router matches prompts against these to route on
+            # who actually holds the longest prefix
+            digs = pfx.path_digests(_DIGEST_MAX)
+            host = self.host_
+            if host is not None:
+                digs.extend(host.digests()[:max(
+                    0, _DIGEST_MAX - len(digs))])
+                out["kv_host_blocks"] = host.blocks
+                out["kv_host_bytes"] = host.bytes
+                out["kv_host_promotions"] = host.promotions
+                out["kv_host_demotions"] = host.demotions
+                out["kv_host_evictions"] = host.evictions
+            out["prefix_digests"] = digs
         return out
 
     def metrics(self):
@@ -1264,15 +1477,20 @@ class InferenceScheduler(Logger):
         with self._lock:
             pending = list(self._queue) + list(self._prefilling) \
                 + list(self._active.values()) + list(self._admitting)
-            aux = list(self._aux)
+            aux = list(self._aux) + list(self._prefix_jobs)
             self._queue.clear()
             self._prefilling = []
             self._active.clear()
             self._admitting = []
             self._aux.clear()
+            self._prefix_jobs.clear()
             self._exports.clear()
+            self._exports_bytes = 0
             self._exports_claimed.clear()
             self._queued_blocks = 0
+        host = self.host_
+        if host is not None:
+            host.clear()   # release the Watcher's host bytes
         for _, _, fut in aux:
             if not fut.done():
                 try:
@@ -1377,7 +1595,7 @@ class InferenceScheduler(Logger):
                 while not self._closed and not self._queue \
                         and not self._active and not self._prefilling \
                         and not self._preempts_owed \
-                        and not self._aux:
+                        and not self._aux and not self._prefix_jobs:
                     if self._draining:
                         self._drained.set()
                     # parked KV exports keep a 1 s housekeeping tick
@@ -1433,6 +1651,8 @@ class InferenceScheduler(Logger):
                     self._admitting.remove(req)
             if self._aux:
                 self._aux_tick()
+            if self._prefix_jobs:
+                self._prefix_tick(cache)
             if self._prefilling:
                 self._prefill_tick(cache)
             if self._active:
@@ -1477,6 +1697,13 @@ class InferenceScheduler(Logger):
         # imports skip the warm match entirely
         if self.prefix_ is not None and req.kv_import is None:
             seq = list(req.prompt) + list(req.generated)
+            if self.host_ is not None:
+                # promote the host-tier extension FIRST so the match
+                # below pins (and the hit stats count) the full warm
+                # prefix; net-zero on the free list — each promoted
+                # block replaces a cold private block the admission
+                # would have claimed anyway
+                self._promote_host(cache, seq)
             handle = self.prefix_.match(
                 seq, max_blocks=(len(seq) - 1) // cache.block_size)
             self.stats.record_prefix_lookup(len(handle),
@@ -1487,7 +1714,8 @@ class InferenceScheduler(Logger):
         need_new = cache.blocks_needed(total) - matched
         if self.prefix_ is not None and self.prefix_evict \
                 and need_new > cache.free_blocks:
-            freed = self.prefix_.evict(need_new - cache.free_blocks)
+            freed = self._evict_prefix(cache,
+                                       need_new - cache.free_blocks)
             if freed:
                 cache.reclaim(freed)
                 self.stats.record_prefix_evict(len(freed))
@@ -1543,6 +1771,81 @@ class InferenceScheduler(Logger):
         if self.prefix_ is not None:
             self.stats.set_prefix_blocks(self.prefix_.resident,
                                          self.prefix_.shared_blocks())
+
+    def _sync_host_gauges(self):
+        if self.host_ is not None:
+            self.stats.set_kv_host(self.host_.blocks,
+                                   self.host_.bytes)
+
+    def _evict_prefix(self, cache, n):
+        """Trie eviction with host-tier demotion: before the device
+        blocks go back to the free list, their contents (and int8
+        scales) are gathered and parked in the host tier keyed by
+        the token path each block completed.  Best-effort — a failed
+        demotion only loses warmth, never blocks the eviction the
+        admission is waiting on."""
+        if self.host_ is None:
+            return self.prefix_.evict(n)
+        pairs = self.prefix_.evict_with_paths(n)
+        if not pairs:
+            return []
+        demoted = 0
+        try:
+            layers = cache.export_blocks([b for b, _ in pairs])
+            for j, (bid, path) in enumerate(pairs):
+                one = {i: {nm: a[j:j + 1]
+                           for nm, a in layer.items()}
+                       for i, layer in layers.items()}
+                if self.host_.put(path, one):
+                    demoted += 1
+        except Exception as e:
+            self.info("host-tier demotion failed: %r", e)
+        if demoted:
+            self.stats.record_kv_host(demoted=demoted)
+        self._sync_host_gauges()
+        return [b for b, _ in pairs]
+
+    def _promote_host(self, cache, seq):
+        """Promote the host-tier extension of ``seq``'s device-
+        resident prefix back into freshly claimed device blocks and
+        re-insert them into the trie — the admission's match then
+        rides the ordinary warm staging-gather path, and only the
+        genuinely cold tail prefills.  Returns blocks promoted (0 on
+        any failure: the request simply admits colder)."""
+        bs = self.block_size
+        limit = (len(seq) - 1) // bs  # >= 1 token must stay cold
+        dev = self.prefix_.resident_prefix(seq, limit)
+        entries = self.host_.match(seq, len(dev),
+                                   max_blocks=limit - len(dev))
+        while entries:
+            ids = cache.take_free_blocks(len(entries))
+            if ids is not None:
+                break
+            entries.pop()  # promote the longest extension that fits
+        if not entries:
+            return 0
+        try:
+            faults.fire("scheduler.kv.promote")
+            merged = {
+                i: {nm: numpy.concatenate(
+                    [e.layers[i][nm].mem for e in entries])
+                    for nm in entries[0].layers[i]}
+                for i in entries[0].layers}
+            cache.import_blocks(ids, merged)
+        except Exception as e:
+            cache.reclaim(ids)
+            self.info("host-tier promotion failed: %r", e)
+            return 0
+        covered = (len(dev) + len(entries)) * bs
+        _, rejected = self.prefix_.insert(list(seq[:covered]),
+                                          dev + ids)
+        if rejected:  # cannot happen short of a digest collision
+            cache.reclaim(rejected)
+        self.host_.pop(entries)
+        self.stats.record_kv_host(promoted=len(entries))
+        self._sync_host_gauges()
+        self._sync_prefix_gauges()
+        return len(entries)
 
     def _reap(self, cache):
         """Boundary sweep over the in-flight set: release the slot and
@@ -1960,13 +2263,18 @@ class InferenceScheduler(Logger):
         self._release_slot(req, cache, finished=True)
         self._sync_kv_gauges(cache)
         now = time.monotonic()
+        from veles_tpu.serving.disagg import record_nbytes
+        record["bytes"] = record_nbytes(record)
         with self._lock:
             self._sweep_exports_locked(now)
             capped = 0
-            while len(self._exports) >= EXPORT_CAP:
-                # oldest unclaimed record pays for the cap
+            while self._exports and self._exports_bytes \
+                    + record["bytes"] > self.kv_export_bytes:
+                # oldest unclaimed record pays for the byte budget
                 oldest = min(self._exports,
                              key=lambda h: self._exports[h]["t"])
+                self._exports_bytes -= \
+                    self._exports[oldest].get("bytes", 0)
                 del self._exports[oldest]
                 capped += 1
             if capped:
@@ -1974,6 +2282,7 @@ class InferenceScheduler(Logger):
                 # expiry, just paid early — same alertable series
                 self.stats.record_kv_export_expired(capped)
             self._exports[handle] = record
+            self._exports_bytes += record["bytes"]
             self.stats.set_kv_exports_pending(len(self._exports))
         if self._tron:
             reqtrace.record(

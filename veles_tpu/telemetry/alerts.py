@@ -443,6 +443,19 @@ def default_rules():
                         "over 5 minutes — long-lived streams are "
                         "accumulating toward the shed threshold; "
                         "heads-up before kv_block_pressure tickets"),
+        AlertRule(
+            "kv_host_thrash", severity="ticket", for_seconds=5.0,
+            expr="avg_over_time("
+                 "veles_serving_kv_host_thrash_rate, 60) > 2",
+            description="the host KV tier is churning: blocks are "
+                        "demoting AND promoting back at a sustained "
+                        "rate (min of the two, blocks/s) — the "
+                        "working set exceeds device capacity and "
+                        "the tier is paging instead of caching; "
+                        "grow kv_host_bytes' device budget "
+                        "(kv_blocks), spread load, or expect "
+                        "staging-gather overhead on every warm "
+                        "admission"),
     ]
 
 
