@@ -242,6 +242,34 @@ def run_kv_quant():
     return rec
 
 
+def run_weight_quant():
+    """Int8 CHECKPOINT-weight quality record (the PR 20
+    ``weights_dtype="int8"`` snapshot-load path): CE delta of the
+    quantized-weight chain vs its own f32 self on the same trained
+    tiny chain and the same verify path as the KV gate —
+    ``veles_tpu/serving/kv_quality.weight_quant_quality`` (which
+    quantizes the chain in place, so this run builds its own)."""
+    import numpy
+    sys.path.insert(0, REPO)
+    from veles_tpu.backends import Device
+    from veles_tpu.serving.kv_quality import weight_quant_quality
+    from bench import _spec_trained_chain
+    t0 = time.time()
+    vocab = 256
+    pattern = (numpy.arange(12) * 17 % vocab).tolist()
+    fw = _spec_trained_chain(Device(), 64, 2, 2, vocab, 128, 16,
+                             pattern, 30, "quality-weight-quant")
+    rng = numpy.random.default_rng(0)
+    seqs = [(pattern * 11)[:96],           # the text it learned
+            rng.integers(0, vocab, (96,)).tolist()]  # and noise
+    rec = weight_quant_quality(fw, seqs, block_size=16)
+    rec["seconds"] = round(time.time() - t0, 1)
+    rec["target"] = ("weight_quant_ce_delta <= the declared "
+                     "tolerance (the int8-weight gate; tier-1 "
+                     "asserts it)")
+    return rec
+
+
 def summarize(runs):
     """The at-a-glance block: ours vs the reference's published number
     per family."""
@@ -281,6 +309,10 @@ def main(argv=None):
         print("== kv_quant", flush=True)
         out["kv_quant"] = run_kv_quant()
         print(json.dumps(out["kv_quant"], indent=1), flush=True)
+    if not args.only or args.only == "weight_quant":
+        print("== weight_quant", flush=True)
+        out["weight_quant"] = run_weight_quant()
+        print(json.dumps(out["weight_quant"], indent=1), flush=True)
     out["summary"] = summarize(out["runs"])
     with open(os.path.join(REPO, args.out), "w") as f:
         json.dump(out, f, indent=1)

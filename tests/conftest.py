@@ -67,11 +67,34 @@ def spec_trained_chain():
     yield fw, pattern
 
 
+@pytest.fixture(scope="session")
+def spec_trained_head(spec_trained_chain):
+    """ONE trained Medusa draft head (k=4) over the session chain,
+    fit on the same cyclic pattern the chain learned — shared by
+    test_draft and test_tp so tier-1 trains it once.  Frozen after
+    training (schedulers only call ``propose``), trained under f32
+    to match the chain's weights."""
+    import numpy
+    from veles_tpu.config import root
+    from veles_tpu.serving import MedusaDraftHead
+    fw, pattern = spec_trained_chain
+    saved = root.common.precision.get("compute_dtype", "bfloat16")
+    root.common.precision.compute_dtype = "float32"
+    try:
+        head = MedusaDraftHead.from_chain(fw, 4, seed=0)
+        corpus = numpy.asarray(
+            ([p % 12 for p in pattern] * 40)[:256])
+        losses = head.train(fw, corpus, steps=40, batch=8, window=32)
+    finally:
+        root.common.precision.compute_dtype = saved
+    yield head, losses
+
+
 def pytest_runtest_protocol(item, nextitem):
     """Single retry for ``@pytest.mark.flaky`` tests — the quarantine
-    for the two KNOWN environment flakes (jax-0.4.37 XLA:CPU
-    nondeterminism, see ROUND6_NOTES.md), so fleet soaks get a stable
-    tier-1 signal.  The first attempt runs unlogged; only a failure
+    for KNOWN environment flakes (jax-0.4.37 XLA:CPU nondeterminism,
+    see ROUND6_NOTES.md; 1-core wall-clock ratio gates), so fleet
+    soaks get a stable tier-1 signal.  The first attempt runs unlogged; only a failure
     triggers the one rerun (full setup/teardown), whose reports are
     what the terminal and exit code see.  Anything without the marker
     takes the stock protocol."""

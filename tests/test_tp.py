@@ -121,6 +121,38 @@ def test_tp2_stream_parity(f32, spec_trained_chain):
         == snap1["kv_bytes_per_token"] // 2
 
 
+def test_tp2_overlap_parity_with_model_drafter(f32,
+                                               spec_trained_chain,
+                                               spec_trained_head):
+    """The PR 20 pair under one roof: tp=2 with the OVERLAP step
+    (``serving.tp_overlap`` — the shard_map body whose row-parallel
+    combines are expressed per shard as collective-permute + add)
+    AND the model drafter stays bit-identical to the tp=1 spec-off
+    baseline, greedy and seeded, through chunked prefill.  The
+    2-operand f32 add of the tp=2 combine is the GSPMD psum's exact
+    arithmetic, so overlap is purely a scheduling change."""
+    from veles_tpu.config import root as cfg
+    fw, pattern = spec_trained_chain
+    head, _ = spec_trained_head
+    prompts = [(pattern * 2)[:12], [5, 2] * 5]
+    submits = [(p, 10, dict(seed=0)) for p in prompts]
+    submits += [(p, 8, dict(temperature=0.9, top_k=5, seed=41 + i))
+                for i, p in enumerate(prompts)]
+    base, _ = _run(fw, submits, check=True, tp=0, kv="paged",
+                   block_size=4, prefill_chunk=4, spec=False)
+    cfg.common.serving.tp_overlap = True
+    try:
+        tp2, snap = _run(fw, submits, check=True, tp=2, kv="paged",
+                         block_size=4, prefill_chunk=4, spec=True,
+                         spec_k=4, drafter="model", draft_head=head)
+    finally:
+        cfg.common.serving.tp_overlap = False
+    assert tp2 == base
+    assert snap["tp"] == 2 and snap["drafter"] == "model"
+    assert snap["spec_accept_rate_by_drafter"].get("model") \
+        is not None
+
+
 def test_tp2_int8_parity(f32, spec_trained_chain):
     """int8 pools under tp=2: the per-row amax reduces over the
     sharded feature axis exactly, so quantized pool bytes — and the

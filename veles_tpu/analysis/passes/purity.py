@@ -34,6 +34,9 @@ REQUIRED_REGISTRATIONS = (
     ("serving/engine.py", "serving.paged_step"),
     ("serving/engine.py", "serving.verify_step"),
     ("serving/engine.py", "serving.sample_first"),
+    ("serving/engine.py", "serving.paged_step_tp"),
+    ("serving/draft.py", "serving.draft_step"),
+    ("serving/draft.py", "serving.draft_train"),
     ("serving/prefill.py", "serving.prefill"),
     ("serving/prefill.py", "serving.prefill_chunk"),
     ("serving/openai_api.py", "serving.embed_pool"),

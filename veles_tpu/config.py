@@ -351,6 +351,28 @@ root.common.update({
         # payloads a prefill replica holds for its decode peers
         "kv_host_bytes": 0,
         "kv_export_bytes": 256 << 20,
+        # model-based drafting (PR 20): drafter "model" arbitrates a
+        # Medusa-style draft head (serving/draft.py, conditioned on
+        # the engine's hidden-state lane) against the free n-gram
+        # proposer per slot by accept-rate EMA; "ngram" (default)
+        # keeps the self-speculative baseline — either way the
+        # emitted streams are bit-identical to spec off, drafting
+        # moves throughput only.  The EMA controller adapts each
+        # slot's draft length between draft_k_min and spec_k along
+        # the warmed power-of-two verify buckets: blend weight
+        # draft_ema, halve below draft_shrink, double above
+        # draft_grow.  tp_overlap swaps the GSPMD-partitioned tp
+        # step for an explicit shard_map step whose row-parallel
+        # all-reduces are expressed per shard (collective-permute at
+        # tp=2), letting XLA schedule the combine against the
+        # residual/LN compute — fp32 pools only (int8 per-row scales
+        # need full-row amax), bit-identical to the GSPMD step.
+        "drafter": "ngram",
+        "draft_k_min": 1,
+        "draft_ema": 0.5,
+        "draft_shrink": 0.5,
+        "draft_grow": 0.8,
+        "tp_overlap": False,
     },
     # replica supervision (serving/fleet.py): rebalance lets a
     # disaggregated fleet re-role replicas when a whole role pool

@@ -20,6 +20,20 @@ from veles_tpu.memory import Array
 from veles_tpu.models.nn_units import ForwardBase
 
 
+def _dequant_dot(x, wq, scale, prec, ad):
+    """Deferred-dequant matmul against a PRE-QUANTIZED int8
+    checkpoint weight (``quantize_weights``): the int8 weight widens
+    into the dot and the per-output-column f32 scale multiplies the
+    accumulator.  Because the scale is a GLOBAL per-column constant
+    (unlike the in-trace ``int8_decode`` epilogue, whose shard-local
+    amax is layout-dependent), the dequant commutes with row-parallel
+    partial sums — which is what lets int8 checkpoints serve under
+    the tp mesh."""
+    y = jnp.einsum("bsd,de->bse", x, wq.astype(x.dtype),
+                   precision=prec, preferred_element_type=ad)
+    return y * scale.astype(y.dtype)
+
+
 def _layer_norm(x, scale, bias, eps=1e-5):
     xf = x.astype(jnp.float32)
     mean = xf.mean(axis=-1, keepdims=True)
@@ -64,6 +78,11 @@ class TransformerBlock(ForwardBase):
         #: inside the traced step (frozen serving params fold to
         #: constants under jit)
         self.int8_decode = bool(int8_decode)
+        #: int8 CHECKPOINT weights (quantize_weights): the matmul
+        #: weights are STORED int8 with per-output-column f32 scales
+        #: as extra params — weight HBM halves at rest and on-device,
+        #: every decode/prefill path dispatches on the stored dtype
+        self.weights_int8 = False
         self.n_experts = int(n_experts)
         self.top_k = int(top_k)
         if self.n_experts and self.top_k > self.n_experts:
@@ -160,7 +179,53 @@ class TransformerBlock(ForwardBase):
             return P("tp", None)
         if name == "ffn_b1":
             return P("tp")
+        # int8-checkpoint dequant scales (quantize_weights): per
+        # OUTPUT column, so they split with column-parallel weights
+        # and replicate beside row-parallel ones (their outputs keep
+        # the full model dim)
+        if name in ("wq_scale", "wk_scale", "wv_scale",
+                    "ffn_w1_scale"):
+            return P("tp")
         return None
+
+    # -- int8 weight checkpoints (snapshotter weights_dtype) ------------
+
+    def quantize_weights(self):
+        """Re-store this block's matmul weights in the int8 CHECKPOINT
+        format: per-output-column symmetric absmax quantization
+        (``ops/gemm.int8_weight_quantize`` — the same scales the
+        in-trace decode epilogue computes), the int8 tensor REPLACING
+        the f32 one in place and a ``{name}_scale`` f32 vector
+        joining ``PARAMS`` beside it.  Weight bytes halve at rest, in
+        the snapshot AND in device HBM — unlike ``int8_decode``,
+        which re-quantizes from resident f32 weights inside the
+        trace.  Every decode/prefill/verify path dispatches on the
+        stored dtype (``_dequant_dot``), and the global per-column
+        scales commute with the tp row-parallel partial sums, so
+        quantized checkpoints still shard.  Idempotent; MoE blocks
+        (expert-sharded weights) are not supported."""
+        if self.n_experts:
+            raise ValueError(
+                "int8 weight checkpoints need the dense FFN (MoE "
+                "expert weights shard over ep; not supported)")
+        if getattr(self, "weights_int8", False):
+            return
+        from veles_tpu.ops import gemm
+        names = ("wq", "wk", "wv", "wo", "ffn_w1", "ffn_w2")
+        for name in names:
+            arr = getattr(self, name)
+            arr.map_read()
+            wq, scale = gemm.int8_weight_quantize(
+                jnp.asarray(arr.mem, jnp.float32))
+            arr.reset(numpy.asarray(wq))
+            sarr = Array(numpy.asarray(scale, numpy.float32))
+            dev = getattr(self, "device", None)
+            if dev is not None:
+                sarr.initialize(dev)
+            setattr(self, name + "_scale", sarr)
+        self.PARAMS = tuple(self.PARAMS) \
+            + tuple(n + "_scale" for n in names)
+        self.weights_int8 = True
 
     def _mha(self, params, x):
         from veles_tpu.models.attention import mha_apply
@@ -202,13 +267,26 @@ class TransformerBlock(ForwardBase):
             y = self._w8_matmul(h1, params["ffn_w2"])
             return (y + params["ffn_b2"].astype(
                 jnp.float32)).astype(x.dtype)
-        h1 = jnp.einsum("bsd,dh->bsh", x.astype(cd),
-                        params["ffn_w1"].astype(cd),
-                        preferred_element_type=jnp.float32)
+        if params["ffn_w1"].dtype == jnp.int8:   # int8 checkpoint
+            h1 = jnp.einsum("bsd,dh->bsh", x.astype(cd),
+                            params["ffn_w1"].astype(cd),
+                            preferred_element_type=jnp.float32) \
+                * params["ffn_w1_scale"].astype(jnp.float32)
+        else:
+            h1 = jnp.einsum("bsd,dh->bsh", x.astype(cd),
+                            params["ffn_w1"].astype(cd),
+                            preferred_element_type=jnp.float32)
         h1 = jnp.maximum(
             h1 + params["ffn_b1"].astype(jnp.float32), 0.0).astype(cd)
-        y = jnp.einsum("bsh,hd->bsd", h1, params["ffn_w2"].astype(cd),
-                       preferred_element_type=jnp.float32)
+        if params["ffn_w2"].dtype == jnp.int8:   # int8 checkpoint
+            y = jnp.einsum("bsh,hd->bsd", h1,
+                           params["ffn_w2"].astype(cd),
+                           preferred_element_type=jnp.float32) \
+                * params["ffn_w2_scale"].astype(jnp.float32)
+        else:
+            y = jnp.einsum("bsh,hd->bsd", h1,
+                           params["ffn_w2"].astype(cd),
+                           preferred_element_type=jnp.float32)
         return (y + params["ffn_b2"].astype(jnp.float32)).astype(x.dtype)
 
     def apply(self, params, x):
@@ -238,9 +316,14 @@ class TransformerBlock(ForwardBase):
         ln = _layer_norm(x, params["ln1_scale"], params["ln1_bias"])
 
         def proj(name):
-            y = jnp.einsum("bsd,de->bse", ln.astype(cd),
-                           params[name].astype(cd), precision=prec,
-                           preferred_element_type=ad)
+            w = params[name]
+            if w.dtype == jnp.int8:   # int8 checkpoint weight
+                y = _dequant_dot(ln.astype(cd), w,
+                                 params[name + "_scale"], prec, ad)
+            else:
+                y = jnp.einsum("bsd,de->bse", ln.astype(cd),
+                               w.astype(cd), precision=prec,
+                               preferred_element_type=ad)
             return y.astype(cd)
 
         return proj("wq"), proj("wk"), proj("wv")
@@ -258,6 +341,10 @@ class TransformerBlock(ForwardBase):
         prec = dtypes.matmul_precision()
         if w8:
             attn = self._w8_matmul(o, params["wo"]).astype(x.dtype)
+        elif params["wo"].dtype == jnp.int8:   # int8 checkpoint
+            attn = _dequant_dot(o.astype(cd), params["wo"],
+                                params["wo_scale"], prec,
+                                ad).astype(x.dtype)
         else:
             attn = jnp.einsum("bsd,de->bse", o.astype(cd),
                               params["wo"].astype(cd), precision=prec,
@@ -418,6 +505,69 @@ class TransformerBlock(ForwardBase):
         return self._attn_tail(params, x, o, w8=w8), \
             {"k": pk, "v": pv}
 
+    def apply_step_paged_local(self, params, x, pos, tables, pool,
+                               tp):
+        """PER-SHARD decode step body for the collective-overlap tp
+        path (``engine._make_paged_step_tp`` runs it under shard_map
+        over the ``tp`` mesh axis): ``params`` are this shard's
+        Megatron slices (wq/wk/wv/ffn_w1 column slices → local heads
+        and hidden columns, wo/ffn_w2 row slices), ``pool`` this
+        shard's head-wise K/V slice.  Identical math to
+        :meth:`apply_step_paged` — the two GSPMD-implicit per-layer
+        reductions become EXPLICIT ``tp_allreduce`` calls
+        (serving/tp.py) the compiler can issue asynchronously while
+        the pool writeback proceeds.  fp32 pools only (the int8
+        per-row amax must span the full feature axis)."""
+        from veles_tpu import dtypes
+        from veles_tpu.ops.paged_attention import paged_decode_attention
+        from veles_tpu.serving.tp import tp_allreduce
+        cd = dtypes.compute_dtype()
+        ad = dtypes.accum_dtype()
+        prec = dtypes.matmul_precision()
+        heads_local = self.heads // int(tp)
+        q, k_new, v_new = self._qkv(params, x)
+        pk, pv, o = paged_decode_attention(
+            q, k_new, v_new, pool["k"], pool["v"], tables, pos,
+            heads_local)
+        # row-parallel output projection: the partial sum reduces
+        # EXPLICITLY — issued before the residual/FFN consume it, so
+        # the cross-chip hop can overlap the pool scatter above
+        if params["wo"].dtype == jnp.int8:   # int8 checkpoint
+            partial = _dequant_dot(o.astype(cd), params["wo"],
+                                   params["wo_scale"], prec, ad)
+        else:
+            partial = jnp.einsum("bsd,de->bse", o.astype(cd),
+                                  params["wo"].astype(cd),
+                                  precision=prec,
+                                  preferred_element_type=ad)
+        attn = tp_allreduce(partial, "tp", int(tp)).astype(x.dtype)
+        y = x + attn
+        ln2 = _layer_norm(y, params["ln2_scale"], params["ln2_bias"])
+        if params["ffn_w1"].dtype == jnp.int8:
+            h1 = jnp.einsum("bsd,dh->bsh", ln2.astype(cd),
+                            params["ffn_w1"].astype(cd),
+                            preferred_element_type=jnp.float32) \
+                * params["ffn_w1_scale"].astype(jnp.float32)
+        else:
+            h1 = jnp.einsum("bsd,dh->bsh", ln2.astype(cd),
+                            params["ffn_w1"].astype(cd),
+                            preferred_element_type=jnp.float32)
+        h1 = jnp.maximum(
+            h1 + params["ffn_b1"].astype(jnp.float32), 0.0).astype(cd)
+        if params["ffn_w2"].dtype == jnp.int8:
+            p2 = jnp.einsum("bsh,hd->bsd", h1,
+                            params["ffn_w2"].astype(cd),
+                            preferred_element_type=jnp.float32) \
+                * params["ffn_w2_scale"].astype(jnp.float32)
+        else:
+            p2 = jnp.einsum("bsh,hd->bsd", h1,
+                            params["ffn_w2"].astype(cd),
+                            preferred_element_type=jnp.float32)
+        ffn = tp_allreduce(p2, "tp", int(tp))
+        out = y + (ffn + params["ffn_b2"].astype(
+            jnp.float32)).astype(x.dtype)
+        return out, {"k": pk, "v": pv}
+
     def apply_verify_paged(self, params, x, pos, lens, tables, pool):
         """Speculative-decoding VERIFY step: score a width-K1 token
         run per row — x [batch, K1, d], row n's position j at
@@ -530,6 +680,9 @@ class TransformerBlock(ForwardBase):
             cfg["attn_impl"] = self.attn_impl
         if self.int8_decode:  # v2 key — omit when unused
             cfg["int8_decode"] = True
+        if getattr(self, "weights_int8", False):  # v3 key — the
+            # int8-checkpoint trace differs; the flag keys _arch_sig
+            cfg["weights_int8"] = True
         return cfg
 
 

@@ -46,6 +46,12 @@ per-token scan.  Here:
   step (``engine.verify_step_paged``) scores in ONE model pass —
   accepted prefixes are pure latency win, output streams stay
   bit-identical to spec-off decoding;
+- :mod:`veles_tpu.serving.draft` — MODEL-based drafting past the
+  n-gram ceiling: Medusa-style per-position heads over the target's
+  final hidden state (the engine's ``want_hidden`` lane), trained
+  against the frozen target, arbitrated per slot against the free
+  n-gram proposer by accept-rate EMA — which also adapts each
+  slot's draft length along the warmed verify width buckets;
 - :mod:`veles_tpu.serving.prefix_cache` — the cross-request radix
   prefix cache (SGLang lineage) over the paged block pools: finished
   requests donate their KV blocks, warm prompts skip prefill for
@@ -74,14 +80,18 @@ per-token scan.  Here:
 """
 
 from veles_tpu.serving.engine import (  # noqa: F401
-    paged_decode_step, slot_decode_step, verify_step_paged,
-    verify_supported)
+    hidden_supported, overlap_supported, paged_decode_step,
+    slot_decode_step, verify_step_paged, verify_supported)
 from veles_tpu.serving.kv_slots import (  # noqa: F401
     PagedKVCache, SlotKVCache, paged_supported)
 from veles_tpu.serving.prefix_cache import (  # noqa: F401
     RadixPrefixCache)
 from veles_tpu.serving.spec import (  # noqa: F401
-    NgramProposer, accept_drafts)
+    NgramIndex, NgramProposer, accept_drafts)
+from veles_tpu.serving.draft import (  # noqa: F401
+    MedusaDraftHead, draft_supported)
+from veles_tpu.serving.kv_quality import (  # noqa: F401
+    kv_quant_quality, weight_quant_quality)
 from veles_tpu.serving.metrics import (  # noqa: F401
     RouterMetrics, ServingMetrics)
 from veles_tpu.serving.prefill import (  # noqa: F401
@@ -95,7 +105,7 @@ from veles_tpu.serving.scheduler import (  # noqa: F401
     RequestCancelledError, RoleMismatchError, SchedulerError,
     resolve_priority)
 from veles_tpu.serving.tp import (  # noqa: F401
-    ServingTP, per_chip_bytes, tp_supported)
+    ServingTP, per_chip_bytes, tp_allreduce, tp_supported)
 from veles_tpu.serving.disagg import (  # noqa: F401
     decode_export, encode_export)
 from veles_tpu.serving.streams import (  # noqa: F401

@@ -1,0 +1,216 @@
+"""Model-based speculative drafting: Medusa-style multi-token heads
+over the target's last hidden state.
+
+The n-gram prompt-lookup proposer (serving/spec.py) is free but
+ceiling-limited: on non-repetitive text its drafts approach empty and
+speculative decoding degrades to plain decode.  The fix (ROADMAP
+item 4) is a MODEL drafter in the EAGLE/Medusa lineage (Li et al.,
+2024; Cai et al., 2024): ``k`` small per-position heads that read the
+target's final hidden state — the [B, d] tensor the engine's
+``want_hidden`` lane already computed for the LM head — and each
+guess one further-future token:
+
+- the LM head over hidden ``h_t`` (position t) predicts token t+1
+  (that is the verify/decode sample itself);
+- draft head ``j`` (1-based) over the SAME ``h_t`` predicts token
+  ``t+1+j`` — so when the scheduler holds the hidden of the position
+  BEHIND the pending token (position p-1 for pending token at p),
+  head j's greedy pick drafts the token at ``p+j``, exactly draft
+  slot ``d_j`` of the verify contract.
+
+Each head is one residual SiLU block plus its own un-embedding:
+``z_j = h + silu(h @ w1_j + b1_j)``, ``logits_j = z_j @ w2_j + b2_j``
+(the Medusa-1 head shape).  Heads are trained against the FROZEN
+target — teacher forward through every unit but the LM head yields
+the hidden states, cross-entropy to the shifted token stream trains
+only the head params (SGD + momentum, one jitted step) — so training
+cost is a few hundred tiny steps, no target gradients.
+
+Drafts from these heads flow through the UNCHANGED verify contract
+(``accept_drafts``): a wrong draft merely rejects, so output streams
+stay bit-identical to spec-off decoding no matter how good or bad
+the head is — the head moves THROUGHPUT only.
+"""
+
+import numpy
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.models.generate import _device_params
+from veles_tpu.serving.engine import hidden_supported
+from veles_tpu.telemetry import track_jit
+
+
+def draft_supported(forwards):
+    """True when the chain can feed a model draft head: the engine's
+    hidden-state lane taps the final unit's input, so the chain must
+    end in a position-wise vocab head (``hidden_supported``) whose
+    weights tell us (d_model, vocab)."""
+    if not hidden_supported(forwards):
+        return False
+    w = getattr(forwards[-1], "weights", None)
+    return w is not None and getattr(w, "mem", None) is not None \
+        and w.mem.ndim == 2
+
+
+def _make_propose(k):
+    def propose(hp, hidden):
+        h = hidden.astype(jnp.float32)
+        z = h[:, None, :] + jax.nn.silu(
+            jnp.einsum("bd,kde->bke", h, hp["w1"]) + hp["b1"])
+        logits = jnp.einsum("bke,kev->bkv", z, hp["w2"]) + hp["b2"]
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return propose
+
+
+def _make_train_step(forwards, k):
+    last = len(forwards) - 1
+
+    def teacher(params, toks):
+        # frozen-target forward through every unit but the LM head —
+        # the SAME hidden stream the engine's want_hidden lane taps
+        h = toks
+        for i in range(last):
+            u = forwards[i]
+            h = u.apply(params[i], h)
+        return h.astype(jnp.float32)
+
+    def loss_fn(hp, tparams, toks):
+        h = teacher(tparams, toks)              # [B, T, d]
+        b, t, _ = h.shape
+        z = h[:, :, None, :] + jax.nn.silu(
+            jnp.einsum("btd,kde->btke", h, hp["w1"]) + hp["b1"])
+        logits = jnp.einsum("btke,kev->btkv", z, hp["w2"]) + hp["b2"]
+        # head j (storage index jj = j-1) over position t predicts
+        # token t+1+j = toks[t+2+jj]; positions past the window mask
+        idx = jnp.arange(t)[:, None] + 2 + jnp.arange(k)[None, :]
+        mask = (idx < t).astype(jnp.float32)     # [T, k]
+        labels = toks[:, jnp.clip(idx, 0, t - 1)]   # [B, T, k]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, labels[..., None], axis=-1)[..., 0]
+        return (nll * mask[None]).sum() \
+            / jnp.maximum(mask.sum() * b, 1.0)
+
+    grad = jax.value_and_grad(loss_fn)
+
+    def step(hp, mom, tparams, toks, lr, momentum):
+        loss, g = grad(hp, tparams, toks)
+        mom = jax.tree_util.tree_map(
+            lambda m, gg: momentum * m + gg, mom, g)
+        hp = jax.tree_util.tree_map(
+            lambda p, m: p - lr * m, hp, mom)
+        return hp, mom, loss
+
+    return step
+
+
+class MedusaDraftHead:
+    """``k`` per-position draft heads over a ``d_model`` hidden state
+    with a ``vocab``-wide un-embedding each.  ``propose`` is the
+    decode-time entry (greedy picks per head, one tiny jitted
+    matmul); ``train`` fits the heads against a frozen target chain.
+    The head is pure host state between calls — it pickles, and the
+    scheduler treats it as an opaque ``draft_head`` argument."""
+
+    def __init__(self, k, d_model, vocab, seed=0):
+        self.k = int(k)
+        self.d_model = int(d_model)
+        self.vocab = int(vocab)
+        if self.k < 1:
+            raise ValueError("need k >= 1")
+        rng = numpy.random.RandomState(int(seed))
+        d, v = self.d_model, self.vocab
+        # w2 starts at zero: untrained heads emit flat logits (argmax
+        # 0) — harmless drafts that simply reject at verify
+        self.params = {
+            "w1": (rng.randn(self.k, d, d) / numpy.sqrt(d)
+                   ).astype(numpy.float32),
+            "b1": numpy.zeros((self.k, d), numpy.float32),
+            "w2": numpy.zeros((self.k, d, v), numpy.float32),
+            "b2": numpy.zeros((self.k, v), numpy.float32),
+        }
+        self._propose_jit = track_jit("serving.draft_step",
+                                      jax.jit(_make_propose(self.k)))
+        self._train_jit = None
+        self._train_sig = None
+
+    @classmethod
+    def from_chain(cls, forwards, k, seed=0):
+        """Size a head for ``forwards`` — d_model and vocab read off
+        the chain's LM-head weights."""
+        if not draft_supported(forwards):
+            raise ValueError(
+                "chain cannot feed a draft head (needs a trailing "
+                "position-wise vocab head; see draft_supported)")
+        d, v = forwards[-1].weights.mem.shape
+        return cls(k, d, v, seed=seed)
+
+    def propose(self, hidden):
+        """Greedy draft tokens for a batch of hidden states:
+        ``hidden`` [B, d] f32 → [B, k] int32, row n's entry j-1
+        drafting the token ``j`` positions past the one row n's
+        hidden already predicts.  The batch pads to a power of two so
+        occupancy changes don't grow the executable ladder."""
+        hidden = numpy.asarray(hidden, numpy.float32)
+        b = hidden.shape[0]
+        bb = 1
+        while bb < b:
+            bb <<= 1
+        if bb != b:
+            hidden = numpy.concatenate(
+                [hidden, numpy.zeros((bb - b, hidden.shape[1]),
+                                     numpy.float32)], axis=0)
+        out = self._propose_jit(
+            {n: jnp.asarray(a) for n, a in self.params.items()},
+            jnp.asarray(hidden))
+        return numpy.asarray(out)[:b]
+
+    def train(self, forwards, corpus, steps=200, batch=8, window=32,
+              lr=0.1, momentum=0.9, seed=0):
+        """Fit the heads against the FROZEN ``forwards`` chain on
+        ``corpus`` (a 1-D int token array): each step samples
+        ``batch`` windows of ``window`` tokens, teacher-forwards them
+        through the target (no target grads), and SGDs the head
+        params on the mean masked cross-entropy.  Returns the loss
+        trace (one float per step)."""
+        corpus = numpy.asarray(corpus, numpy.int64).ravel()
+        if len(corpus) < window + 1:
+            raise ValueError("corpus shorter than one window")
+        sig = (id(forwards), self.k)
+        if self._train_jit is None or self._train_sig != sig:
+            self._train_jit = track_jit("serving.draft_train",
+                                        jax.jit(_make_train_step(
+                                            forwards, self.k)))
+            self._train_sig = sig
+        tparams = _device_params(forwards)
+        hp = {n: jnp.asarray(a) for n, a in self.params.items()}
+        mom = jax.tree_util.tree_map(jnp.zeros_like, hp)
+        rng = numpy.random.RandomState(int(seed))
+        losses = []
+        for _ in range(int(steps)):
+            starts = rng.randint(0, len(corpus) - window,
+                                 size=int(batch))
+            toks = numpy.stack([corpus[s:s + window] for s in starts]
+                               ).astype(numpy.int32)
+            hp, mom, loss = self._train_jit(
+                hp, mom, tparams, jnp.asarray(toks),
+                jnp.float32(lr), jnp.float32(momentum))
+            losses.append(float(loss))
+        self.params = {n: numpy.asarray(a) for n, a in hp.items()}
+        return losses
+
+    def __getstate__(self):
+        return {"k": self.k, "d_model": self.d_model,
+                "vocab": self.vocab, "params": self.params}
+
+    def __setstate__(self, state):
+        self.k = state["k"]
+        self.d_model = state["d_model"]
+        self.vocab = state["vocab"]
+        self.params = state["params"]
+        self._propose_jit = track_jit("serving.draft_step",
+                                      jax.jit(_make_propose(self.k)))
+        self._train_jit = None
+        self._train_sig = None

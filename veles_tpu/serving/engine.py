@@ -106,6 +106,7 @@ def clear_step_cache():
     ``generate.clear_decode_caches``)."""
     _step_cached.cache_clear()
     _paged_step_cached.cache_clear()
+    _paged_step_tp_cached.cache_clear()
     _verify_step_cached.cache_clear()
 
 
@@ -135,15 +136,34 @@ def slot_decode_step(forwards, cache, toks, pos, temps, topks, seeds,
     return nxt
 
 
-def _make_paged_step(forwards):
+def hidden_supported(forwards):
+    """True when the chain ends in a position-wise vocab head over a
+    [batch, seq, d] hidden stream — the shape the optional
+    hidden-state output lane (``want_hidden``) taps for the
+    model-based draft head (serving/draft.py): the lane returns the
+    input of the FINAL unit, i.e. the target's last hidden state."""
+    if len(forwards) < 2:
+        return False
+    last = forwards[-1]
+    return getattr(last, "DECODE_POINTWISE", False) \
+        and not hasattr(last, "init_cache")
+
+
+def _make_paged_step(forwards, want_hidden=False):
     cacheable = frozenset(i for i, u in enumerate(forwards)
                           if hasattr(u, "init_cache"))
+    last = len(forwards) - 1
 
     def step(params, toks, pos, tables, temps, topks, seeds, counts,
              pools):
         h = toks
+        hid = None
         out = dict(pools)
         for i, u in enumerate(forwards):
+            if want_hidden and i == last:
+                # the final unit's INPUT is the target's last hidden
+                # state — what the draft head conditions on
+                hid = h.astype(jnp.float32)
             if i in cacheable:
                 h, out[i] = u.apply_step_paged(params[i], h, pos,
                                                tables, pools[i])
@@ -153,7 +173,10 @@ def _make_paged_step(forwards):
                 h = u.apply(params[i], h)
         logits = h[:, 0].astype(jnp.float32)
         keys = _fold_keys(seeds, counts)
-        return sample_slots(logits, temps, topks, keys), out
+        nxt = sample_slots(logits, temps, topks, keys)
+        if want_hidden:
+            return nxt, hid[:, 0], out
+        return nxt, out
     return step
 
 
@@ -162,8 +185,93 @@ def _paged_step_cached(cache_key, closure):
     return track_jit("serving.paged_step", jax.jit(closure.fn))
 
 
+def overlap_supported(forwards):
+    """True when every cacheable block in the chain speaks the
+    per-shard decode body (``apply_step_paged_local``) the
+    collective-overlap path is built from — the gate
+    ``root.common.serving.tp_overlap`` checks before swapping the
+    GSPMD step for the explicit shard_map one."""
+    has = False
+    for u in forwards:
+        if hasattr(u, "init_cache"):
+            has = True
+            if not hasattr(u, "apply_step_paged_local"):
+                return False
+    return has
+
+
+def _make_paged_step_tp(forwards, ctx, pools, want_hidden=False):
+    """The EXPLICIT-collective tp decode step: the same math as
+    :func:`_make_paged_step` under a tp mesh, but written per-shard
+    through ``shard_map`` so each block's row-parallel reductions are
+    explicit collective-permute / all-gather ops
+    (serving/tp.tp_allreduce) instead of GSPMD-inserted all-reduces.
+    Explicit collectives let the compiler START the cross-chip hop
+    while the K/V pool writeback (data-independent of the reduction)
+    proceeds — the overlap the serialized auto-partitioned step never
+    gets.  tp=2 reduces by a single ppermute+add (bit-identical to
+    psum: two-operand float addition is order-free), wider meshes
+    all-gather and sum in fixed shard order (deterministic, same
+    value on every shard)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    size = ctx.size
+    cacheable = frozenset(i for i, u in enumerate(forwards)
+                          if hasattr(u, "init_cache"))
+    last = len(forwards) - 1
+    pspecs = {}
+    for i, u in enumerate(forwards):
+        spec_fn = getattr(u, "tp_param_spec", None)
+        layer = {}
+        for name in u.param_arrays():
+            spec = spec_fn(name, size) if spec_fn is not None \
+                else None
+            layer[name] = spec if spec is not None else P()
+        pspecs[i] = layer
+    lspecs = {}
+    for i, layer in pools.items():
+        lspecs[i] = {
+            name: P(None, None, "tp")
+            if not name.endswith("_scale") and a.ndim == 3
+            and a.shape[-1] % size == 0 else P()
+            for name, a in layer.items()}
+
+    def body(params, toks, pos, tables, temps, topks, seeds, counts,
+             pools_):
+        h = toks
+        hid = None
+        out = dict(pools_)
+        for i, u in enumerate(forwards):
+            if want_hidden and i == last:
+                hid = h.astype(jnp.float32)
+            if i in cacheable:
+                h, out[i] = u.apply_step_paged_local(
+                    params[i], h, pos, tables, pools_[i], size)
+            elif hasattr(u, "apply_step_slots"):
+                h = u.apply_step_slots(params[i], h, pos)
+            else:
+                h = u.apply(params[i], h)
+        logits = h[:, 0].astype(jnp.float32)
+        keys = _fold_keys(seeds, counts)
+        nxt = sample_slots(logits, temps, topks, keys)
+        if want_hidden:
+            return nxt, hid[:, 0], out
+        return nxt, out
+
+    rep = P()
+    in_specs = (pspecs, rep, rep, rep, rep, rep, rep, rep, lspecs)
+    out_specs = (rep, rep, lspecs) if want_hidden else (rep, lspecs)
+    return shard_map(body, mesh=ctx.mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
+@functools.lru_cache(maxsize=32)
+def _paged_step_tp_cached(cache_key, closure):
+    return track_jit("serving.paged_step_tp", jax.jit(closure.fn))
+
+
 def paged_decode_step(forwards, cache, toks, pos, tables, temps,
-                      topks, seeds, counts):
+                      topks, seeds, counts, want_hidden=False):
     """Run ONE decode step over a PACKED batch of active slots
     against ``cache`` (:class:`serving.kv_slots.PagedKVCache`,
     updated in place).
@@ -174,46 +282,81 @@ def paged_decode_step(forwards, cache, toks, pos, tables, temps,
     [B, 1], ``pos``/``temps``/``topks``/``seeds``/``counts`` [B],
     ``tables`` [B, T] physical block ids (T·block_size must cover
     ``max(pos) + 1``).  Returns the [B] next tokens; the caller maps
-    packed rows back to its slots.
+    packed rows back to its slots.  ``want_hidden`` additionally
+    returns the [B, d] f32 last hidden state (the final unit's
+    input) — the model-based draft head's conditioning
+    (serving/draft.py); the flag keys the executable cache, so
+    hidden-on and hidden-off never share a trace.
 
     A cache built with a tensor-parallel context (``cache.tp_`` —
     serving/tp.py) runs the step SPMD over the tp mesh: params ride
     pre-sharded Megatron-style, the pools head-wise, and the
     executable cache keys on the mesh size so tp on/off never share
-    a trace."""
+    a trace.  With ``root.common.serving.tp_overlap`` set (and every
+    cacheable block speaking the shard_map step — see
+    ``overlap_supported``) the step compiles through the EXPLICIT
+    collective path instead of GSPMD auto-insertion: per-shard block
+    bodies combine their row-parallel partial sums with
+    collective-permute / all-gather reductions the compiler can
+    issue asynchronously, overlapping the cross-chip hop with the
+    K/V pool writeback."""
     from veles_tpu import dtypes
+    from veles_tpu.config import root
     ctx = getattr(cache, "tp_", None)
     params = ctx.device_params(forwards) if ctx is not None \
         else _device_params(forwards)
     tables = jnp.asarray(tables, jnp.int32)
     b, t = tables.shape
+    # fp32 pools only: the int8 pool's per-row amax must reduce over
+    # the FULL feature axis (GSPMD does that collectively); a
+    # per-shard body would compute shard-local scales
+    overlap = bool(ctx is not None
+                   and root.common.serving.get("tp_overlap", False)
+                   and getattr(cache, "kv_dtype", "fp32") == "fp32"
+                   and overlap_supported(forwards))
     cache_key = (_arch_sig(forwards), b, t, cache.block_size,
                  cache.capacity_blocks,
                  getattr(cache, "kv_dtype", "fp32"),
                  ctx.size if ctx is not None else 1,
+                 bool(want_hidden), overlap,
                  str(dtypes.compute_dtype()),
                  str(dtypes.matmul_precision()))
-    fn = _paged_step_cached(cache_key,
-                            _StepClosure(_make_paged_step(forwards)))
-    nxt, cache.pools = fn(
+    if overlap:
+        fn = _paged_step_tp_cached(
+            cache_key, _StepClosure(_make_paged_step_tp(
+                forwards, ctx, cache.pools,
+                want_hidden=want_hidden)))
+    else:
+        fn = _paged_step_cached(
+            cache_key, _StepClosure(_make_paged_step(
+                forwards, want_hidden=want_hidden)))
+    got = fn(
         params, jnp.asarray(toks, jnp.int32),
         jnp.asarray(pos, jnp.int32), tables,
         jnp.asarray(temps, jnp.float32),
         jnp.asarray(topks, jnp.int32),
         jnp.asarray(seeds, jnp.uint32),
         jnp.asarray(counts, jnp.int32), cache.pools)
+    if want_hidden:
+        nxt, hid, cache.pools = got
+        return nxt, hid
+    nxt, cache.pools = got
     return nxt
 
 
-def _make_verify_step(forwards):
+def _make_verify_step(forwards, want_hidden=False):
     cacheable = frozenset(i for i, u in enumerate(forwards)
                           if hasattr(u, "init_cache"))
+    last = len(forwards) - 1
 
     def step(params, toks, pos, lens, tables, temps, topks, seeds,
              counts, pools):
         h = toks
+        hid = None
         out = dict(pools)
         for i, u in enumerate(forwards):
+            if want_hidden and i == last:
+                hid = h.astype(jnp.float32)
             if i in cacheable:
                 h, out[i] = u.apply_verify_paged(
                     params[i], h, pos, lens, tables, pools[i])
@@ -234,6 +377,8 @@ def _make_verify_step(forwards):
         nxt = sample_slots(logits, jnp.repeat(temps, k1),
                            jnp.repeat(topks, k1),
                            keys.reshape(b * k1))
+        if want_hidden:
+            return nxt.reshape(b, k1), hid, out
         return nxt.reshape(b, k1), out
     return step
 
@@ -252,7 +397,8 @@ def _verify_step_cached(cache_key, closure, donate=False):
 
 
 def verify_step_paged(forwards, cache, toks, pos, lens, tables,
-                      temps, topks, seeds, counts):
+                      temps, topks, seeds, counts,
+                      want_hidden=False):
     """Score a PACKED batch of speculative token runs in ONE model
     pass against ``cache`` (:class:`serving.kv_slots.PagedKVCache`,
     updated in place) — the batched verify step of speculative
@@ -271,7 +417,11 @@ def verify_step_paged(forwards, cache, toks, pos, lens, tables,
     its first j drafted tokens — the host accepts the longest prefix
     where draft j matches sample j-1 (plus the first non-matching
     sample, the "free" correction token), which reproduces the
-    spec-off stream bit-for-bit for greedy AND per-seed sampling."""
+    spec-off stream bit-for-bit for greedy AND per-seed sampling.
+    ``want_hidden`` additionally returns the [B, K1, d] f32 hidden
+    states (the final unit's input at every scored position) — after
+    accepting L tokens the scheduler carries row position L-1's
+    hidden into the next iteration's model-based draft."""
     from veles_tpu import dtypes
     from veles_tpu.config import root
     ctx = getattr(cache, "tp_", None)
@@ -291,18 +441,25 @@ def verify_step_paged(forwards, cache, toks, pos, lens, tables,
     cache_key = (_arch_sig(forwards), b, k1, t, cache.block_size,
                  cache.capacity_blocks, kv_dtype, fused,
                  ctx.size if ctx is not None else 1,
+                 bool(want_hidden),
                  str(dtypes.compute_dtype()),
                  str(dtypes.matmul_precision()))
-    fn = _verify_step_cached(cache_key,
-                             _StepClosure(_make_verify_step(forwards)),
-                             donate=fused or kv_dtype == "int8")
-    nxt, cache.pools = fn(
+    fn = _verify_step_cached(
+        cache_key,
+        _StepClosure(_make_verify_step(forwards,
+                                       want_hidden=want_hidden)),
+        donate=fused or kv_dtype == "int8")
+    got = fn(
         params, toks, jnp.asarray(pos, jnp.int32),
         jnp.asarray(lens, jnp.int32), tables,
         jnp.asarray(temps, jnp.float32),
         jnp.asarray(topks, jnp.int32),
         jnp.asarray(seeds, jnp.uint32),
         jnp.asarray(counts, jnp.int32), cache.pools)
+    if want_hidden:
+        nxt, hid, cache.pools = got
+        return nxt, hid
+    nxt, cache.pools = got
     return nxt
 
 

@@ -33,6 +33,13 @@ import jax.numpy as jnp
 #: whole nats)
 KV_QUANT_CE_TOLERANCE = 0.05
 
+#: declared int8-WEIGHT quality bound (quantize_weights — per
+#: output-column absmax, deferred dequant on the f32 accumulator),
+#: same units and same reasoning as the KV bound: measured deltas on
+#: the trained tiny chain sit far below it, a scale bug blows
+#: through it
+WEIGHT_QUANT_CE_TOLERANCE = 0.05
+
 
 def _verify_pass(forwards, params, toks, pos, lens, tables, pools):
     """One teacher-forced chunk through the chain's verify path —
@@ -125,4 +132,47 @@ def kv_quant_quality(forwards, seqs, block_size=16,
         "kv_quant_within_tolerance": bool(delta <= tolerance),
         "kv_quant_positions": total,
         "kv_quant_block_size": int(block_size),
+    }
+
+
+def weight_quant_quality(forwards, seqs, block_size=16,
+                         tolerance=WEIGHT_QUANT_CE_TOLERANCE):
+    """Measure the int8 CHECKPOINT-weight quality cost (the
+    ``weights_dtype="int8"`` snapshot load / ``quantize_weights``
+    path) the same way ``kv_quant_quality`` measures KV: teacher-
+    forced CE through the identical verify path, f32 weights first,
+    then AFTER quantizing every block in place.  NOTE: the chain
+    comes back quantized — run this gate last (or on a throwaway
+    load), exactly how quality.py and the tp bench use it."""
+    ce_fp, total_targets = [], []
+    for seq in seqs:
+        lf = teacher_forced_logits(forwards, seq, block_size, "fp32")
+        n = min(len(lf), len(seq) - 1)
+        targets = numpy.asarray(seq[1:n + 1], numpy.intp)
+        ce_fp.append(_mean_ce(lf[:n], targets))
+        total_targets.append((n, targets))
+    quantized = 0
+    for u in forwards:
+        if hasattr(u, "quantize_weights"):
+            u.quantize_weights()
+            quantized += 1
+    if not quantized:
+        raise ValueError("no quantizable unit in the chain")
+    ce_q8, agree, total = [], 0, 0
+    for seq, (n, targets) in zip(seqs, total_targets):
+        lf = teacher_forced_logits(forwards, seq, block_size, "fp32")
+        lq = lf[:n]
+        ce_q8.append(_mean_ce(lq, targets))
+        total += n
+    ce_fp32 = float(numpy.mean(ce_fp))
+    ce_int8 = float(numpy.mean(ce_q8))
+    delta = ce_int8 - ce_fp32
+    return {
+        "weight_quant_ce_fp32": round(ce_fp32, 6),
+        "weight_quant_ce_int8": round(ce_int8, 6),
+        "weight_quant_ce_delta": round(delta, 6),
+        "weight_quant_ce_tolerance": tolerance,
+        "weight_quant_within_tolerance": bool(delta <= tolerance),
+        "weight_quant_positions": total,
+        "weight_quant_blocks": quantized,
     }

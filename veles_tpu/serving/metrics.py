@@ -777,6 +777,12 @@ class ServingMetrics:
         self.spec_drafted_tokens = 0    # proposer output, cumulative
         self.spec_accepted_tokens = 0   # drafts kept at verify
         self.spec_rollback_tokens = 0   # drafts rejected at verify
+        #: {drafter: [drafted, accepted]} — the arbitration between
+        #: the n-gram proposer and the model draft head is per-slot,
+        #: so accept rates must split by source to be interpretable
+        self.spec_by_drafter = {}
+        self.spec_draft_k_last = 0      # adaptive draft length, last
+        self.spec_draft_k_min_seen = 0  # ...and the smallest adapted-to
         # instance-lifetime latency histograms (the shared telemetry
         # type: bounded reservoir + bucket counts), window = `recent`
         self._ttft = Histogram("ttft_ms", buckets=MS_BUCKETS,
@@ -925,15 +931,30 @@ class ServingMetrics:
         self._global["kv_export_fetched"].labels(
             replica=self.replica).inc()
 
-    def record_spec(self, drafted, accepted):
+    def record_spec(self, drafted, accepted, drafter="ngram",
+                    draft_k=None):
         """One slot's verify outcome: ``drafted`` tokens proposed,
         ``accepted`` of them kept (the correction token is free and
-        not counted either way)."""
+        not counted either way).  ``drafter`` names the source that
+        proposed this slot's drafts ("ngram" or "model") so accept
+        rates stay interpretable under per-slot arbitration;
+        ``draft_k`` (when given) is the slot's ADAPTED draft length
+        after this verify — the gauge tests watch to see the EMA
+        controller shrink under rejection."""
         drafted, accepted = int(drafted), int(accepted)
         with self._lock:
             self.spec_drafted_tokens += drafted
             self.spec_accepted_tokens += accepted
             self.spec_rollback_tokens += drafted - accepted
+            rec = self.spec_by_drafter.setdefault(str(drafter), [0, 0])
+            rec[0] += drafted
+            rec[1] += accepted
+            if draft_k is not None:
+                draft_k = int(draft_k)
+                self.spec_draft_k_last = draft_k
+                if not self.spec_draft_k_min_seen \
+                        or draft_k < self.spec_draft_k_min_seen:
+                    self.spec_draft_k_min_seen = draft_k
         self._global["spec_drafted"].inc(drafted)
         self._global["spec_accepted"].inc(accepted)
         self._global["spec_rollback"].inc(drafted - accepted)
@@ -1221,6 +1242,12 @@ class ServingMetrics:
                     self.spec_accepted_tokens
                     / self.spec_drafted_tokens, 4)
                 if self.spec_drafted_tokens else None,
+                "spec_accept_rate_by_drafter": {
+                    name: round(rec[1] / rec[0], 4) if rec[0] else None
+                    for name, rec in sorted(
+                        self.spec_by_drafter.items())},
+                "spec_draft_k_last": self.spec_draft_k_last,
+                "spec_draft_k_min_seen": self.spec_draft_k_min_seen,
                 "uptime_s": round(time.monotonic() - self._t0, 3),
             }
         if kv:  # paged-cache occupancy (operator admission headroom)

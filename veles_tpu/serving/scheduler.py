@@ -77,11 +77,13 @@ plus the correction sample reproduces the spec-off stream
 bit-for-bit (greedy AND seeded; the verify samples fold the same
 per-request draw counters), rejected tails roll back logically
 (their K/V rows sit past the accepted length, masked until
-overwritten), and the occupancy/depth bucket ladder grows ONE
-fixed-width draft axis (k = ``spec_k``; shorter draft sets pad and
-``lens`` masks them) pre-compiled at :meth:`start` — one verify
-executable per (B, T) instead of a per-k ladder, which halves the
-warmup compile count the flipped-on default would otherwise pay.  The **radix
+overwritten), and the occupancy/depth bucket ladder grows a draft
+axis: ONE fixed ``spec_k``-wide verify executable per (B, T) for
+n-gram-only schedulers (shorter draft sets pad and ``lens`` masks
+them — the pre-PR 20 compile count), while model-drafter schedulers
+(``draft_head`` attached) key the width on the power-of-two bucket
+of the widest per-slot adaptive ``draft_k`` so collapsed-accept-rate
+batches stop paying ``spec_k``-wide sampling.  The **radix
 prefix cache** (``prefix_cache`` + ``prefix_evict``;
 :mod:`veles_tpu.serving.prefix_cache`) makes KV blocks
 cross-request: finished requests donate their written blocks,
@@ -191,7 +193,9 @@ from veles_tpu.serving.prefill import (
     chunked_supported, prefill, prefill_chunk, serving_supported,
     serving_window)
 from veles_tpu.serving.prefix_cache import RadixPrefixCache
-from veles_tpu.serving.spec import NgramProposer, accept_drafts
+from veles_tpu.serving.draft import draft_supported
+from veles_tpu.serving.spec import (
+    NgramIndex, NgramProposer, accept_drafts)
 from veles_tpu.serving.streams import TokenStream
 
 #: priority classes, lowest to highest; ints in [0, 2] also accepted
@@ -318,7 +322,8 @@ class _Request(object):
                  "t_admit", "t_first", "pf_seq", "pf_caches",
                  "pf_off", "pf_width", "pf_chunk", "pf_matched",
                  "prefix_handle", "priority", "sink", "trace",
-                 "tenant", "export_only", "kv_import")
+                 "tenant", "export_only", "kv_import", "hid",
+                 "draft_k", "accept_ema", "gram_ix")
 
     def __init__(self, prompt, steps, temperature, top_k, stop_token,
                  seed, deadline, priority=1, sink=None, trace=None,
@@ -354,6 +359,16 @@ class _Request(object):
         self.prefix_handle = None  # pinned radix-cache match
         self.export_only = False  # prefill-role: stop after export
         self.kv_import = None     # decode-role: adopted export record
+        # speculative-drafting state (spec mode): the last hidden
+        # state the verify/decode lane returned for this request
+        # (None until the first post-prefill step — the model drafter
+        # falls back to n-gram there), the accept-rate-adaptive draft
+        # length (set at admission), per-drafter accept-rate EMAs,
+        # and the memoized trailing-ngram index
+        self.hid = None
+        self.draft_k = 0
+        self.accept_ema = {}
+        self.gram_ix = None
 
     def fail(self, error):
         """Set the future's exception unless a racing path (watchdog,
@@ -385,9 +400,10 @@ class InferenceScheduler(Logger):
                  kv_dtype=None, prefill_chunk=None, warm_buckets=None,
                  request_timeout=None, watchdog=None,
                  shed_block_factor=None, spec=None, spec_k=None,
-                 prefix_cache=None, prefix_evict=None, tp=None,
-                 role=None, replica_id=None, kv_host_bytes=None,
-                 kv_export_bytes=None):
+                 drafter=None, draft_head=None, draft_k_min=None,
+                 draft_ema=None, prefix_cache=None, prefix_evict=None,
+                 tp=None, role=None, replica_id=None,
+                 kv_host_bytes=None, kv_export_bytes=None):
         super(InferenceScheduler, self).__init__()
         if not serving_supported(forwards):
             raise ValueError(
@@ -480,6 +496,55 @@ class InferenceScheduler(Logger):
         self.spec = spec
         self._proposer = NgramProposer(k=self.spec_k) if spec \
             else None
+        #: draft source: "ngram" (prompt lookup, zero weights — the
+        #: PR 9 baseline) or "model" (Medusa heads over the target's
+        #: last hidden state, serving/draft.py — pass the trained
+        #: head as ``draft_head``).  Arbitrated PER SLOT at runtime:
+        #: the model head needs a hidden state (absent on the first
+        #: step after prefill/resume) and per-drafter accept-rate
+        #: EMAs pick whichever source earns its drafts; either way
+        #: acceptance keeps streams bit-identical to spec-off
+        drafter_ = str(_serving_conf("drafter", "ngram")
+                       if drafter is None else drafter)
+        if drafter_ not in ("ngram", "model"):
+            raise ValueError("drafter must be 'ngram' or 'model'")
+        if drafter_ == "model" and spec:
+            if draft_head is None:
+                self.info("drafter='model' needs a trained "
+                          "draft_head; falling back to n-gram")
+                drafter_ = "ngram"
+            elif not draft_supported(forwards):
+                self.info("chain has no hidden-state lane for the "
+                          "model drafter; falling back to n-gram")
+                drafter_ = "ngram"
+        self.drafter = drafter_ if spec else "ngram"
+        self._draft_head = draft_head \
+            if spec and self.drafter == "model" else None
+        if self._draft_head is not None:
+            d, v = forwards[-1].weights.mem.shape
+            if (self._draft_head.d_model,
+                    self._draft_head.vocab) != (d, v):
+                raise ValueError(
+                    "draft_head sized (d=%d, vocab=%d) but the chain "
+                    "serves (d=%d, vocab=%d)"
+                    % (self._draft_head.d_model,
+                       self._draft_head.vocab, d, v))
+        #: accept-rate-adaptive draft length (spec mode): per-slot
+        #: EMA of accepted/drafted with weight ``draft_ema`` shrinks
+        #: the slot's draft k (halving, floor ``draft_k_min``) while
+        #: acceptance is poor and grows it back toward spec_k while
+        #: acceptance is high — the verify width then buckets to the
+        #: power of two covering the longest live draft, so cold
+        #: slots stop paying the full-k verify
+        self.draft_k_min = int(_serving_conf("draft_k_min", 1)
+                               if draft_k_min is None else draft_k_min)
+        self.draft_k_min = max(1, min(self.draft_k_min, self.spec_k))
+        self.draft_ema = float(_serving_conf("draft_ema", 0.5)
+                               if draft_ema is None else draft_ema)
+        if not 0.0 < self.draft_ema <= 1.0:
+            raise ValueError("draft_ema must be in (0, 1]")
+        self.draft_shrink = float(_serving_conf("draft_shrink", 0.5))
+        self.draft_grow = float(_serving_conf("draft_grow", 0.8))
         #: cross-request radix prefix cache (serving/prefix_cache.py)
         #: — needs the paged cache, chunked prefill for the cold
         #: tail, and a power-of-two block size (the staging/chunk
@@ -1359,6 +1424,8 @@ class InferenceScheduler(Logger):
                 else self.kv_blocks
         out["spec"] = self.spec
         out["spec_k"] = self.spec_k if self.spec else 0
+        out["drafter"] = self.drafter if self.spec else None
+        out["draft_k_min"] = self.draft_k_min if self.spec else 0
         pfx = self.prefix_
         out["prefix_cache"] = pfx is not None
         if pfx is not None:  # loop-owned; monitoring-grade reads
@@ -1536,9 +1603,19 @@ class InferenceScheduler(Logger):
                           for n in range(1, self.max_slots + 1)})
         depths = sorted({_bucket(n, 1, cache.blocks_per_slot)
                          for n in range(1, cache.blocks_per_slot + 1)})
-        # the verify grid rides ONE fixed draft width (shorter draft
-        # sets pad up; lens masks) — see _step_verify
-        ks = [self.spec_k] if self.spec else []
+        # n-gram-only schedulers verify at ONE fixed spec_k width, so
+        # warmup compiles one executable per (B, T) — the pre-PR 20
+        # count.  Only model-drafter schedulers (draft head attached)
+        # ride the adaptive pow2 width ladder (see _step_verify), and
+        # only they warm it.
+        if not self.spec:
+            ks = []
+        elif self._draft_head is not None:
+            ks = sorted({_bucket(n, 1, self.spec_k)
+                         for n in range(1, self.spec_k + 1)})
+        else:
+            ks = [_bucket(self.spec_k, 1, self.spec_k)]
+        want_h = self._draft_head is not None
         t0 = time.monotonic()
         for b in buckets:
             for t in depths:
@@ -1550,7 +1627,8 @@ class InferenceScheduler(Logger):
                     numpy.zeros((b,), numpy.float32),
                     numpy.zeros((b,), numpy.int32),
                     numpy.zeros((b,), numpy.uint32),
-                    numpy.zeros((b,), numpy.int32))
+                    numpy.zeros((b,), numpy.int32),
+                    want_hidden=want_h)
                 for kk in ks:
                     # the verify ladder rides the same dummy trash-
                     # block convention, one executable per (B, T, k)
@@ -1563,7 +1641,8 @@ class InferenceScheduler(Logger):
                         numpy.zeros((b,), numpy.float32),
                         numpy.zeros((b,), numpy.int32),
                         numpy.zeros((b,), numpy.uint32),
-                        numpy.zeros((b,), numpy.int32))
+                        numpy.zeros((b,), numpy.int32),
+                        want_hidden=want_h)
         self.info("paged-step warmup: %d occupancy x %d depth x "
                   "%d spec buckets in %.2fs", len(buckets),
                   len(depths), len(ks) + 1, time.monotonic() - t0)
@@ -1766,6 +1845,10 @@ class InferenceScheduler(Logger):
             self._sync_prefix_gauges()
         req.slot = None
         req.pf_matched = 0
+        # the hidden the draft head conditions on is per-position
+        # host state — a resume re-prefills and re-earns it, and a
+        # finished request must not pin a d_model float vector
+        req.hid = None
 
     def _sync_prefix_gauges(self):
         if self.prefix_ is not None:
@@ -2328,22 +2411,83 @@ class InferenceScheduler(Logger):
         seeds[j] = req.seed
         counts[j] = len(req.generated)
 
+    def _pick_model(self, req):
+        """Per-slot drafter arbitration: take the model head unless
+        its accept-rate EMA has fallen below the n-gram proposer's.
+        Unseen drafters score an optimistic 1.0 (each gets tried
+        before being judged), ties go to the model — so a slot whose
+        model drafts reject drifts to n-gram and drifts back the
+        moment n-gram does worse."""
+        em = req.accept_ema.get("model")
+        en = req.accept_ema.get("ngram")
+        return (1.0 if em is None else em) \
+            >= (1.0 if en is None else en)
+
     def _draft(self, active):
-        """Propose up to spec_k draft tokens per slot by n-gram
-        prompt lookup over its own context — capped so accepting
-        every draft plus the correction token never exceeds the
-        request's step budget (the positions stay inside the blocks
-        claimed at admission)."""
-        drafts = {}
+        """Propose draft tokens per slot — capped so accepting every
+        draft plus the correction token never exceeds the request's
+        step budget (the positions stay inside the blocks claimed at
+        admission).  Each slot drafts up to its ADAPTIVE ``draft_k``
+        (accept-rate EMA; see __init__) from its arbitrated source:
+        the Medusa head batched over every slot with a live hidden
+        state, or n-gram prompt lookup through the request's memoized
+        trailing-gram index.  Returns ``(drafts, sources)`` —
+        {slot: tokens} and {slot: "model"|"ngram"}."""
+        drafts, sources = {}, {}
+        model_out = {}
+        if self._draft_head is not None:
+            rows = [s for s in sorted(active)
+                    if active[s].hid is not None]
+            if rows:
+                out = self._draft_head.propose(
+                    numpy.stack([active[s].hid for s in rows]))
+                for j, slot in enumerate(rows):
+                    model_out[slot] = out[j]
         for slot, req in active.items():
             room = req.steps - len(req.generated) - 1
             if room < 1:
                 continue
-            d = self._proposer.propose(
-                list(req.prompt) + list(req.generated), room)
+            if req.draft_k < 1:
+                req.draft_k = self.spec_k  # start optimistic
+            limit = min(req.draft_k, room)
+            d = None
+            if slot in model_out and self._pick_model(req):
+                d = [int(t) for t in model_out[slot][:limit]]
+                sources[slot] = "model"
+            if not d:
+                if req.gram_ix is None:
+                    req.gram_ix = NgramIndex(
+                        self._proposer.max_ngram,
+                        self._proposer.min_ngram)
+                d = self._proposer.propose(
+                    list(req.prompt) + list(req.generated), limit,
+                    index=req.gram_ix)
+                sources[slot] = "ngram"
             if d:
-                drafts[slot] = d
-        return drafts
+                drafts[slot] = d[:limit]
+            else:
+                sources.pop(slot, None)
+        return drafts, sources
+
+    def _adapt_draft_k(self, req, drafted, accepted, drafter):
+        """Post-verify accept-rate bookkeeping for one slot: blend
+        this verify's accept rate into the slot's per-drafter EMA
+        (weight ``draft_ema``), then steer the slot's draft length —
+        halve toward ``draft_k_min`` below ``draft_shrink`` (stop
+        paying verify width for drafts that keep rejecting), double
+        toward ``spec_k`` above ``draft_grow``.  Powers of two only,
+        so every length lands on a warmed verify bucket."""
+        rate = accepted / drafted
+        prev = req.accept_ema.get(drafter)
+        ema = rate if prev is None \
+            else (1.0 - self.draft_ema) * prev + self.draft_ema * rate
+        req.accept_ema[drafter] = ema
+        if ema < self.draft_shrink:
+            req.draft_k = max(self.draft_k_min, req.draft_k >> 1)
+        elif ema > self.draft_grow:
+            req.draft_k = min(self.spec_k, req.draft_k << 1)
+        self.stats.record_spec(drafted, accepted, drafter=drafter,
+                               draft_k=req.draft_k)
 
     def _meter_step(self, active, cache, dt):
         """Step-boundary usage attribution (PR 17 metering): each
@@ -2373,9 +2517,9 @@ class InferenceScheduler(Logger):
         to a power-of-two occupancy bucket; the attended range is the
         power-of-two block bucket of the deepest request."""
         if self.spec:
-            drafts = self._draft(active)
+            drafts, sources = self._draft(active)
             if drafts:
-                self._step_verify(cache, active, drafts)
+                self._step_verify(cache, active, drafts, sources)
                 return
         slots = sorted(active)
         n = len(slots)
@@ -2395,16 +2539,27 @@ class InferenceScheduler(Logger):
         for j, slot in enumerate(slots):
             self._fill_row(arrays, j, active[slot])
         tables[:n] = cache.table_rows(slots, t)
+        want_h = self._draft_head is not None
         t0 = time.perf_counter()
-        nxt = numpy.asarray(paged_decode_step(
+        got = paged_decode_step(
             self.forwards, cache, toks, pos, tables, temps, topks,
-            seeds, counts))
+            seeds, counts, want_hidden=want_h)
+        if want_h:
+            nxt, hid = got
+            hid = numpy.asarray(hid)
+        else:
+            nxt = got
+        nxt = numpy.asarray(nxt)
         dt = time.perf_counter() - t0
         # plain decode: every active slot emits exactly one token
         self.stats.record_step(n, b, tokens=n, duration_s=dt)
         self._meter_step(active, cache, dt)
         for j, slot in enumerate(slots):
             req = active[slot]
+            if want_h:
+                # hidden of the position just decoded — what the
+                # Medusa heads condition on next iteration
+                req.hid = hid[j]
             self._emit(req, int(nxt[j]))
             self._maybe_finish(req, cache)
         if self._tron:
@@ -2415,7 +2570,7 @@ class InferenceScheduler(Logger):
             reqtrace.record_step(emitted, duration=dt,
                                  mode="decode", slots=n, bucket=b)
 
-    def _step_verify(self, cache, active, drafts):
+    def _step_verify(self, cache, active, drafts, sources):
         """Speculative step: every active slot rides ONE batched
         verify pass — its pending token plus its drafts (slots
         without a draft run a plain width-1 decode inside the same
@@ -2427,12 +2582,22 @@ class InferenceScheduler(Logger):
         slots = sorted(active)
         n = len(slots)
         b = _bucket(n, 1, self.max_slots)
-        # fixed draft width: every verify pass runs at k = spec_k
-        # (lens masks the padding) so there is exactly ONE verify
-        # executable per (B, T) — a per-k ladder would 4x the warmup
-        # compile count for a bandwidth-bound step whose width
-        # barely moves its cost
-        k = self.spec_k
+        # adaptive draft width — MODEL-DRAFTER schedulers only: the
+        # verify runs at the power-of-two bucket of the widest draft
+        # BUDGET among drafting slots, so when every slot's EMA
+        # controller has shrunk its draft_k the pass stops paying
+        # spec_k-wide sampling for one-token drafts.  Keying on
+        # draft_k (not raw draft lengths) keeps un-shrunk batches on
+        # the spec_k-wide executable; the ladder is bounded at
+        # log2(spec_k) + 1 per (B, T) and only exists where a draft
+        # head is attached — n-gram-only schedulers keep the ONE
+        # fixed-width executable (drafts pad up, ``lens`` masks), so
+        # the flipped-on spec default compiles nothing extra.
+        if self._draft_head is not None:
+            k = _bucket(max(active[s].draft_k for s in drafts),
+                        1, self.spec_k)
+        else:
+            k = _bucket(self.spec_k, 1, self.spec_k)
         bs = cache.block_size
         deepest = max(len(active[s].prompt)
                       + len(active[s].generated) for s in slots) + k
@@ -2458,10 +2623,17 @@ class InferenceScheduler(Logger):
             seeds[j] = req.seed
             counts[j] = len(req.generated)
         tables[:n] = cache.table_rows(slots, t)
+        want_h = self._draft_head is not None
         t0 = time.perf_counter()
-        nxt = numpy.asarray(verify_step_paged(
+        got = verify_step_paged(
             self.forwards, cache, toks, pos, lens, tables, temps,
-            topks, seeds, counts))
+            topks, seeds, counts, want_hidden=want_h)
+        if want_h:
+            nxt, hid = got
+            hid = numpy.asarray(hid)
+        else:
+            nxt = got
+        nxt = numpy.asarray(nxt)
         dt = time.perf_counter() - t0
         # metered BEFORE acceptance retires finished slots — the
         # step's residency belongs to everyone who rode the batch
@@ -2471,8 +2643,6 @@ class InferenceScheduler(Logger):
             req = active[slot]
             d = list(drafts.get(slot, ()))[:k]
             out = accept_drafts(d, nxt[j, :len(d) + 1])
-            if d:
-                self.stats.record_spec(len(d), len(out) - 1)
             before = len(req.generated)
             for tok in out:
                 self._emit(req, int(tok))
@@ -2480,8 +2650,16 @@ class InferenceScheduler(Logger):
                         or (req.stop_token is not None
                             and int(tok) == req.stop_token):
                     break
-            emitted[req.trace] = emitted.get(req.trace, 0) \
-                + len(req.generated) - before
+            done = len(req.generated) - before
+            if want_h and done > 0:
+                # hidden of the LAST position this verify scored and
+                # kept — row [j, done-1] conditioned the token now
+                # pending, so the Medusa heads read it next iteration
+                req.hid = hid[j, done - 1]
+            if d:
+                self._adapt_draft_k(req, len(d), len(out) - 1,
+                                    sources.get(slot, "ngram"))
+            emitted[req.trace] = emitted.get(req.trace, 0) + done
             self._maybe_finish(req, cache)
         # recorded AFTER acceptance so goodput counts what the verify
         # actually emitted (a fully-rejected batch is 0 good tokens)

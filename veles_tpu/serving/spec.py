@@ -20,7 +20,54 @@ the prompt — exactly the traffic a serving fleet sees most.
 
 Host-side and stateless per call: the scheduler owns one proposer and
 calls :meth:`NgramProposer.propose` per active slot per iteration.
+Long contexts can hand ``propose`` a per-request :class:`NgramIndex`
+— an incrementally-maintained map from every n-gram to its two most
+recent occurrence starts — turning the O(len·max_ngram) right-to-left
+rescan into an O(max_ngram) lookup after an O(max_ngram)-per-new-token
+sync (the context is append-only, so the index never rebuilds).
 """
+
+
+class NgramIndex:
+    """Incremental trailing-n-gram index over ONE request's
+    append-only context: ``_last[gram] = (last_start, prev_start)``
+    — the start offsets of the gram's most recent and second most
+    recent occurrences (``None`` when it has appeared only once).
+    After :meth:`sync`, the trailing gram's most recent occurrence is
+    the tail itself, so ``prev_start`` IS the "most recent PRIOR
+    occurrence" the scan-based proposer finds — same answer, O(1)
+    per gram length instead of a rescan of the whole context."""
+
+    def __init__(self, max_ngram=3, min_ngram=1):
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = max(1, int(min_ngram))
+        self.n = 0          # context prefix already indexed
+        self._last = {}
+
+    def sync(self, context):
+        """Fold any newly APPENDED tokens into the index.  A context
+        shorter than what was already indexed means the caller's
+        stream was rewritten (never happens in the scheduler — a
+        preempt-resume re-prefills the same tokens) — rebuild from
+        scratch rather than serve stale offsets."""
+        if len(context) < self.n:
+            self.n = 0
+            self._last.clear()
+        for i in range(self.n, len(context)):
+            for g in range(self.min_ngram,
+                           min(self.max_ngram, i + 1) + 1):
+                s = i - g + 1
+                gram = tuple(context[s:i + 1])
+                prev = self._last.get(gram)
+                self._last[gram] = (
+                    s, prev[0] if prev is not None else None)
+        self.n = len(context)
+
+    def prior(self, gram):
+        """Start offset of the most recent occurrence of ``gram``
+        BEFORE its trailing occurrence, or None."""
+        entry = self._last.get(tuple(gram))
+        return entry[1] if entry is not None else None
 
 
 class NgramProposer:
@@ -42,20 +89,34 @@ class NgramProposer:
         if self.max_ngram < self.min_ngram:
             raise ValueError("max_ngram < min_ngram")
 
-    def propose(self, context, max_tokens=None):
+    def propose(self, context, max_tokens=None, index=None):
         """Draft tokens continuing ``context`` (a list of ints, the
         request's prompt + generated stream).  Returns a list of at
         most ``min(k, max_tokens)`` drafted ids — empty when no
         earlier occurrence of the trailing n-gram exists (the caller
-        then runs a plain decode step for that slot)."""
+        then runs a plain decode step for that slot).
+
+        ``index`` (an optional per-request :class:`NgramIndex`) makes
+        the lookup O(max_ngram) instead of a right-to-left context
+        rescan — same drafts, memoized (the index syncs itself to any
+        tokens appended since its last call)."""
         limit = self.k if max_tokens is None \
             else min(self.k, int(max_tokens))
         n_ctx = len(context)
         if limit < 1 or n_ctx < self.min_ngram + 1:
             return []
+        if index is not None:
+            index.sync(context)
         for n in range(min(self.max_ngram, n_ctx - 1),
                        self.min_ngram - 1, -1):
             tail = context[n_ctx - n:]
+            if index is not None:
+                j = index.prior(tail)
+                if j is not None:
+                    cont = context[j + n:j + n + limit]
+                    if cont:
+                        return list(cont)
+                continue
             # scan right-to-left for the most recent PRIOR occurrence
             # (recent text predicts the continuation best)
             for j in range(n_ctx - n - 1, -1, -1):

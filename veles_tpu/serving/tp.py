@@ -28,9 +28,29 @@ runs SPMD over the mesh with no per-step host logic changes.
 """
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from veles_tpu.parallel.mesh import build_mesh
+
+
+def tp_allreduce(x, axis, size):
+    """Deterministic EXPLICIT all-reduce for the collective-overlap
+    decode step (``engine._make_paged_step_tp`` — per-shard bodies
+    under shard_map): sums ``x`` over the ``axis`` mesh axis of
+    ``size`` shards.
+
+    tp=2 reduces with ONE collective-permute plus a local add —
+    bit-identical to ``psum`` (two-operand float addition is
+    order-free) and expressed as a point-to-point the compiler can
+    issue asynchronously, overlapping the hop with independent
+    compute (the K/V pool writeback in the decode step).  Wider
+    meshes all-gather and sum in FIXED shard order, so every shard
+    folds the partials identically and the result is replicated
+    exactly — the property the bit-parity tests lean on."""
+    if size == 2:
+        return x + jax.lax.ppermute(x, axis, [(0, 1), (1, 0)])
+    return jnp.sum(jax.lax.all_gather(x, axis, axis=0), axis=0)
 
 
 def tp_supported(forwards, size):

@@ -122,9 +122,21 @@ class SnapshotterToFile(SnapshotterBase):
             pass
 
     @staticmethod
-    def import_file(path):
+    def import_file(path, weights_dtype=None):
         """Load a snapshot back into a live workflow
-        (ref: snapshotter.py:411-420 + __main__.py:539-589)."""
+        (ref: snapshotter.py:411-420 + __main__.py:539-589).
+
+        ``weights_dtype="int8"`` quantizes every unit exposing
+        ``quantize_weights`` (the transformer blocks) AT LOAD TIME:
+        the f32 checkpoint stays on disk untouched, the resident
+        copy holds int8 weights + per-output-column scales — weight
+        HBM halves before the first upload ever happens.  Serving
+        quality rides the weight_quant gate
+        (serving/kv_quality.weight_quant_quality)."""
+        if weights_dtype not in (None, "fp32", "int8"):
+            raise ValueError(
+                "weights_dtype must be fp32 or int8, got %r"
+                % (weights_dtype,))
         for codec, ext in EXT.items():
             if path.endswith(ext) and ext != ".pickle":
                 opener = CODECS[codec]
@@ -134,6 +146,10 @@ class SnapshotterToFile(SnapshotterBase):
         with opener(path, "r") as f:
             obj = pickle.load(f)
         obj._restored_from_snapshot_ = True
+        if weights_dtype == "int8":
+            for unit in getattr(obj, "units", ()):
+                if hasattr(unit, "quantize_weights"):
+                    unit.quantize_weights()
         return obj
 
 
